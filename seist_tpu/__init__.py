@@ -1,0 +1,28 @@
+"""seist_tpu — a TPU-native (JAX/XLA/pjit) seismic-monitoring deep-learning
+framework with the capabilities of senli1073/SeisT.
+
+Layout:
+    seist_tpu.taskspec   task specs + io-item catalog   (replaces config.py)
+    seist_tpu.registry   model/dataset registries       (replaces _factory.py x2)
+    seist_tpu.data       datasets, preprocessing, input pipeline
+    seist_tpu.models     Flax model zoo + losses + checkpointing
+    seist_tpu.ops        on-device postprocess (picking/trigger) + metrics
+    seist_tpu.parallel   mesh construction, sharding, multi-host init
+    seist_tpu.train      jitted train/eval loops, LR schedules
+    seist_tpu.utils      logger, meters, misc
+"""
+
+__version__ = "0.1.0"
+
+from seist_tpu import registry, taskspec  # noqa: F401
+
+
+def load_all(validate: bool = True) -> None:
+    """Import all model/dataset modules (running their registrations) and
+    validate task specs — the counterpart of the reference's import-time
+    ``Config.check_and_init()`` (config.py:435)."""
+    import seist_tpu.models  # noqa: F401
+    import seist_tpu.data  # noqa: F401
+
+    if validate:
+        taskspec.validate()

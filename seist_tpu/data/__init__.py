@@ -1,0 +1,3 @@
+"""Datasets + preprocessing. Importing this package registers all datasets."""
+
+from seist_tpu.data.preprocess import DataPreprocessor, pad_array, pad_phases  # noqa: F401

@@ -1,0 +1,13 @@
+"""Model zoo. Importing this package registers all models (the reference does
+the same in models/__init__.py:2-10)."""
+
+from seist_tpu.models.losses import (  # noqa: F401
+    BCELoss,
+    BinaryFocalLoss,
+    CELoss,
+    CombinationLoss,
+    FocalLoss,
+    HuberLoss,
+    MousaviLoss,
+    MSELoss,
+)

@@ -1,0 +1,3 @@
+from seist_tpu.utils.logger import logger  # noqa: F401
+from seist_tpu.utils.meters import AverageMeter, ProgressMeter  # noqa: F401
+from seist_tpu.utils import misc  # noqa: F401

@@ -1,0 +1,69 @@
+"""Process-global multi-file logger.
+
+TPU-native counterpart of the reference's ``utils/logger.py:5-89`` singleton:
+per-phase log files (``global.log`` / ``train.log`` / ``test.log``) plus
+console output, with attribute proxying so ``logger.info(...)`` works
+module-level. In multi-host runs only process 0 logs to console by default.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Dict, Optional
+
+
+class _Logger:
+    _FMT = "%(asctime)s | %(levelname)s | %(message)s"
+
+    def __init__(self):
+        self._logdir: Optional[str] = None
+        self._loggers: Dict[str, logging.Logger] = {}
+        self._active: str = "global"
+        self._console_enabled = True
+        self._ensure("global")
+
+    def _ensure(self, name: str) -> logging.Logger:
+        if name in self._loggers:
+            return self._loggers[name]
+        lg = logging.getLogger(f"seist_tpu.{name}")
+        lg.setLevel(logging.INFO)
+        lg.propagate = False
+        if self._console_enabled:
+            h = logging.StreamHandler(sys.stdout)
+            h.setFormatter(logging.Formatter(self._FMT))
+            lg.addHandler(h)
+        if self._logdir is not None:
+            fh = logging.FileHandler(os.path.join(self._logdir, f"{name}.log"))
+            fh.setFormatter(logging.Formatter(self._FMT))
+            lg.addHandler(fh)
+        self._loggers[name] = lg
+        return lg
+
+    def set_logdir(self, logdir: str) -> None:
+        os.makedirs(logdir, exist_ok=True)
+        self._logdir = logdir
+        # Re-attach file handlers for existing loggers.
+        names = list(self._loggers)
+        self._loggers.clear()
+        for n in names:
+            self._ensure(n)
+
+    def set_logger(self, name: str) -> None:
+        self._active = name
+        self._ensure(name)
+
+    def enable_console(self, enabled: bool) -> None:
+        self._console_enabled = enabled
+        names = list(self._loggers)
+        self._loggers.clear()
+        for n in names:
+            self._ensure(n)
+
+    def __getattr__(self, attr):
+        # Proxy info/warning/error/... to the active logger (ref logger.py:73-84).
+        return getattr(self._ensure(self._active), attr)
+
+
+logger = _Logger()

@@ -1,0 +1,140 @@
+"""Loss parity tests.
+
+Each loss is checked against an independent torch-CPU computation of the
+reference formulas (models/loss.py:8-210). Our arrays are channels-last
+(N, L, C); the reference is channels-first (N, C, L) — the reductions are
+equivalent, which these tests prove numerically.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from seist_tpu.models import losses as L
+
+N, C, SEQ = 4, 3, 64
+EPS = 1e-6
+
+
+@pytest.fixture
+def dense_pair(rng):
+    preds = rng.uniform(0.01, 0.99, size=(N, SEQ, C)).astype(np.float32)
+    targets = rng.uniform(0, 1, size=(N, SEQ, C)).astype(np.float32)
+    return preds, targets
+
+
+def _t(x_channel_last):
+    """channels-last numpy -> channels-first torch."""
+    return torch.from_numpy(np.moveaxis(x_channel_last, -1, 1).copy())
+
+
+def test_ce_loss_matches_reference_formula(dense_pair):
+    preds, targets = dense_pair
+    w = [0.5, 1.0, 2.0]
+    ours = float(L.CELoss(weight=w)(preds, targets))
+    tw = torch.tensor([[0.5], [1.0], [2.0]])
+    ref = (-_t(targets) * torch.log(_t(preds) + EPS) * tw).sum(1).mean()
+    assert ours == pytest.approx(float(ref), rel=1e-4)
+
+
+def test_ce_loss_classes_shape(rng):
+    preds = rng.uniform(0.01, 0.99, size=(N, 2)).astype(np.float32)
+    targets = np.eye(2, dtype=np.float32)[rng.integers(0, 2, N)]
+    ours = float(L.CELoss(weight=[1.0, 1.0])(preds, targets))
+    ref = (
+        (-torch.from_numpy(targets) * torch.log(torch.from_numpy(preds) + EPS))
+        .sum(1)
+        .mean()
+    )
+    assert ours == pytest.approx(float(ref), rel=1e-4)
+
+
+def test_bce_loss_matches_reference_formula(dense_pair):
+    preds, targets = dense_pair
+    w = [0.5, 1.0, 1.0]
+    ours = float(L.BCELoss(weight=w)(preds, targets))
+    tp, tt = _t(preds), _t(targets)
+    tw = torch.tensor([[0.5], [1.0], [1.0]])
+    ref = (
+        -(tt * torch.log(tp + EPS) + (1 - tt) * torch.log(1 - tp + EPS)) * tw
+    ).mean()
+    assert ours == pytest.approx(float(ref), rel=1e-4)
+
+
+def test_focal_loss_matches_reference_formula(rng):
+    logits = rng.normal(size=(N, 2)).astype(np.float32)
+    targets = np.eye(2, dtype=np.float32)[rng.integers(0, 2, N)]
+    ours = float(L.FocalLoss(gamma=2)(logits, targets))
+    tp = torch.softmax(torch.from_numpy(logits), dim=1)
+    tt = torch.from_numpy(targets)
+    ref = (-tt * torch.log(tp + EPS) * (1 - tp) ** 2).sum(1).mean()
+    assert ours == pytest.approx(float(ref), rel=1e-4)
+
+
+def test_binary_focal_loss(dense_pair):
+    preds, targets = dense_pair
+    ours = float(L.BinaryFocalLoss(gamma=2, alpha=1)(preds, targets))
+    tp, tt = _t(preds), _t(targets)
+    ref = (-(1 * (1 - tp) ** 2 * tt * torch.log(tp + EPS))).mean()
+    assert ours == pytest.approx(float(ref), rel=1e-4)
+
+
+def test_mse_loss(dense_pair):
+    preds, targets = dense_pair
+    ours = float(L.MSELoss()(preds, targets))
+    assert ours == pytest.approx(float(((preds - targets) ** 2).mean()), rel=1e-4)
+
+
+def test_huber_loss_matches_torch(rng):
+    preds = rng.normal(size=(N, 1)).astype(np.float32) * 3
+    targets = rng.normal(size=(N, 1)).astype(np.float32) * 3
+    ours = float(L.HuberLoss()(preds, targets))
+    ref = torch.nn.HuberLoss()(torch.from_numpy(preds), torch.from_numpy(targets))
+    assert ours == pytest.approx(float(ref), rel=1e-4)
+
+
+def test_mousavi_loss(rng):
+    preds = rng.normal(size=(N, 2)).astype(np.float32)
+    targets = rng.normal(size=(N, 1)).astype(np.float32)
+    ours = float(L.MousaviLoss()(preds, targets))
+    tp, tt = torch.from_numpy(preds), torch.from_numpy(targets)
+    y_hat, s = tp[:, 0].reshape(-1, 1), tp[:, 1].reshape(-1, 1)
+    ref = torch.sum(0.5 * torch.exp(-s) * torch.square(torch.abs(tt - y_hat)) + 0.5 * s)
+    assert ours == pytest.approx(float(ref), rel=1e-4)
+
+
+def test_combination_loss(rng):
+    p0 = rng.uniform(0.01, 0.99, size=(N, 2)).astype(np.float32)
+    p1 = rng.uniform(0.01, 0.99, size=(N, 2)).astype(np.float32)
+    t0 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, N)]
+    t1 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, N)]
+    comb = L.CombinationLoss(losses=[L.MSELoss, L.MSELoss], losses_weights=[0.3, 0.7])
+    ours = float(comb((p0, p1), (t0, t1)))
+    expected = 0.3 * ((p0 - t0) ** 2).mean() + 0.7 * ((p1 - t1) ** 2).mean()
+    assert ours == pytest.approx(float(expected), rel=1e-4)
+
+
+def test_combination_loss_rejects_single():
+    with pytest.raises(ValueError):
+        L.CombinationLoss(losses=[L.MSELoss])
+
+
+def test_losses_are_jittable(dense_pair):
+    import jax
+
+    preds, targets = dense_pair
+    loss = L.BCELoss(weight=[0.5, 1.0, 1.0])
+    jitted = jax.jit(lambda p, t: loss(p, t))
+    assert float(jitted(preds, targets)) == pytest.approx(
+        float(loss(preds, targets)), rel=1e-6
+    )
+
+
+def test_losses_are_differentiable(dense_pair):
+    import jax
+    import jax.numpy as jnp
+
+    preds, targets = dense_pair
+    loss = L.CELoss(weight=[1.0, 1.0, 1.0])
+    g = jax.grad(lambda p: loss(p, jnp.asarray(targets)))(jnp.asarray(preds))
+    assert np.isfinite(np.asarray(g)).all()

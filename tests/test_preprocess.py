@@ -1,0 +1,386 @@
+"""Preprocessor behavior-parity tests against hand-computed fixtures.
+
+Encodes the quirks checklist from SURVEY.md Appendix A
+(ref: training/preprocess.py:16-821).
+"""
+
+import numpy as np
+import pytest
+
+from seist_tpu.data.preprocess import DataPreprocessor, pad_array, pad_phases
+
+FS = 50
+L_IN = 1024
+
+
+def make_pp(**kw):
+    defaults = dict(
+        data_channels=["z", "n", "e"],
+        sampling_rate=FS,
+        in_samples=L_IN,
+        min_snr=float("-inf"),
+        p_position_ratio=-1.0,
+        coda_ratio=1.4,
+        norm_mode="std",
+        soft_label_shape="gaussian",
+        soft_label_width=20,
+        max_event_num=1,
+    )
+    defaults.update(kw)
+    return DataPreprocessor(**defaults)
+
+
+def make_event(ppks=(100,), spks=(200,), length=L_IN, nch=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "data": rng.normal(size=(nch, length)).astype(np.float64),
+        "ppks": list(ppks),
+        "spks": list(spks),
+        "emg": 3.5,
+        "smg": 3.1,
+        "pmp": [1],
+        "clr": [0],
+        "baz": 123.0,
+        "dis": 42.0,
+        "snr": np.array([10.0, 12.0, 8.0]),
+    }
+
+
+# ----------------------------------------------------------------- pad_phases
+def test_pad_phases_matched_pair_unchanged():
+    assert pad_phases([100], [200], 10, L_IN) == ([100], [200])
+
+
+def test_pad_phases_trailing_p_gets_virtual_s():
+    ppks, spks = pad_phases([100, 300], [200], 10, L_IN)
+    assert ppks == [100, 300]
+    assert spks == [200, L_IN + 10]
+
+
+def test_pad_phases_leading_s_gets_virtual_p():
+    ppks, spks = pad_phases([], [200], 10, L_IN)
+    assert ppks == [-10]
+    assert spks == [200]
+
+
+def test_pad_phases_abs_padding_idx():
+    ppks, spks = pad_phases([], [200], -10, L_IN)
+    assert ppks == [-10]
+
+
+def test_pad_array():
+    out = pad_array([1, 2], 4, -7)
+    np.testing.assert_array_equal(out, [1, 2, -7, -7])
+    with pytest.raises(ValueError):
+        pad_array([1, 2, 3], 2, 0)
+
+
+# ------------------------------------------------------------------ is_noise
+def test_is_noise_rules():
+    pp = make_pp()
+    ev = make_event()
+    assert not pp._is_noise(ev["data"], [100], [200], ev["snr"])
+    assert pp._is_noise(ev["data"], [], [], ev["snr"])  # no phases
+    assert pp._is_noise(ev["data"], [100], [], ev["snr"])  # mismatch
+    assert pp._is_noise(ev["data"], [-1], [200], ev["snr"])  # negative
+    assert pp._is_noise(ev["data"], [100], [L_IN + 5], ev["snr"])  # out of range
+    assert pp._is_noise(ev["data"], [200], [100], ev["snr"])  # P after S
+
+
+def test_min_snr_default_never_marks_noise():
+    # min_snr default -inf => all(snr < min_snr) is never True
+    # (ref: main.py:81-82, preprocess.py:160-167).
+    pp = make_pp()
+    ev = make_event()
+    assert not pp._is_noise(ev["data"], [100], [200], np.array([0.001, 0.001, 0.001]))
+
+
+def test_min_snr_set_marks_noise():
+    pp = make_pp(min_snr=3.0)
+    ev = make_event()
+    assert pp._is_noise(ev["data"], [100], [200], np.array([1.0, 2.0, 2.5]))
+    assert not pp._is_noise(ev["data"], [100], [200], np.array([1.0, 5.0, 2.5]))
+
+
+# ----------------------------------------------------------------- normalize
+def test_normalize_std():
+    pp = make_pp()
+    data = np.random.default_rng(0).normal(3.0, 5.0, size=(3, 256))
+    out = pp._normalize(data.copy(), "std")
+    np.testing.assert_allclose(out.mean(axis=1), 0, atol=1e-9)
+    np.testing.assert_allclose(out.std(axis=1), 1, atol=1e-9)
+
+
+def test_normalize_max_zero_guard():
+    pp = make_pp()
+    data = np.zeros((3, 64))
+    out = pp._normalize(data.copy(), "max")
+    assert np.isfinite(out).all()
+
+
+def test_normalize_empty_mode_only_demeans():
+    pp = make_pp()
+    data = np.random.default_rng(0).normal(3.0, 5.0, size=(3, 64))
+    out = pp._normalize(data.copy(), "")
+    np.testing.assert_allclose(out.mean(axis=1), 0, atol=1e-9)
+    assert out.std() > 1.5  # not scaled
+
+
+# ---------------------------------------------------------------- cut_window
+def test_cut_window_crop_keeps_phases(rng):
+    pp = make_pp()
+    data = np.zeros((3, 4096))
+    ppks, spks = [1000], [1500]
+    out, p2, s2 = pp._cut_window(data, ppks, spks, L_IN, rng)
+    assert out.shape == (3, L_IN)
+    # crop start is random in [0, min(ppks + [L-W]) - gap) => P stays in-window
+    assert len(p2) == 1 and 0 <= p2[0] < L_IN
+
+
+def test_cut_window_pads_short_input(rng):
+    pp = make_pp()
+    data = np.ones((3, 500))
+    out, _, _ = pp._cut_window(data, [100], [200], L_IN, rng)
+    assert out.shape == (3, L_IN)
+    np.testing.assert_array_equal(out[:, 500:], 0)
+
+
+def test_cut_window_p_position_ratio_pins_p(rng):
+    pp = make_pp(p_position_ratio=0.25)
+    data = np.random.default_rng(0).normal(size=(3, 4096))
+    ppk = 2000
+    out, p2, s2 = pp._cut_window(data, [ppk], [2100], L_IN, rng)
+    assert out.shape == (3, L_IN)
+    assert p2 == [int(L_IN * 0.25)]
+    assert s2 == [int(L_IN * 0.25) + 100]
+
+
+def test_p_position_ratio_disables_augments():
+    pp = make_pp(
+        p_position_ratio=0.5,
+        add_event_rate=0.5,
+        shift_event_rate=0.5,
+        generate_noise_rate=0.5,
+    )
+    assert pp.add_event_rate == 0.0
+    assert pp.shift_event_rate == 0.0
+    assert pp.generate_noise_rate == 0.0
+
+
+# ---------------------------------------------------------------- soft labels
+def test_gaussian_soft_label_sigma_is_fixed_10():
+    # The gaussian sigma ignores label_width (ref quirk: preprocess.py:576-578).
+    pp = make_pp(soft_label_width=40)
+    ev = make_event(ppks=[500], spks=[700])
+    label = pp._generate_soft_label("ppk", ev)
+    assert label.shape == (L_IN,)
+    assert label[500] == pytest.approx(1.0)
+    assert label[490] == pytest.approx(np.exp(-(10**2) / (2 * 10**2)), rel=1e-5)
+    assert label[479] == 0.0  # outside window extent (width 40 => left 20)
+    assert label[521] == 0.0
+
+
+def test_soft_label_left_edge():
+    pp = make_pp(soft_label_width=20)
+    ev = make_event(ppks=[5], spks=[700])
+    label = pp._generate_soft_label("ppk", ev)
+    # idx-left < 0 branch: window right-aligned at idx+right+1
+    assert label[5] == pytest.approx(1.0)
+    assert label[0] == pytest.approx(np.exp(-(5**2) / 200), rel=1e-5)
+
+
+def test_soft_label_right_edge():
+    pp = make_pp(soft_label_width=20)
+    ev = make_event(ppks=[L_IN - 5], spks=[L_IN - 2])
+    label = pp._generate_soft_label("ppk", ev)
+    assert label[L_IN - 5] == pytest.approx(1.0)
+    assert label[L_IN - 1] == pytest.approx(np.exp(-(4**2) / 200), rel=1e-5)
+
+
+def test_triangle_box_sigmoid_shapes():
+    for shape in ["triangle", "box", "sigmoid"]:
+        pp = make_pp(soft_label_shape=shape)
+        ev = make_event(ppks=[500], spks=[700])
+        label = pp._generate_soft_label("ppk", ev)
+        assert label.max() == pytest.approx(1.0)
+        assert label.min() >= 0.0
+
+
+def test_unknown_label_shape_raises():
+    pp = make_pp(soft_label_shape="bogus")
+    ev = make_event()
+    with pytest.raises(NotImplementedError):
+        pp._generate_soft_label("ppk", ev)
+
+
+def test_non_label_is_one_minus_p_minus_s_clipped():
+    pp = make_pp()
+    ev = make_event(ppks=[500], spks=[520])
+    non = pp._generate_soft_label("non", ev)
+    p = pp._generate_soft_label("ppk", ev)
+    s = pp._generate_soft_label("spk", ev)
+    expected = np.clip(1.0 - p - s, 0, None)
+    np.testing.assert_allclose(non, expected, atol=1e-6)
+
+
+def test_det_label_box_with_coda():
+    pp = make_pp()
+    ev = make_event(ppks=[100], spks=[200])
+    det = pp._generate_soft_label("det", ev)
+    # box spans [ppk, spk + 1.4*(spk-ppk)) = [100, 340)
+    assert det[100] == 1.0
+    assert det[339] == 1.0
+    assert det[120] == 1.0
+    assert det[341] < 1.0  # soft tail
+    assert det[50] == 0.0
+    assert det.max() == 1.0
+
+
+def test_det_label_unmatched_s_uses_padded_virtual_p():
+    # 'det' uses phase lists padded with soft_label_width (preprocess.py:621-626)
+    pp = make_pp(soft_label_width=20)
+    ev = make_event(ppks=[], spks=[200])
+    det = pp._generate_soft_label("det", ev)
+    # virtual P at -20 => box [0(clipped), 200+1.4*220=508)
+    assert det[0] == 1.0
+    assert det[507] == 1.0
+
+
+def test_ppk_plus_label_steps_to_one():
+    pp = make_pp()
+    ev = make_event(ppks=[500], spks=[700])
+    lab = pp._generate_soft_label("ppk+", ev)
+    assert lab[499] < 1.0
+    np.testing.assert_allclose(lab[500:], 1.0, atol=1e-6)
+
+
+def test_waveform_and_diff_items():
+    pp = make_pp()
+    ev = make_event()
+    z = pp._generate_soft_label("z", ev)
+    np.testing.assert_allclose(z, ev["data"][0].astype(np.float32))
+    dz = pp._generate_soft_label("dz", ev)
+    assert dz[0] == 0.0
+    np.testing.assert_allclose(
+        dz[1:], np.diff(ev["data"][0]).astype(np.float32), atol=1e-6
+    )
+
+
+# ---------------------------------------------------------------- io assembly
+def test_grouped_io_item_is_channels_last():
+    pp = make_pp()
+    ev = make_event()
+    item = pp.get_io_item(("z", "n", "e"), ev)
+    assert item.shape == (L_IN, 3)
+    np.testing.assert_allclose(item[:, 1], ev["data"][1].astype(np.float32))
+
+
+def test_onehot_item():
+    pp = make_pp()
+    ev = make_event()
+    pmp = pp.get_io_item("pmp", ev)
+    np.testing.assert_array_equal(pmp, [0, 1])
+    assert pmp.dtype == np.int64
+
+
+def test_value_item():
+    pp = make_pp()
+    ev = make_event()
+    assert pp.get_io_item("emg", ev) == pytest.approx(3.5)
+
+
+# ------------------------------------------------------------ metrics targets
+def test_metrics_targets_ppk_padding():
+    pp = make_pp()
+    ev = make_event(ppks=[100], spks=[200])
+    t = pp.get_targets_for_metrics(ev, max_event_num=3, task_names=["ppk", "spk"])
+    np.testing.assert_array_equal(t["ppk"], [100, int(-1e7), int(-1e7)])
+    assert t["ppk"].dtype == np.int64
+
+
+def test_metrics_targets_det_expected_num():
+    pp = make_pp(add_event_rate=0.5, shift_event_rate=0.0, max_event_num=1)
+    ev = make_event(ppks=[100], spks=[200])
+    t = pp.get_targets_for_metrics(ev, max_event_num=1, task_names=["det"])
+    # expected_num = 1 + 1(add_event) + 0 + 0 = 2 pairs, padded with [1, 0]
+    assert pp.expected_det_num() == 2
+    np.testing.assert_array_equal(t["det"], [100, 340, 1, 0])
+
+
+def test_process_noise_event_cleared(rng):
+    pp = make_pp()
+    ev = make_event(ppks=[200], spks=[100])  # P after S => noise
+    out = pp.process(ev, augmentation=False, rng=rng)
+    # phases cleared then padded to empty lists
+    assert out["ppks"] == [] and out["spks"] == []
+    assert out["data"].shape == (3, L_IN)
+
+
+def test_process_normalizes(rng):
+    pp = make_pp()
+    ev = make_event(length=2048)
+    out = pp.process(ev, augmentation=False, rng=rng)
+    np.testing.assert_allclose(out["data"].mean(axis=1), 0, atol=1e-7)
+    np.testing.assert_allclose(out["data"].std(axis=1), 1, atol=1e-6)
+
+
+# --------------------------------------------------------------- augmentation
+def test_augmentation_preserves_shapes(rng):
+    pp = make_pp(
+        add_event_rate=1.0,
+        add_noise_rate=1.0,
+        add_gap_rate=1.0,
+        drop_channel_rate=1.0,
+        scale_amplitude_rate=1.0,
+        pre_emphasis_rate=1.0,
+        shift_event_rate=1.0,
+        max_event_num=2,
+    )
+    ev = make_event(length=4096, ppks=[1000], spks=[1200])
+    out = pp.process(ev, augmentation=True, rng=rng)
+    assert out["data"].shape == (3, L_IN)
+    assert len(out["ppks"]) == len(out["spks"])
+
+
+def test_generate_noise_clears_labels(rng):
+    pp = make_pp(generate_noise_rate=1.0)
+    ev = make_event(length=2048)
+    out = pp.process(ev, augmentation=True, rng=rng)
+    assert out["ppks"] == [] and out["spks"] == []
+    assert out["emg"] == 0
+
+
+def test_shift_event_rolls_phases():
+    pp = make_pp()
+    rng = np.random.default_rng(42)
+    data = np.arange(3 * 100, dtype=np.float64).reshape(3, 100)
+    d2, p2, s2 = pp._shift_event(data.copy(), [10], [20], rng)
+    shift = int(np.where(d2[0] == 0)[0][0])
+    assert p2 == [(10 + shift) % 100]
+    assert s2 == [(20 + shift) % 100]
+
+
+def test_drop_channel_keeps_at_least_one():
+    pp = make_pp()
+    for seed in range(10):
+        data = np.ones((3, 64))
+        out = pp._drop_channel(data, np.random.default_rng(seed))
+        zeroed = int((np.abs(out).max(axis=1) == 0).sum())
+        assert 1 <= zeroed <= 2
+
+
+def test_pre_emphasis_formula():
+    pp = make_pp()
+    data = np.random.default_rng(0).normal(size=(2, 32))
+    orig = data.copy()
+    out = pp._pre_emphasis(data, 0.97)
+    np.testing.assert_allclose(out[:, 0], orig[:, 0])
+    np.testing.assert_allclose(out[:, 1:], orig[:, 1:] - 0.97 * orig[:, :-1])
+
+
+def test_add_event_appends_sorted(rng):
+    pp = make_pp(max_event_num=3)
+    data = np.random.default_rng(1).normal(size=(3, 4096))
+    d2, p2, s2 = pp._add_event(data, [100], [200], 0, rng)
+    assert len(p2) == 2 and p2 == sorted(p2)
+    assert s2[1] - p2[1] == 100  # same P-S gap
