@@ -1,0 +1,81 @@
+"""BAZ network — back-azimuth from single-station waveforms (channels-last).
+
+Architecture parity with the reference ``models/baz_network.py:17-121``
+(Mousavi & Beroza 2020): conv stack + covariance/eigen feature branch ->
+(cos, sin) outputs trained with dual MSE.
+
+TPU note: the reference uses ``torch.linalg.eig`` on the (symmetric)
+covariance under no_grad (baz_network.py:79-86). General eig is not lowered
+on TPU; the covariance is symmetric so ``jnp.linalg.eigh`` is exact, real,
+and TPU-native — we use it under ``stop_gradient``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from seist_tpu.models import common
+from seist_tpu.registry import register_model
+
+Array = jnp.ndarray
+
+
+def _cov_features(x: Array) -> Array:
+    """Covariance + eigen features, (N, L, C) -> (N, 2C+1, C)
+    (ref: baz_network.py:67-101, transposed for channels-last)."""
+    N, L, C = x.shape
+    diff = x - x.mean(axis=1, keepdims=True)
+    cov = jnp.einsum("nlc,nld->ncd", diff, diff) / (L - 1)
+    eig_values, eig_vectors = jnp.linalg.eigh(cov)
+    eig_values = eig_values[..., None]  # (N, C, 1)
+    eig_values = eig_values / jnp.max(eig_values, axis=(-2, -1), keepdims=True)
+    cov = cov / jnp.max(jnp.abs(cov), axis=(-2, -1), keepdims=True)
+    feat = jnp.concatenate([cov, eig_values, eig_vectors], axis=-1)  # (N, C, 2C+1)
+    return jax.lax.stop_gradient(jnp.swapaxes(feat, -1, -2))  # (N, 2C+1, C)
+
+
+class BAZNetwork(nn.Module):
+    """(N, L, C) -> ((N, 1) cos, (N, 1) sin) (ref: baz_network.py:17-121)."""
+
+    in_channels: int = 3
+    conv_channels: Sequence[int] = (20, 32, 64, 20)
+    kernel_size: int = 3
+    pool_size: int = 2
+    lin_hidden_dim: int = 100
+    drop_rate: float = 0.3
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Tuple[Array, Array]:
+        x1 = _cov_features(x)
+
+        p = (self.kernel_size - 1) // 2
+        for i, outc in enumerate(self.conv_channels):
+            x = nn.Conv(
+                outc, (self.kernel_size,), padding=[(p, p)], name=f"wave_conv{i}"
+            )(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+            x = common.max_pool_1d_ceil(x, self.pool_size)
+        x = x.reshape(x.shape[0], -1)
+
+        x1 = nn.Dense(self.conv_channels[-1], name="conv1")(x1)  # 1x1 conv
+        x1 = nn.relu(x1)
+        x1 = x1.reshape(x1.shape[0], -1)
+
+        x = jnp.concatenate([x, x1], axis=-1)
+        x = nn.Dense(self.lin_hidden_dim, name="lin0")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+        x = nn.Dense(2, name="lin1")(x)
+        return x[:, :1], x[:, 1:]
+
+
+@register_model
+def baz_network(**kwargs) -> BAZNetwork:
+    kwargs.pop("in_samples", None)
+    kwargs = {k: v for k, v in kwargs.items() if k in BAZNetwork.__dataclass_fields__}
+    return BAZNetwork(**kwargs)

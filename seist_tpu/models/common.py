@@ -1,0 +1,254 @@
+"""Shared building blocks for the model zoo (channels-last, Flax linen).
+
+Geometry parity helpers mirror the reference exactly (a stated hard part,
+SURVEY.md §7): ``auto_pad_1d`` reproduces ``models/seist.py:12-48`` /
+``magnet.py:16-33``; ceil-mode pooling reproduces torch's
+``MaxPool1d/AvgPool1d(ceil_mode=True)`` including the partial-window divisor
+of AvgPool; ``interpolate_linear`` reproduces ``F.interpolate(mode='linear',
+align_corners=False)``.
+
+All arrays are ``(N, L, C)``. All modules take ``train: bool`` and use the
+'dropout' RNG stream for dropout and stochastic depth.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Array = jnp.ndarray
+
+# Default init mirroring the SeisT reference (trunc normal 0.02,
+# seist.py:816-831). Other models use flax defaults (init distribution is not
+# a behavior-parity surface).
+trunc_normal_init = nn.initializers.truncated_normal(stddev=0.02)
+
+
+# --------------------------------------------------------------------- padding
+def auto_pad_amount(length: int, kernel_size: int, stride: int = 1) -> Tuple[int, int]:
+    """'same'-style asymmetric padding so L_out = ceil(L/stride)
+    (ref: seist.py:41-47)."""
+    assert kernel_size >= stride, (
+        f"`kernel_size` must be >= `stride`, got {kernel_size}, {stride}"
+    )
+    pds = (stride - (length % stride)) % stride + kernel_size - stride
+    return pds // 2, pds - pds // 2
+
+
+def auto_pad_1d(
+    x: Array, kernel_size: int, stride: int = 1, padding_value: float = 0.0
+) -> Array:
+    """Pad the length axis (-2) of an (N, L, C) array (ref: seist.py:12-48)."""
+    lp, rp = auto_pad_amount(x.shape[-2], kernel_size, stride)
+    pads = [(0, 0)] * x.ndim
+    pads[-2] = (lp, rp)
+    return jnp.pad(x, pads, constant_values=padding_value)
+
+
+def same_pad_amount(kernel_size: int) -> Tuple[int, int]:
+    """torch-style static 'same' padding for stride-1 convs
+    (ref: phasenet.py:45-48)."""
+    return (kernel_size - 1) // 2, kernel_size - 1 - (kernel_size - 1) // 2
+
+
+def same_pad_1d(x: Array, kernel_size: int, padding_value: float = 0.0) -> Array:
+    lp, rp = same_pad_amount(kernel_size)
+    pads = [(0, 0)] * x.ndim
+    pads[-2] = (lp, rp)
+    return jnp.pad(x, pads, constant_values=padding_value)
+
+
+def causal_pad_1d(x: Array, kernel_size: int, dilation: int = 1) -> Array:
+    """Left-only padding for causal TCNs (ref: distpt_network.py:17-34)."""
+    pds = (kernel_size - 1) * dilation
+    pads = [(0, 0)] * x.ndim
+    pads[-2] = (pds, 0)
+    return jnp.pad(x, pads)
+
+
+# --------------------------------------------------------------------- pooling
+def ceil_len(length: int, stride: int) -> int:
+    return -(-length // stride)
+
+
+def max_pool_1d_ceil(x: Array, kernel_size: int) -> Array:
+    """MaxPool1d(k, ceil_mode=True) parity: stride=k, right-pad with -inf."""
+    L = x.shape[-2]
+    pad_r = ceil_len(L, kernel_size) * kernel_size - L
+    return jax.lax.reduce_window(
+        x,
+        -jnp.inf,
+        jax.lax.max,
+        window_dimensions=(1, kernel_size, 1),
+        window_strides=(1, kernel_size, 1),
+        padding=((0, 0), (0, pad_r), (0, 0)),
+    )
+
+
+def avg_pool_1d_ceil(x: Array, kernel_size: int) -> Array:
+    """AvgPool1d(k, ceil_mode=True) parity: the partial last window divides by
+    the count of *valid* elements (verified against torch)."""
+    L = x.shape[-2]
+    pad_r = ceil_len(L, kernel_size) * kernel_size - L
+    sums = jax.lax.reduce_window(
+        x,
+        0.0,
+        jax.lax.add,
+        window_dimensions=(1, kernel_size, 1),
+        window_strides=(1, kernel_size, 1),
+        padding=((0, 0), (0, pad_r), (0, 0)),
+    )
+    # Valid-count divisor per output position (static, computed in Python).
+    n_out = ceil_len(L, kernel_size)
+    counts = jnp.full((n_out,), float(kernel_size))
+    last_valid = L - (n_out - 1) * kernel_size
+    counts = counts.at[-1].set(float(last_valid))
+    return sums / counts[None, :, None]
+
+
+def max_pool_1d(x: Array, kernel_size: int) -> Array:
+    """MaxPool1d(k) floor-mode parity (drops the trailing partial window)."""
+    L = x.shape[-2]
+    n_out = L // kernel_size
+    return jax.lax.reduce_window(
+        x[:, : n_out * kernel_size],
+        -jnp.inf,
+        jax.lax.max,
+        window_dimensions=(1, kernel_size, 1),
+        window_strides=(1, kernel_size, 1),
+        padding="VALID",
+    )
+
+
+def global_avg_pool(x: Array) -> Array:
+    """AdaptiveAvgPool1d(1) + flatten: (N, L, C) -> (N, C)."""
+    return x.mean(axis=-2)
+
+
+# ---------------------------------------------------------------- interpolate
+def interpolate_linear(x: Array, out_size: int) -> Array:
+    """F.interpolate(mode='linear', align_corners=False) parity for (N, L, C).
+
+    src = (dst + 0.5) * L_in/L_out - 0.5, clamped; linear blend of the two
+    nearest source samples (ref usage: seist.py:566, ditingmotion nearest uses
+    interpolate_nearest below).
+    """
+    L_in = x.shape[-2]
+    if L_in == out_size:
+        return x
+    scale = L_in / out_size
+    dst = jnp.arange(out_size, dtype=jnp.float32)
+    src = (dst + 0.5) * scale - 0.5
+    src = jnp.clip(src, 0.0, L_in - 1)
+    lo = jnp.floor(src).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, L_in - 1)
+    w = (src - lo.astype(jnp.float32))[None, :, None]
+    return x[:, lo, :] * (1.0 - w) + x[:, hi, :] * w
+
+
+def interpolate_nearest(x: Array, out_size: int) -> Array:
+    """F.interpolate(mode='nearest') parity for (N, L, C)."""
+    L_in = x.shape[-2]
+    if L_in == out_size:
+        return x
+    idx = jnp.floor(jnp.arange(out_size, dtype=jnp.float32) * (L_in / out_size))
+    return x[:, idx.astype(jnp.int32), :]
+
+
+def upsample_x2(x: Array) -> Array:
+    """nn.Upsample(scale_factor=2) (nearest) parity (ref: eqtransformer.py:384)."""
+    return jnp.repeat(x, 2, axis=-2)
+
+
+# --------------------------------------------------------------------- helpers
+def make_divisible(v: int, divisor: int) -> int:
+    """Channel rounding (ref: seist.py:51-60)."""
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+# --------------------------------------------------------------------- modules
+class DropPath(nn.Module):
+    """Per-sample stochastic depth (timm DropPath parity, scale_by_keep)."""
+
+    rate: float
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        if not train or self.rate <= 0.0:
+            return x
+        keep = 1.0 - self.rate
+        rng = self.make_rng("dropout")
+        shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class ScaledActivation(nn.Module):
+    """activation(x) * scale (ref: seist.py:63-70); bounds regression heads."""
+
+    act: Callable[[Array], Array]
+    scale_factor: float
+
+    def __call__(self, x: Array) -> Array:
+        return self.act(x) * self.scale_factor
+
+
+def make_norm(
+    norm: str, *, use_running_average: bool, name: Optional[str] = None
+) -> nn.Module:
+    """Normalization factory. 'batch' matches torch BatchNorm1d defaults
+    (momentum 0.1 -> flax momentum 0.9, eps 1e-5). Under global-view jit with
+    a batch-sharded mesh the batch statistics are computed over the *global*
+    batch, which is exactly the reference's SyncBatchNorm semantics
+    (train.py:374) with zero extra code.
+    """
+    if norm == "batch":
+        return nn.BatchNorm(
+            use_running_average=use_running_average,
+            momentum=0.9,
+            epsilon=1e-5,
+            name=name,
+        )
+    if norm == "layer":
+        return nn.LayerNorm(name=name)
+    if norm == "group":
+        return nn.GroupNorm(num_groups=8, name=name)
+    raise NotImplementedError(f"Unknown norm '{norm}'")
+
+
+class LSTM(nn.Module):
+    """Unidirectional LSTM over (N, L, C) returning (outputs, final_h).
+
+    torch ``nn.LSTM`` parity at the architecture level; the recurrence is a
+    ``lax.scan`` per flax nn.RNN (SURVEY.md §7 'LSTM baselines on TPU').
+    """
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, Array]:
+        cell = nn.OptimizedLSTMCell(features=self.hidden)
+        carry, outputs = nn.RNN(cell, return_carry=True)(x)
+        # carry = (c, h) for OptimizedLSTMCell
+        return outputs, carry[1]
+
+
+class BiLSTM(nn.Module):
+    """Bidirectional LSTM over (N, L, C); returns (outputs_2H, final_h_2H)."""
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, Array]:
+        fwd_out, fwd_h = LSTM(self.hidden, name="fwd")(x)
+        bwd_out, bwd_h = LSTM(self.hidden, name="bwd")(x[:, ::-1, :])
+        outputs = jnp.concatenate([fwd_out, bwd_out[:, ::-1, :]], axis=-1)
+        final = jnp.concatenate([fwd_h, bwd_h], axis=-1)
+        return outputs, final

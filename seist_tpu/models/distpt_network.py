@@ -1,0 +1,111 @@
+"""dist-PT network — causal dilated TCN for distance + P-travel-time.
+
+Architecture parity with the reference ``models/distpt_network.py:37-181``
+(Mousavi & Beroza 2020). Registered but config-disabled in the reference
+(config.py:112-125) because DiTing lacks travel-time labels; kept here for
+API-surface parity.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from seist_tpu.models import common
+from seist_tpu.registry import register_model
+
+Array = jnp.ndarray
+
+
+class ResBlock(nn.Module):
+    """Two causal dilated convs + 1x1 residual (ref: distpt_network.py:37-87).
+    Returns (residual_out, pre_residual)."""
+
+    out_channels: int
+    kernel_size: int
+    dilation: int
+    drop_rate: float
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Tuple[Array, Array]:
+        for i in range(2):
+            x = common.causal_pad_1d(x, self.kernel_size, self.dilation)
+            x = nn.Conv(
+                self.out_channels,
+                (self.kernel_size,),
+                kernel_dilation=(self.dilation,),
+                padding="VALID",
+                name=f"conv{i}",
+            )(x)
+            x = common.make_norm("batch", use_running_average=not train, name=f"bn{i}")(x)
+            x = nn.relu(x)
+            # Dropout1d: drop whole channels (broadcast over the L axis)
+            x = nn.Dropout(
+                self.drop_rate, broadcast_dims=(1,), deterministic=not train
+            )(x)
+        x1 = x + nn.Dense(self.out_channels, name="conv_out")(x)
+        return x1, x
+
+
+class TemporalConvLayer(nn.Module):
+    """1x1 in-proj + dilated ResBlocks, summed skip connections
+    (ref: distpt_network.py:90-134)."""
+
+    out_channels: int = 64
+    kernel_size: int = 2
+    num_conv_blocks: int = 1
+    dilations: Sequence[int] = (1, 2, 4, 8, 16, 32)
+    drop_rate: float = 0.0
+    return_sequences: bool = False
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        x = nn.Dense(self.out_channels, name="conv_in")(x)
+        shortcuts = []
+        for b, dilation in enumerate(list(self.dilations) * self.num_conv_blocks):
+            x, sc = ResBlock(
+                out_channels=self.out_channels,
+                kernel_size=self.kernel_size,
+                dilation=dilation,
+                drop_rate=self.drop_rate,
+                name=f"block{b}",
+            )(x, train)
+            shortcuts.append(sc)
+        x = sum(shortcuts)
+        if not self.return_sequences:
+            x = x[:, -1, :]
+        return x
+
+
+class DistPTNetwork(nn.Module):
+    """(N, L, C) -> ((N, 2) dist, (N, 2) p-travel) (ref: distpt_network.py:137-181)."""
+
+    in_channels: int = 3
+    tcn_channels: int = 20
+    kernel_size: int = 6
+    num_conv_blocks: int = 1
+    dilations: Sequence[int] = tuple(2**i for i in range(11))
+    drop_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Tuple[Array, Array]:
+        x = TemporalConvLayer(
+            out_channels=self.tcn_channels,
+            kernel_size=self.kernel_size,
+            num_conv_blocks=self.num_conv_blocks,
+            dilations=self.dilations,
+            drop_rate=self.drop_rate,
+            name="tcn",
+        )(x, train)
+        do = nn.Dense(2, name="lin_dist")(x)
+        po = nn.Dense(2, name="lin_ptrvl")(x)
+        return do, po
+
+
+@register_model
+def distpt_network(**kwargs) -> DistPTNetwork:
+    kwargs.pop("in_samples", None)
+    kwargs = {k: v for k, v in kwargs.items() if k in DistPTNetwork.__dataclass_fields__}
+    return DistPTNetwork(**kwargs)

@@ -1,0 +1,200 @@
+"""DiTingMotion — dense multi-branch CNN with side-output fusion.
+
+Architecture parity with the reference ``models/ditingmotion.py:38-341``
+(Zhao et al. 2023): CombConv layers with dense concats, per-block side
+layers for clarity/polarity, sigmoid fuse heads, final output = average of
+all side outputs + fuse output.
+
+Input is ``(N, L, 2)``: vertical channel + its first difference
+(io-items ["z", "dz"], config.py:129).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from seist_tpu.models import common
+from seist_tpu.registry import register_model
+
+Array = jnp.ndarray
+
+
+class CombConvLayer(nn.Module):
+    """Parallel convs at several kernel sizes, dense concat with the input,
+    then an out conv (ref: ditingmotion.py:38-80)."""
+
+    out_channels: int
+    kernel_sizes: Sequence[int]
+    out_kernel_size: int
+    drop_rate: float
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        outs = [x]
+        for i, kers in enumerate(self.kernel_sizes):
+            xi = common.auto_pad_1d(x, kers)
+            xi = nn.Conv(self.out_channels, (kers,), padding="VALID", name=f"conv{i}")(xi)
+            outs.append(nn.relu(xi))
+        x = jnp.concatenate(outs, axis=-1)
+        x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+        x = common.auto_pad_1d(x, self.out_kernel_size)
+        x = nn.Conv(
+            self.out_channels, (self.out_kernel_size,), padding="VALID", name="out_conv"
+        )(x)
+        return nn.relu(x)
+
+
+class BasicBlock(nn.Module):
+    """CombConv stack + dense concat + floor-mode maxpool
+    (ref: ditingmotion.py:83-116)."""
+
+    layer_channels: Sequence[int]
+    comb_kernel_sizes: Sequence[int]
+    comb_out_kernel_size: int
+    drop_rate: float
+    pool_size: int
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        x1 = x
+        for i, outc in enumerate(self.layer_channels):
+            x1 = CombConvLayer(
+                out_channels=outc,
+                kernel_sizes=self.comb_kernel_sizes,
+                out_kernel_size=self.comb_out_kernel_size,
+                drop_rate=self.drop_rate,
+                name=f"comb{i}",
+            )(x1, train)
+        x1 = jnp.concatenate([x, x1], axis=-1)
+        return common.max_pool_1d(x1, self.pool_size)
+
+
+class SideLayer(nn.Module):
+    """CombConv + flatten + 2-layer MLP with sigmoid
+    (ref: ditingmotion.py:119-171). Returns (features, hidden, probs)."""
+
+    conv_out_channels: int
+    comb_kernel_sizes: Sequence[int]
+    comb_out_kernel_size: int
+    drop_rate: float
+    linear_in_dim: int
+    linear_hidden_dim: int
+    linear_out_dim: int
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Tuple[Array, Array, Array]:
+        x = CombConvLayer(
+            out_channels=self.conv_out_channels,
+            kernel_sizes=self.comb_kernel_sizes,
+            out_kernel_size=self.comb_out_kernel_size,
+            drop_rate=self.drop_rate,
+            name="conv_layer",
+        )(x, train)
+        N, L, C = x.shape
+        if C * L != self.linear_in_dim:
+            # Official model is fixed to L=128 inputs; interpolate to adapt
+            # (ref: ditingmotion.py:157-161).
+            target = self.linear_in_dim // self.conv_out_channels
+            x = common.interpolate_nearest(x, target)
+        x1 = x.reshape(N, -1)
+        x2 = nn.relu(nn.Dense(self.linear_hidden_dim, name="lin0")(x1))
+        x3 = nn.sigmoid(nn.Dense(self.linear_out_dim, name="lin1")(x2))
+        return x1, x2, x3
+
+
+class DiTingMotion(nn.Module):
+    """(N, L, 2) -> ((N, 2) clarity, (N, 2) polarity)
+    (ref: ditingmotion.py:174-335)."""
+
+    in_channels: int = 2
+    blocks_layer_channels: Sequence[Sequence[int]] = (
+        (8, 8),
+        (8, 8),
+        (8, 8, 8),
+        (8, 8, 8),
+        (8, 8, 8),
+    )
+    side_layer_conv_channels: int = 2
+    blocks_sidelayer_linear_in_dims: Sequence[Optional[int]] = (None, None, 32, 16, 16)
+    blocks_sidelayer_linear_hidden_dims: Sequence[Optional[int]] = (None, None, 8, 8, 8)
+    comb_kernel_sizes: Sequence[int] = (3, 3, 5, 5)
+    comb_out_kernel_size: int = 3
+    pool_size: int = 2
+    drop_rate: float = 0.2
+    fuse_hidden_dim: int = 8
+    num_polarity_classes: int = 2
+    num_clarity_classes: int = 2
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Tuple[Array, Array]:
+        clarity_to_fuse: List[Array] = []
+        polarity_to_fuse: List[Array] = []
+        clarity_outs: List[Array] = []
+        polarity_outs: List[Array] = []
+
+        for b, (layer_channels, lin_in, lin_hidden) in enumerate(
+            zip(
+                self.blocks_layer_channels,
+                self.blocks_sidelayer_linear_in_dims,
+                self.blocks_sidelayer_linear_hidden_dims,
+            )
+        ):
+            x = BasicBlock(
+                layer_channels=layer_channels,
+                comb_kernel_sizes=self.comb_kernel_sizes,
+                comb_out_kernel_size=self.comb_out_kernel_size,
+                drop_rate=self.drop_rate,
+                pool_size=self.pool_size,
+                name=f"block{b}",
+            )(x, train)
+
+            if lin_in is not None:
+                c0, _, c2 = SideLayer(
+                    conv_out_channels=self.side_layer_conv_channels,
+                    comb_kernel_sizes=self.comb_kernel_sizes,
+                    comb_out_kernel_size=self.comb_out_kernel_size,
+                    drop_rate=self.drop_rate,
+                    linear_in_dim=lin_in,
+                    linear_hidden_dim=lin_hidden,
+                    linear_out_dim=self.num_clarity_classes,
+                    name=f"clarity_side{b}",
+                )(x, train)
+                clarity_to_fuse.append(c0)
+                clarity_outs.append(c2)
+
+                _, p1, p2 = SideLayer(
+                    conv_out_channels=self.side_layer_conv_channels,
+                    comb_kernel_sizes=self.comb_kernel_sizes,
+                    comb_out_kernel_size=self.comb_out_kernel_size,
+                    drop_rate=self.drop_rate,
+                    linear_in_dim=lin_in,
+                    linear_hidden_dim=lin_hidden,
+                    linear_out_dim=self.num_polarity_classes,
+                    name=f"polarity_side{b}",
+                )(x, train)
+                polarity_to_fuse.append(p1)
+                polarity_outs.append(p2)
+
+        c = jnp.concatenate(clarity_to_fuse, axis=-1)
+        c = nn.Dense(self.fuse_hidden_dim, name="fuse_clarity0")(c)
+        c = nn.Dense(self.num_clarity_classes, name="fuse_clarity1")(c)
+        clarity_outs.append(nn.sigmoid(c))
+
+        p = jnp.concatenate(polarity_to_fuse, axis=-1)
+        p = nn.Dense(self.fuse_hidden_dim, name="fuse_polarity0")(p)
+        p = nn.Dense(self.num_polarity_classes, name="fuse_polarity1")(p)
+        polarity_outs.append(nn.sigmoid(p))
+
+        final_clarity = sum(clarity_outs) / len(clarity_outs)
+        final_polarity = sum(polarity_outs) / len(polarity_outs)
+        return final_clarity, final_polarity
+
+
+@register_model
+def ditingmotion(**kwargs) -> DiTingMotion:
+    kwargs.pop("in_samples", None)
+    kwargs = {k: v for k, v in kwargs.items() if k in DiTingMotion.__dataclass_fields__}
+    return DiTingMotion(**kwargs)

@@ -1,0 +1,312 @@
+"""EQTransformer — conv + ResCNN + BiLSTM + transformer encoder with three
+upsampling decoders (det / P / S).
+
+Architecture parity with the reference ``models/eqtransformer.py:18-614``
+(Mousavi et al. 2020). Channels-last Flax. Notes:
+
+* The reference's L1 regularization of first-stage conv weights is
+  implemented via grad hooks (eqtransformer.py:43-51,388-396); here it is a
+  training-side optax gradient transform (seist_tpu/train/schedule.py:
+  ``l1_sign_decay``) scoped to the first conv stage — the constructor alphas
+  default to 0.0 in both frameworks.
+* The additive single-head attention with optional banded mask reproduces
+  ``AttentionLayer`` (eqtransformer.py:135-198) including the
+  exp/max-shift/eps-sum softmax.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from seist_tpu.models import common
+from seist_tpu.registry import register_model
+
+Array = jnp.ndarray
+
+_EPS = 1e-6
+
+
+class ConvBlock(nn.Module):
+    """same conv -> relu -> odd-length pad -> maxpool/2
+    (ref: eqtransformer.py:18-59)."""
+
+    out_channels: int
+    kernel_size: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = common.same_pad_1d(x, self.kernel_size)
+        x = nn.Conv(self.out_channels, (self.kernel_size,), padding="VALID", name="conv")(x)
+        x = nn.relu(x)
+        if x.shape[-2] % 2:
+            pads = [(0, 0)] * x.ndim
+            pads[-2] = (0, 1)
+            x = jnp.pad(x, pads, constant_values=-1.0 / _EPS)
+        return common.max_pool_1d(x, 2)
+
+
+class ResConvBlock(nn.Module):
+    """Pre-norm residual conv pair with channel dropout
+    (ref: eqtransformer.py:62-102)."""
+
+    kernel_size: int
+    drop_rate: float
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        C = x.shape[-1]
+        x1 = x
+        for i in range(2):
+            x1 = common.make_norm("batch", use_running_average=not train, name=f"bn{i}")(x1)
+            x1 = nn.relu(x1)
+            x1 = nn.Dropout(
+                self.drop_rate, broadcast_dims=(1,), deterministic=not train
+            )(x1)
+            x1 = common.same_pad_1d(x1, self.kernel_size)
+            x1 = nn.Conv(C, (self.kernel_size,), padding="VALID", name=f"conv{i}")(x1)
+        return x + x1
+
+
+class BiLSTMBlock(nn.Module):
+    """BiLSTM -> dropout -> 1x1 conv -> BN (ref: eqtransformer.py:105-132)."""
+
+    out_channels: int
+    drop_rate: float
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        x, _ = common.BiLSTM(self.out_channels, name="bilstm")(x)
+        x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+        x = nn.Dense(self.out_channels, name="conv")(x)
+        x = common.make_norm("batch", use_running_average=not train, name="bn")(x)
+        return x
+
+
+class AttentionLayer(nn.Module):
+    """Additive single-head attention, optional banded mask
+    (ref: eqtransformer.py:135-198)."""
+
+    d_model: int
+    attn_width: int | None = None
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, Array]:
+        # x: (N, L, C)
+        C = x.shape[-1]
+        Wx = self.param("Wx", nn.initializers.xavier_uniform(), (C, self.d_model))
+        Wt = self.param("Wt", nn.initializers.xavier_uniform(), (C, self.d_model))
+        bh = self.param("bh", nn.initializers.zeros, (self.d_model,))
+        Wa = self.param("Wa", nn.initializers.xavier_uniform(), (self.d_model, 1))
+        ba = self.param("ba", nn.initializers.zeros, (1,))
+
+        q = (x @ Wt)[:, :, None, :]  # (N, L, 1, d)
+        k = (x @ Wx)[:, None, :, :]  # (N, 1, L, d)
+        h = jnp.tanh(q + k + bh)  # (N, L, L, d)
+        e = (h @ Wa)[..., 0] + ba  # (N, L, L)
+        e = jnp.exp(e - jnp.max(e, axis=-1, keepdims=True))
+
+        if self.attn_width is not None:
+            L = x.shape[1]
+            i = jnp.arange(L)[:, None]
+            j = jnp.arange(L)[None, :]
+            # tril(w//2 - 1) & triu(-w//2): j - i <= w//2 - 1 and i - j <= w//2
+            mask = (j - i <= self.attn_width // 2 - 1) & (i - j <= self.attn_width // 2)
+            e = jnp.where(mask, e, 0.0)
+
+        s = jnp.sum(e, axis=-1, keepdims=True)
+        a = e / (s + _EPS)
+        v = jnp.einsum("nlm,nmc->nlc", a, x)
+        return v, a
+
+
+class FeedForward(nn.Module):
+    """2-layer MLP (ref: eqtransformer.py:201-229)."""
+
+    feedforward_dim: int
+    drop_rate: float
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        C = x.shape[-1]
+        x = nn.Dense(
+            self.feedforward_dim,
+            kernel_init=nn.initializers.xavier_uniform(),
+            name="lin0",
+        )(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+        x = nn.Dense(C, kernel_init=nn.initializers.xavier_uniform(), name="lin1")(x)
+        return x
+
+
+class TransformerLayer(nn.Module):
+    """attn + LN + FF + LN (ref: eqtransformer.py:232-266)."""
+
+    d_model: int
+    feedforward_dim: int
+    drop_rate: float
+    attn_width: int | None = None
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Tuple[Array, Array]:
+        x1, w = AttentionLayer(self.d_model, self.attn_width, name="attn")(x)
+        x2 = nn.LayerNorm(name="ln0")(x1 + x)
+        x3 = FeedForward(self.feedforward_dim, self.drop_rate, name="ff")(x2, train)
+        x4 = nn.LayerNorm(name="ln1")(x3 + x2)
+        return x4, w
+
+
+class Encoder(nn.Module):
+    """Conv x7 + ResConv x5 + BiLSTM x3 + Transformer x2
+    (ref: eqtransformer.py:269-359)."""
+
+    conv_channels: Sequence[int]
+    conv_kernels: Sequence[int]
+    resconv_kernels: Sequence[int]
+    num_lstm_blocks: int
+    num_transformer_layers: int
+    transformer_io_channels: int
+    transformer_d_model: int
+    feedforward_dim: int
+    drop_rate: float
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        for i, (outc, kers) in enumerate(zip(self.conv_channels, self.conv_kernels)):
+            x = ConvBlock(outc, kers, name=f"conv{i}")(x)
+        for i, kers in enumerate(self.resconv_kernels):
+            x = ResConvBlock(kers, self.drop_rate, name=f"resconv{i}")(x, train)
+        for i in range(self.num_lstm_blocks):
+            x = BiLSTMBlock(
+                self.transformer_io_channels, self.drop_rate, name=f"bilstm{i}"
+            )(x, train)
+        for i in range(self.num_transformer_layers):
+            x, w = TransformerLayer(
+                self.transformer_d_model,
+                self.feedforward_dim,
+                self.drop_rate,
+                name=f"transformer{i}",
+            )(x, train)
+        return x
+
+
+class UpSamplingBlock(nn.Module):
+    """x2 nearest upsample -> crop -> same conv -> relu
+    (ref: eqtransformer.py:362-405)."""
+
+    out_channels: int
+    out_samples: int
+    kernel_size: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = common.upsample_x2(x)
+        x = x[:, : self.out_samples, :]
+        x = common.same_pad_1d(x, self.kernel_size)
+        x = nn.Conv(self.out_channels, (self.kernel_size,), padding="VALID", name="conv")(x)
+        return nn.relu(x)
+
+
+class Decoder(nn.Module):
+    """Optional LSTM + local-attn transformer, then 7 upsampling blocks
+    (ref: eqtransformer.py:421-513)."""
+
+    conv_channels: Sequence[int]
+    conv_kernels: Sequence[int]
+    transformer_io_channels: int
+    transformer_d_model: int
+    feedforward_dim: int
+    drop_rate: float
+    out_samples: int
+    has_lstm: bool = True
+    has_local_attn: bool = True
+    local_attn_width: int = 3
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        if self.has_lstm:
+            x, _ = common.LSTM(self.transformer_io_channels, name="lstm")(x)
+            x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+        if self.has_local_attn:
+            x, _ = TransformerLayer(
+                self.transformer_d_model,
+                self.feedforward_dim,
+                self.drop_rate,
+                attn_width=self.local_attn_width,
+                name="transformer",
+            )(x, train)
+
+        crop_sizes = [self.out_samples]
+        for _ in range(len(self.conv_kernels) - 1):
+            crop_sizes.insert(0, math.ceil(crop_sizes[0] / 2))
+
+        for i, (outc, crop, kers) in enumerate(
+            zip(self.conv_channels, crop_sizes, self.conv_kernels)
+        ):
+            x = UpSamplingBlock(outc, crop, kers, name=f"up{i}")(x)
+
+        x = nn.Conv(1, (11,), padding=[(5, 5)], name="conv_out")(x)
+        return nn.sigmoid(x)
+
+
+class EQTransformer(nn.Module):
+    """(N, L, 3) -> (N, L, 3) probabilities [det, ppk, spk]
+    (ref: eqtransformer.py:516-614)."""
+
+    in_channels: int = 3
+    in_samples: int = 8192
+    conv_channels: Sequence[int] = (8, 16, 16, 32, 32, 64, 64)
+    conv_kernels: Sequence[int] = (11, 9, 7, 7, 5, 5, 3)
+    resconv_kernels: Sequence[int] = (3, 3, 3, 2, 2)
+    num_lstm_blocks: int = 3
+    num_transformer_layers: int = 2
+    transformer_io_channels: int = 16
+    transformer_d_model: int = 32
+    feedforward_dim: int = 128
+    local_attention_width: int = 3
+    drop_rate: float = 0.1
+    decoder_with_attn_lstm: Sequence[bool] = (False, True, True)
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        feature = Encoder(
+            conv_channels=self.conv_channels,
+            conv_kernels=self.conv_kernels,
+            resconv_kernels=self.resconv_kernels,
+            num_lstm_blocks=self.num_lstm_blocks,
+            num_transformer_layers=self.num_transformer_layers,
+            transformer_io_channels=self.transformer_io_channels,
+            transformer_d_model=self.transformer_d_model,
+            feedforward_dim=self.feedforward_dim,
+            drop_rate=self.drop_rate,
+            name="encoder",
+        )(x, train)
+
+        outputs = []
+        for d, has_attn_lstm in enumerate(self.decoder_with_attn_lstm):
+            outputs.append(
+                Decoder(
+                    conv_channels=self.conv_channels[::-1],
+                    conv_kernels=self.conv_kernels[::-1],
+                    transformer_io_channels=self.transformer_io_channels,
+                    transformer_d_model=self.transformer_d_model,
+                    feedforward_dim=self.feedforward_dim,
+                    drop_rate=self.drop_rate,
+                    out_samples=self.in_samples,
+                    has_lstm=has_attn_lstm,
+                    has_local_attn=has_attn_lstm,
+                    local_attn_width=self.local_attention_width,
+                    name=f"decoder{d}",
+                )(feature, train)
+            )
+        return jnp.concatenate(outputs, axis=-1)
+
+
+@register_model
+def eqtransformer(**kwargs) -> EQTransformer:
+    kwargs = {k: v for k, v in kwargs.items() if k in EQTransformer.__dataclass_fields__}
+    return EQTransformer(**kwargs)

@@ -1,0 +1,66 @@
+"""MagNet — conv + BiLSTM magnitude estimator (channels-last Flax).
+
+Architecture parity with the reference ``models/magnet.py:36-117``
+(Mousavi & Beroza 2020): two conv-pool blocks, one BiLSTM, linear head
+producing (magnitude, log-variance) consumed by MousaviLoss.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from seist_tpu.models import common
+from seist_tpu.registry import register_model
+
+Array = jnp.ndarray
+
+
+class ConvBlock(nn.Module):
+    """conv -> dropout -> ceil-mode maxpool (ref: magnet.py:36-60)."""
+
+    out_channels: int
+    conv_kernel_size: int
+    pool_kernel_size: int
+    drop_rate: float
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        x = common.auto_pad_1d(x, self.conv_kernel_size)
+        x = nn.Conv(
+            self.out_channels, (self.conv_kernel_size,), padding="VALID", name="conv"
+        )(x)
+        x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+        x = common.max_pool_1d_ceil(x, self.pool_kernel_size)
+        return x
+
+
+class MagNet(nn.Module):
+    """(N, L, C) -> (N, 2): (y_hat, log sigma^2) (ref: magnet.py:63-110)."""
+
+    in_channels: int = 3
+    conv_channels: Sequence[int] = (64, 32)
+    lstm_dim: int = 100
+    drop_rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        for i, outc in enumerate(self.conv_channels):
+            x = ConvBlock(
+                out_channels=outc,
+                conv_kernel_size=3,
+                pool_kernel_size=4,
+                drop_rate=self.drop_rate,
+                name=f"conv{i}",
+            )(x, train)
+        _, h = common.BiLSTM(self.lstm_dim, name="bilstm")(x)
+        return nn.Dense(2, name="lin")(h)
+
+
+@register_model
+def magnet(**kwargs) -> MagNet:
+    kwargs.pop("in_samples", None)
+    kwargs = {k: v for k, v in kwargs.items() if k in MagNet.__dataclass_fields__}
+    return MagNet(**kwargs)

@@ -1,0 +1,194 @@
+"""PhaseNet — 1-D U-Net for phase picking (channels-last Flax).
+
+TPU-native re-implementation with architecture parity to the reference
+``models/phasenet.py:17-275`` (Zhu & Beroza 2019): stride-4 down/up x5,
+skip concats with asymmetric crop, softmax over the 3 class channels.
+
+Input ``(N, L, 3)`` -> output probabilities ``(N, L, 3)`` (non/ppk/spk).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from seist_tpu.models import common
+from seist_tpu.registry import register_model
+
+Array = jnp.ndarray
+
+
+class ConvBlock(nn.Module):
+    """Optional stride conv + same conv (ref: phasenet.py:17-80)."""
+
+    in_channels: int
+    out_channels: int
+    kernel_size: int
+    stride: int
+    drop_rate: float
+    has_stride_conv: bool = True
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        if self.has_stride_conv:
+            # Dynamic pad so L_out = ceil(L/stride) (ref: phasenet.py:60-67).
+            x = common.auto_pad_1d(x, self.kernel_size, self.stride)
+            x = nn.Conv(
+                self.in_channels,
+                (self.kernel_size,),
+                strides=(self.stride,),
+                padding="VALID",
+                use_bias=False,
+                name="conv0",
+            )(x)
+            x = common.make_norm("batch", use_running_average=not train, name="bn0")(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+
+        x = common.same_pad_1d(x, self.kernel_size)
+        x = nn.Conv(
+            self.out_channels,
+            (self.kernel_size,),
+            padding="VALID",
+            use_bias=False,
+            name="conv1",
+        )(x)
+        x = common.make_norm("batch", use_running_average=not train, name="bn1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+        return x
+
+
+class ConvTransBlock(nn.Module):
+    """Optional same conv (on concat) + transposed conv
+    (ref: phasenet.py:83-149)."""
+
+    in_channels: int
+    out_channels: int
+    kernel_size: int
+    stride: int
+    drop_rate: float
+    has_conv_same: bool = True
+    has_conv_trans: bool = True
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        if self.has_conv_same:
+            x = common.same_pad_1d(x, self.kernel_size)
+            x = nn.Conv(
+                self.in_channels,
+                (self.kernel_size,),
+                padding="VALID",
+                use_bias=False,
+                name="conv0",
+            )(x)
+            x = common.make_norm("batch", use_running_average=not train, name="bn0")(x)
+            x = nn.relu(x)
+        if self.has_conv_trans:
+            x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+            # torch ConvTranspose1d(pad=0): L_out = (L-1)*s + k; flax 'VALID'
+            # transposed conv matches for k >= s.
+            x = nn.ConvTranspose(
+                self.out_channels,
+                (self.kernel_size,),
+                strides=(self.stride,),
+                padding="VALID",
+                use_bias=False,
+                name="convt",
+            )(x)
+            x = common.make_norm("batch", use_running_average=not train, name="bn1")(x)
+            x = nn.relu(x)
+        if self.has_conv_same:
+            x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+        return x
+
+
+class PhaseNet(nn.Module):
+    """U-Net over (N, L, C) (ref: phasenet.py:152-267)."""
+
+    in_channels: int = 3
+    kernel_size: int = 7
+    stride: int = 4
+    conv_channels: Sequence[int] = (8, 16, 32, 64, 128)
+    drop_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = False) -> Array:
+        ch = list(self.conv_channels)
+        depth = len(ch)
+
+        x = common.same_pad_1d(x, self.kernel_size)
+        x = nn.Conv(ch[0], (self.kernel_size,), padding="VALID", name="conv_in")(x)
+        x = common.make_norm("batch", use_running_average=not train, name="bn_in")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.drop_rate, deterministic=not train)(x)
+
+        # Down path (ref: phasenet.py:194-210, 244-249)
+        down_in = ch[:1] + ch[:-1]
+        shortcuts = []
+        for i in range(depth - 1):
+            x = ConvBlock(
+                in_channels=down_in[i],
+                out_channels=ch[i],
+                kernel_size=self.kernel_size,
+                stride=self.stride,
+                drop_rate=self.drop_rate,
+                has_stride_conv=(i != 0),
+                name=f"down{i}",
+            )(x, train)
+            shortcuts.append(x)
+        x = ConvBlock(
+            in_channels=down_in[-1],
+            out_channels=ch[-1],
+            kernel_size=self.kernel_size,
+            stride=self.stride,
+            drop_rate=self.drop_rate,
+            has_stride_conv=True,
+            name=f"down{depth - 1}",
+        )(x, train)
+
+        # Up path (ref: phasenet.py:213-230, 251-262)
+        up_in = ch[::-1]
+        up_out = ch[-2::-1] + [ch[0]]  # last block has no trans conv
+        rev_i = list(range(depth))[::-1]
+        for j in range(depth - 1):
+            x = ConvTransBlock(
+                in_channels=up_in[j],
+                out_channels=up_out[j],
+                kernel_size=self.kernel_size,
+                stride=self.stride,
+                drop_rate=self.drop_rate,
+                has_conv_same=(rev_i[j] < depth - 1),
+                has_conv_trans=(rev_i[j] > 0),
+                name=f"up{j}",
+            )(x, train)
+            shortcut = shortcuts[-(j + 1)]
+            # Crop the transposed-conv overhang then concat the skip
+            # (ref: phasenet.py:253-260).
+            p = common.auto_pad_amount(
+                shortcut.shape[-2], self.kernel_size, self.stride
+            )
+            lp, rp = p
+            x = jnp.concatenate([shortcut, x[:, lp : x.shape[-2] - rp, :]], axis=-1)
+        x = ConvTransBlock(
+            in_channels=up_in[-1],
+            out_channels=up_out[-1],
+            kernel_size=self.kernel_size,
+            stride=self.stride,
+            drop_rate=self.drop_rate,
+            has_conv_same=True,
+            has_conv_trans=False,
+            name=f"up{depth - 1}",
+        )(x, train)
+
+        x = nn.Conv(3, (1,), name="conv_out")(x)
+        return nn.softmax(x, axis=-1)
+
+
+@register_model
+def phasenet(**kwargs) -> PhaseNet:
+    kwargs.pop("in_samples", None)
+    kwargs = {k: v for k, v in kwargs.items() if k in PhaseNet.__dataclass_fields__}
+    return PhaseNet(**kwargs)

@@ -1,0 +1,196 @@
+"""Model-zoo tests: registration surface, output shapes, parameter parity.
+
+Parameter parity: reference state-dict totals (BASELINE.md, measured from
+pretrained/*.pth) equal our params + batch_stats + one `num_batches_tracked`
+scalar per BN layer. Counting uses jax.eval_shape (no compute) so the suite
+stays fast.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import seist_tpu
+from seist_tpu import taskspec
+from seist_tpu.models import api
+from seist_tpu.registry import MODELS
+
+seist_tpu.load_all()
+
+ALL_MODELS = [
+    "phasenet",
+    "eqtransformer",
+    "magnet",
+    "baz_network",
+    "distpt_network",
+    "ditingmotion",
+] + [f"seist_{s}_{t}" for s in "sml" for t in ("dpk", "pmp", "emg", "baz", "dis")]
+
+
+def test_registry_has_21_models():
+    # API surface parity: SURVEY.md Appendix B / reference README.md:54
+    assert set(ALL_MODELS) <= set(MODELS.names())
+    assert len(ALL_MODELS) == 21
+
+
+def _count_with_bn(model, in_samples, in_channels):
+    shapes = api.param_shapes(model, in_samples=in_samples, in_channels=in_channels)
+    n_params = api.count_params(shapes["params"])
+    bn_leaves = jax.tree_util.tree_leaves(shapes.get("batch_stats", {}))
+    n_stats = sum(int(np.prod(p.shape)) for p in bn_leaves)
+    n_bn_layers = len(bn_leaves) // 2
+    return n_params + n_stats + n_bn_layers
+
+
+@pytest.mark.parametrize(
+    "name,ref_total",
+    [
+        # Reference state-dict numels incl. BN buffers (BASELINE.md).
+        ("seist_s_dpk", 128_981),
+        ("seist_m_dpk", 387_620),
+        ("seist_l_dpk", 670_681),
+        ("seist_l_emg", 537_461),
+    ],
+)
+def test_seist_param_parity(name, ref_total):
+    model = api.create_model(name)
+    assert _count_with_bn(model, 8192, 3) == ref_total
+
+
+L_SMALL = 512
+
+
+@pytest.mark.parametrize("size", ["s", "m", "l"])
+def test_seist_dpk_output_shape(size):
+    model = api.create_model(f"seist_{size}_dpk", in_samples=L_SMALL)
+    x = jnp.zeros((2, L_SMALL, 3))
+    v = api.init_variables(model, in_samples=L_SMALL, batch_size=2)
+    out = jax.jit(lambda v, x: model.apply(v, x, train=False))(v, x)
+    assert out.shape == (2, L_SMALL, 3)
+    # sigmoid outputs are probabilities
+    assert float(jnp.min(out)) >= 0.0 and float(jnp.max(out)) <= 1.0
+
+
+def test_seist_cls_and_reg_heads():
+    x = jnp.zeros((2, L_SMALL, 3))
+    m_cls = api.create_model("seist_s_pmp", in_samples=L_SMALL)
+    v = api.init_variables(m_cls, in_samples=L_SMALL, batch_size=2)
+    out = jax.jit(lambda v, x: m_cls.apply(v, x, train=False))(v, x)
+    assert out.shape == (2, 2)
+    np.testing.assert_allclose(np.asarray(out.sum(-1)), 1.0, atol=1e-5)  # softmax
+
+    m_reg = api.create_model("seist_s_emg", in_samples=L_SMALL)
+    v = api.init_variables(m_reg, in_samples=L_SMALL, batch_size=2)
+    out = jax.jit(lambda v, x: m_reg.apply(v, x, train=False))(v, x)
+    assert out.shape == (2, 1)
+    assert 0.0 <= float(out.min()) and float(out.max()) <= 8.0  # sigmoid x 8
+
+
+def test_phasenet_output_is_softmax():
+    model = api.create_model("phasenet")
+    x = jnp.zeros((2, 1024, 3))
+    v = api.init_variables(model, in_samples=1024, batch_size=2)
+    out = jax.jit(lambda v, x: model.apply(v, x, train=False))(v, x)
+    assert out.shape == (2, 1024, 3)
+    np.testing.assert_allclose(np.asarray(out.sum(-1)), 1.0, atol=1e-5)
+
+
+def test_eqtransformer_output_shape():
+    model = api.create_model("eqtransformer", in_samples=L_SMALL)
+    x = jnp.zeros((2, L_SMALL, 3))
+    v = api.init_variables(model, in_samples=L_SMALL, batch_size=2)
+    out = jax.jit(lambda v, x: model.apply(v, x, train=False))(v, x)
+    assert out.shape == (2, L_SMALL, 3)
+    assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0  # sigmoid
+
+
+def test_magnet_output_shape():
+    model = api.create_model("magnet")
+    x = jnp.zeros((2, 1024, 3))
+    v = api.init_variables(model, in_samples=1024, batch_size=2)
+    out = jax.jit(lambda v, x: model.apply(v, x, train=False))(v, x)
+    assert out.shape == (2, 2)  # (y_hat, log sigma^2)
+
+
+def test_baz_network_output_shape():
+    model = api.create_model("baz_network", in_samples=1024)
+    x = jnp.ones((2, 1024, 3)) * jnp.arange(3)[None, None, :]
+    v = api.init_variables(model, in_samples=1024, batch_size=2)
+    out = jax.jit(lambda v, x: model.apply(v, x, train=False))(v, x)
+    assert isinstance(out, tuple) and out[0].shape == (2, 1) and out[1].shape == (2, 1)
+
+
+def test_baz_cov_features_match_reference_semantics(rng):
+    import torch
+
+    from seist_tpu.models.baz_network import _cov_features
+
+    x = rng.normal(size=(2, 64, 3)).astype(np.float32)
+    feats = np.asarray(_cov_features(jnp.asarray(x)))  # (N, 2C+1, C)
+    # torch-side covariance on channels-first input (ref: baz_network.py:67-77)
+    xt = torch.from_numpy(np.moveaxis(x, -1, 1).copy())
+    diff = xt - xt.mean(-1, keepdim=True)
+    cov_ref = torch.einsum("ncl,ndl->ncd", diff, diff) / (x.shape[1] - 1)
+    cov_ref = cov_ref / cov_ref.abs().amax(dim=(-2, -1), keepdim=True)
+    np.testing.assert_allclose(
+        feats[:, :3, :].transpose(0, 2, 1), cov_ref.numpy(), atol=2e-3
+    )
+
+
+def test_distpt_output_shape():
+    model = api.create_model("distpt_network")
+    x = jnp.zeros((2, 1024, 3))
+    v = api.init_variables(model, in_samples=1024, batch_size=2)
+    out = jax.jit(lambda v, x: model.apply(v, x, train=False))(v, x)
+    assert out[0].shape == (2, 2) and out[1].shape == (2, 2)
+
+
+def test_ditingmotion_output_shape():
+    model = api.create_model("ditingmotion", in_channels=2, in_samples=128)
+    x = jnp.zeros((2, 128, 2))
+    v = api.init_variables(model, in_samples=128, in_channels=2, batch_size=2)
+    clr, pmp = jax.jit(lambda v, x: model.apply(v, x, train=False))(v, x)
+    assert clr.shape == (2, 2) and pmp.shape == (2, 2)
+
+
+def test_every_model_has_a_task_spec():
+    for name in ALL_MODELS:
+        if name == "distpt_network":
+            # Registered but config-disabled in the reference too
+            # (config.py:112-125: no travel-time data in DiTing).
+            with pytest.raises(KeyError):
+                taskspec.get_task_spec(name)
+            continue
+        taskspec.get_task_spec(name)
+
+
+def test_train_mode_uses_dropout_rngs():
+    model = api.create_model("seist_s_dpk", in_samples=L_SMALL)
+    v = api.init_variables(model, in_samples=L_SMALL)
+    x = jnp.ones((2, L_SMALL, 3))
+    apply = jax.jit(
+        lambda v, x, k: model.apply(
+            v, x, train=True, rngs={"dropout": k}, mutable=["batch_stats"]
+        )
+    )
+    out1, _ = apply(v, x, jax.random.PRNGKey(1))
+    out2, _ = apply(v, x, jax.random.PRNGKey(2))
+    # different dropout keys => different outputs
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+
+def test_batch_stats_update_in_train_mode():
+    model = api.create_model("phasenet")
+    v = api.init_variables(model, in_samples=256)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 256, 3)), jnp.float32)
+    _, updates = jax.jit(
+        lambda v, x, k: model.apply(
+            v, x, train=True, rngs={"dropout": k}, mutable=["batch_stats"]
+        )
+    )(v, x, jax.random.PRNGKey(0))
+    before = jax.tree_util.tree_leaves(v["batch_stats"])
+    after = jax.tree_util.tree_leaves(updates["batch_stats"])
+    assert any(
+        not np.allclose(np.asarray(b), np.asarray(a)) for b, a in zip(before, after)
+    )
