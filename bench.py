@@ -1,0 +1,107 @@
+"""Benchmark: SeisT-L dpk training throughput (waveforms/sec/chip).
+
+Runs the full jitted training step (forward + BCE loss + backward + Adam +
+BatchNorm stat update) of the flagship ``seist_l_dpk`` model on synthetic
+8192-sample 3-channel waveforms — the north-star metric from BASELINE.md
+(DiTing waveforms/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against the torch reference measured on this host's
+CPU via tools/bench_reference.py (the reference publishes no numbers and no
+GPU is available here — see BASELINE.md); the measured value is stored in
+tools/reference_baseline.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import seist_tpu
+    from seist_tpu import taskspec
+    from seist_tpu.models import api
+    from seist_tpu.train import (
+        build_cyclic_schedule,
+        build_optimizer,
+        create_train_state,
+        jit_step,
+        make_train_step,
+    )
+
+    seist_tpu.load_all()
+
+    model_name = os.environ.get("BENCH_MODEL", "seist_l_dpk")
+    in_samples = int(os.environ.get("BENCH_SAMPLES", 8192))
+    batch = int(os.environ.get("BENCH_BATCH", 256))
+    warmup_steps = 5
+    bench_steps = int(os.environ.get("BENCH_STEPS", 30))
+
+    model = api.create_model(model_name, in_samples=in_samples)
+    variables = api.init_variables(
+        model, in_samples=in_samples, batch_size=batch
+    )
+    sched = build_cyclic_schedule(8e-5, 1e-3, total_steps=10_000)
+    state = create_train_state(model, variables, build_optimizer("adam", sched))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((batch, in_samples, 3)), dtype=jnp.float32
+    )
+    y = np.zeros((batch, in_samples, 3), np.float32)
+    y[:, in_samples // 4, 1] = 1.0
+    y[:, in_samples // 2, 2] = 1.0
+    y[..., 0] = 1.0 - y[..., 1] - y[..., 2]
+    y = jnp.asarray(y)
+
+    spec = taskspec.get_task_spec(model_name)
+    loss_fn = taskspec.make_loss(model_name)
+    step = jit_step(make_train_step(spec, loss_fn), donate_state=False)
+    key = jax.random.PRNGKey(0)
+
+    for _ in range(warmup_steps):
+        state, loss, _ = step(state, x, y, key)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(bench_steps):
+        state, loss, _ = step(state, x, y, key)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    wfs = batch * bench_steps / dt
+
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tools",
+        "reference_baseline.json",
+    )
+    vs_baseline = 0.0
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            ref = json.load(f)
+        ref_wfs = ref.get("waveforms_per_sec", 0.0)
+        if ref_wfs:
+            vs_baseline = wfs / ref_wfs
+
+    print(
+        json.dumps(
+            {
+                "metric": f"{model_name}_train_throughput",
+                "value": round(wfs, 2),
+                "unit": "waveforms/sec/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,7 @@ Architecture parity with the reference ``models/eqtransformer.py:18-614``
 
 * The reference's L1 regularization of first-stage conv weights is
   implemented via grad hooks (eqtransformer.py:43-51,388-396); here it is a
-  training-side optax gradient transform (seist_tpu/train/schedule.py:
+  training-side optax gradient transform (seist_tpu/train/optim.py:
   ``l1_sign_decay``) scoped to the first conv stage — the constructor alphas
   default to 0.0 in both frameworks.
 * The additive single-head attention with optional banded mask reproduces
@@ -113,8 +113,12 @@ class AttentionLayer(nn.Module):
             L = x.shape[1]
             i = jnp.arange(L)[:, None]
             j = jnp.arange(L)[None, :]
-            # tril(w//2 - 1) & triu(-w//2): j - i <= w//2 - 1 and i - j <= w//2
-            mask = (j - i <= self.attn_width // 2 - 1) & (i - j <= self.attn_width // 2)
+            # tril(w//2 - 1) & triu(-w//2). Note the reference's `-w // 2` is
+            # (-w)//2 (floor division of the *negated* width), so odd w=3
+            # gives a lower bound of j - i >= -2, not -1.
+            mask = (j - i <= self.attn_width // 2 - 1) & (
+                j - i >= (-self.attn_width) // 2
+            )
             e = jnp.where(mask, e, 0.0)
 
         s = jnp.sum(e, axis=-1, keepdims=True)

@@ -476,7 +476,6 @@ class SeismogramTransformer(nn.Module):
             for i in range(total_blocks)
         ]
 
-        stage_in = [self.stem_channels[-1]] + list(self.layer_channels)
         for i, num_blocks in enumerate(self.layer_blocks):
             lc = self.layer_channels[i]
 

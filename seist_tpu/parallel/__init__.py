@@ -1,0 +1,23 @@
+"""Parallelism layer: device mesh, shardings, multi-host helpers."""
+
+from seist_tpu.parallel.dist import (  # noqa: F401
+    barrier,
+    broadcast_object,
+    init_distributed_mode,
+    is_dist_avail_and_initialized,
+    is_main_process,
+    process_count,
+    process_index,
+)
+from seist_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    MESH_AXES,
+    batch_sharding,
+    batch_spec,
+    make_mesh,
+    replicate,
+    replicated,
+    shard_batch,
+)

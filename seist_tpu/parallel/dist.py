@@ -1,0 +1,85 @@
+"""Multi-host process-group helpers.
+
+Replaces the reference's torchrun/NCCL rendezvous
+(/root/reference/utils/misc.py:143-172): `jax.distributed.initialize` reads
+the coordinator address + process count from the environment (or TPU metadata)
+and wires the hosts into one JAX runtime; collectives then ride ICI/DCN via
+the compiled programs — there is no user-visible process group object.
+
+Rank-0-only conventions (printing, checkpoint writes, result CSVs) mirror the
+reference's `is_main_process` guards (misc.py:73-100, train.py:192,288,407).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def init_distributed_mode(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize the multi-host runtime if a multi-host env is detected.
+
+    Env contract mirrors the reference's env-var rendezvous
+    (misc.py:143-152): set ``COORDINATOR_ADDRESS``, ``NUM_PROCESSES``,
+    ``PROCESS_ID`` (or pass explicitly). On Cloud TPU pods all three resolve
+    automatically from metadata, so a bare call works too.
+
+    Returns True when distributed mode was initialized.
+    """
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+
+    explicit = coordinator_address is not None
+    auto_tpu = os.environ.get("TPU_WORKER_HOSTNAMES") is not None
+    if not (explicit or auto_tpu):
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_dist_avail_and_initialized() -> bool:
+    return jax.process_count() > 1
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until all hosts reach this point (ref: dist.barrier())."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def broadcast_object(obj: Any) -> Any:
+    """Broadcast a host-side python object from process 0 to all
+    (ref: misc.py:134-140 broadcast_object_list)."""
+    if jax.process_count() <= 1:
+        return obj
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(obj)

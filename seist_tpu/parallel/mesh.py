@@ -1,0 +1,89 @@
+"""Device mesh + sharding helpers.
+
+TPU-native replacement for the reference's NCCL/DDP layer
+(/root/reference/utils/misc.py:103-172, training/train.py:367-374). Instead of
+wrapping the model in DDP and hand-placing collectives, we declare a
+`jax.sharding.Mesh` and annotate data/parameter shardings; XLA inserts the
+gradient all-reduce (over ICI intra-slice, DCN across slices) when the train
+step is jit-compiled.
+
+Axis convention (fixed, in this order):
+
+* ``data``  — batch (data parallel). The only axis the SeisT-scale models
+  *need* (the reference implements exactly one strategy, DDP — SURVEY §2.4).
+* ``model`` — tensor-parallel axis, size 1 by default. Kept first-class so
+  channel-sharded variants can be added without re-plumbing.
+* ``seq``   — sequence/context-parallel axis, size 1 by default. Ring
+  attention / sequence sharding for very long waveforms rides this axis
+  (see seist_tpu/ops/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+MESH_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_SEQ)
+
+
+def make_mesh(
+    data: Optional[int] = None,
+    model: int = 1,
+    seq: int = 1,
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """Build a ``(data, model, seq)`` mesh over ``devices``.
+
+    ``data=None`` consumes all remaining devices. On real TPU slices
+    ``mesh_utils.create_device_mesh`` lays the axes onto the physical torus so
+    the heaviest-traffic axis rides ICI neighbors.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if data is None:
+        if n % (model * seq):
+            raise ValueError(f"{n} devices not divisible by model*seq={model * seq}")
+        data = n // (model * seq)
+    if data * model * seq != n:
+        raise ValueError(
+            f"mesh shape {(data, model, seq)} != device count {n}"
+        )
+    dev_mesh = mesh_utils.create_device_mesh(
+        (data, model, seq), devices=np.asarray(devices)
+    )
+    return Mesh(dev_mesh, MESH_AXES)
+
+
+def batch_spec(extra_axes: int = 0) -> P:
+    """PartitionSpec sharding the leading (batch) axis over ``data``."""
+    return P(AXIS_DATA, *([None] * extra_axes))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(AXIS_DATA))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch: Any) -> Any:
+    """Device-put a host batch pytree with the leading axis sharded on
+    ``data`` (the `DistributedSampler`-equivalent placement; each host passes
+    its local shard and jax builds the global array)."""
+    sharding = batch_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(mesh: Mesh, tree: Any) -> Any:
+    """Fully replicate a pytree (params/optimizer state) over the mesh."""
+    sharding = replicated(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)

@@ -1,0 +1,21 @@
+"""Training engine: schedules, optimizers, jitted steps, checkpointing."""
+
+from seist_tpu.train.checkpoint import (  # noqa: F401
+    load_checkpoint,
+    restore_into_state,
+    save_checkpoint,
+)
+from seist_tpu.train.optim import build_optimizer, l1_sign_decay  # noqa: F401
+from seist_tpu.train.schedule import (  # noqa: F401
+    build_cyclic_schedule,
+    cyclic_lr,
+    reference_gamma,
+)
+from seist_tpu.train.state import TrainState, create_train_state  # noqa: F401
+from seist_tpu.train.step import (  # noqa: F401
+    fold_rngs,
+    jit_eval_step,
+    jit_step,
+    make_eval_step,
+    make_train_step,
+)

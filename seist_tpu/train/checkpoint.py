@@ -1,0 +1,93 @@
+"""Checkpoint save / restore (orbax).
+
+Counterpart of the reference's torch checkpointing
+(/root/reference/models/_factory.py:59-126): the saved payload carries the
+same logical fields — epoch, model params (+ BN stats), optimizer state, best
+loss — and restore tolerates params-only checkpoints the way the reference
+tolerates raw state-dicts (:101-102). DDP/compile prefix-stripping has no
+analogue here: a pytree is a pytree.
+
+Orbax handles multi-host coordination internally (every process must call
+save; only process 0 writes metadata), replacing the reference's
+rank-0-only torch.save guard (train.py:407-415).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from seist_tpu.utils.logger import logger
+
+
+def _as_abstract(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype), tree
+    )
+
+
+def save_checkpoint(
+    ckpt_dir: str,
+    state,
+    epoch: int,
+    loss: float,
+) -> str:
+    """Write ``<ckpt_dir>/model-<epoch>`` (ref naming: `model-{epoch}.pth`,
+    train.py:411). Returns the checkpoint path."""
+    path = os.path.join(os.path.abspath(ckpt_dir), f"model-{epoch}")
+    payload = {
+        "params": state.params,
+        "batch_stats": state.batch_stats if state.batch_stats is not None else {},
+        "opt_state": state.opt_state,
+        "meta": {"epoch": epoch, "loss": float(loss), "step": int(state.step)},
+    }
+    with ocp.StandardCheckpointer() as saver:
+        saver.save(path, payload, force=True)
+    logger.info(f"Checkpoint saved: {path}")
+    return path
+
+
+def load_checkpoint(
+    ckpt_path: str,
+    state=None,
+) -> Dict[str, Any]:
+    """Restore a checkpoint.
+
+    With ``state`` given, the restored arrays adopt the state's exact
+    structure/dtypes (full resume: params + batch_stats + opt_state + meta).
+    Without it, returns the raw pytree (params-only inspection / inference),
+    mirroring the reference's tolerance for bare state-dicts
+    (_factory.py:101-102).
+    """
+    path = os.path.abspath(ckpt_path)
+    with ocp.StandardCheckpointer() as restorer:
+        if state is None:
+            return restorer.restore(path)
+        target = {
+            "params": _as_abstract(state.params),
+            "batch_stats": _as_abstract(
+                state.batch_stats if state.batch_stats is not None else {}
+            ),
+            "opt_state": _as_abstract(state.opt_state),
+            "meta": {"epoch": 0, "loss": 0.0, "step": 0},
+        }
+        return restorer.restore(path, target)
+
+
+def restore_into_state(state, restored: Dict[str, Any]):
+    """Apply a restored payload onto a TrainState (resume path,
+    ref train.py:255-264,324-326)."""
+    opt_state = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(state.opt_state),
+        jax.tree_util.tree_leaves(restored["opt_state"]),
+    )
+    return state.replace(
+        params=restored["params"],
+        batch_stats=restored["batch_stats"] or state.batch_stats,
+        opt_state=opt_state,
+        step=int(restored["meta"]["step"]),
+    )

@@ -1,0 +1,71 @@
+"""Optimizer construction (optax).
+
+Counterpart of the reference's optimizer block
+(/root/reference/training/train.py:302-323): Adam / AdamW / SGD selected by
+name, per-step LR driven by the cyclic schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Schedule = Union[float, Callable[[int], float]]
+
+
+def l1_sign_decay(
+    alpha: float,
+    mask: Optional[Callable] = None,
+) -> optax.GradientTransformation:
+    """L1 regularization applied in gradient space: ``g + alpha * sign(w)``.
+
+    This is the optax equivalent of the reference EQTransformer's
+    backward-hook L1 on its first conv stage
+    (/root/reference/models/eqtransformer.py:43-51,388-396) — instead of
+    mutating grads in a hook, chain this transform before the optimizer and
+    scope it with ``mask`` (a ``params -> bool pytree`` fn selecting e.g. the
+    first conv stage's kernels).
+    """
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("l1_sign_decay requires params")
+        updates = jax.tree_util.tree_map(
+            lambda g, p: g + alpha * jnp.sign(p), updates, params
+        )
+        return updates, state
+
+    tx = optax.GradientTransformation(init_fn, update_fn)
+    if mask is not None:
+        tx = optax.masked(tx, mask)
+    return tx
+
+
+def build_optimizer(
+    name: str,
+    learning_rate: Schedule,
+    weight_decay: float = 0.0,
+    momentum: float = 0.9,
+) -> optax.GradientTransformation:
+    name = name.lower()
+    if name == "adam":
+        tx = optax.adam(learning_rate)
+        # torch Adam's `weight_decay` is L2-into-gradient, not decoupled.
+        if weight_decay:
+            tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+        return tx
+    if name == "adamw":
+        return optax.adamw(learning_rate, weight_decay=weight_decay)
+    if name == "sgd":
+        tx = optax.sgd(learning_rate, momentum=momentum)
+        if weight_decay:
+            tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+        return tx
+    raise NotImplementedError(f"Unsupported optimizer: '{name}' (adam/adamw/sgd)")

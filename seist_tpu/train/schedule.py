@@ -1,0 +1,85 @@
+"""Learning-rate schedules.
+
+The reference trains every model with torch ``CyclicLR``
+(/root/reference/training/train.py:343-354): warmup of ``step_size_up`` steps
+from base_lr to max_lr, then ``step_size_down`` back, cycling; mode one of
+triangular / triangular2 / exp_range, with the quirky
+``gamma = base_lr ** (1 / (2 * steps))`` rule computed by the caller
+(train.py:349). This module reproduces those semantics as a pure
+``step -> lr`` function usable directly as an optax schedule inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def cyclic_lr(
+    base_lr: float,
+    max_lr: float,
+    step_size_up: int,
+    step_size_down: Optional[int] = None,
+    mode: str = "triangular",
+    gamma: float = 1.0,
+):
+    """torch.optim.lr_scheduler.CyclicLR parity (cycle_momentum=False).
+
+    Formula matches torch's ``get_lr``: position inside the cycle scales the
+    height (max_lr - base_lr); triangular2 halves the height each cycle;
+    exp_range multiplies it by gamma**step.
+    """
+    if mode not in ("triangular", "triangular2", "exp_range"):
+        raise ValueError(f"Unknown CyclicLR mode: {mode}")
+    step_size_up = float(step_size_up)
+    step_size_down = float(
+        step_size_down if step_size_down is not None else step_size_up
+    )
+    total_size = step_size_up + step_size_down
+    step_ratio = step_size_up / total_size
+
+    def schedule(count):
+        t = jnp.asarray(count, dtype=jnp.float32)
+        cycle = jnp.floor(1.0 + t / total_size)
+        x = 1.0 + t / total_size - cycle
+        scale_factor = jnp.where(
+            x <= step_ratio, x / step_ratio, (x - 1.0) / (step_ratio - 1.0)
+        )
+        height = (max_lr - base_lr) * scale_factor
+        if mode == "triangular":
+            return base_lr + height
+        if mode == "triangular2":
+            return base_lr + height * (2.0 ** -(cycle - 1.0))
+        return base_lr + height * jnp.power(gamma, t)
+
+    return schedule
+
+
+def reference_gamma(base_lr: float, total_steps: int) -> float:
+    """The caller-side gamma rule (ref: train.py:349):
+    ``gamma = base_lr ** ((steps * 2) ** -1)`` so the exp_range envelope
+    decays to ~sqrt(base_lr) over the run."""
+    return float(base_lr ** ((total_steps * 2) ** -1))
+
+
+def build_cyclic_schedule(
+    base_lr: float,
+    max_lr: float,
+    total_steps: int,
+    warmup_steps: float = 2000,
+    down_steps: float = 3000,
+    mode: str = "exp_range",
+):
+    """Schedule construction exactly as the reference train worker does it
+    (train.py:328-354): warmup/down values < 1 are ratios of total steps."""
+    up = warmup_steps if warmup_steps >= 1 else max(1, int(warmup_steps * total_steps))
+    down = down_steps if down_steps >= 1 else max(1, int(down_steps * total_steps))
+    return cyclic_lr(
+        base_lr=base_lr,
+        max_lr=max_lr,
+        step_size_up=int(up),
+        step_size_down=int(down),
+        mode=mode,
+        gamma=reference_gamma(base_lr, total_steps),
+    )

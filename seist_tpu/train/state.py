@@ -1,0 +1,41 @@
+"""Train state pytree.
+
+One immutable pytree carries everything the reference's train worker keeps in
+mutable objects (model.parameters(), BN running stats inside modules,
+optimizer state, global step — /root/reference/training/train.py:278-354).
+Being a pytree, the whole state threads through a single jitted train step and
+shards/replicates uniformly over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import optax
+from flax import core, struct
+from flax.training import train_state
+
+
+class TrainState(train_state.TrainState):
+    """Flax TrainState + BatchNorm running statistics.
+
+    ``batch_stats`` replaces torch BN buffers; under jit with the batch
+    sharded on the ``data`` axis, reductions over the batch axis are *global*
+    (XLA inserts the collective), so cross-replica stat sync — the
+    reference's SyncBatchNorm conversion (train.py:374) — falls out for free.
+    """
+
+    batch_stats: core.FrozenDict[str, Any] = struct.field(default=None)
+
+
+def create_train_state(
+    model,
+    variables: dict,
+    tx: optax.GradientTransformation,
+) -> TrainState:
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats"),
+        tx=tx,
+    )

@@ -8,12 +8,20 @@ driver's dryrun_multichip hook.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Unconditional override: the session environment pins JAX_PLATFORMS to the
+# real TPU tunnel (e.g. "axon") and its sitecustomize registers that backend
+# at interpreter start, so the env var alone is not enough — the config update
+# below (before any device query) is what actually forces CPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -194,3 +194,19 @@ def test_batch_stats_update_in_train_mode():
     assert any(
         not np.allclose(np.asarray(b), np.asarray(a)) for b, a in zip(before, after)
     )
+
+
+def test_eqt_banded_mask_matches_torch():
+    torch = pytest.importorskip("torch")
+    for w in (3, 4, 5):
+        L = 9
+        ref = (
+            torch.ones((L, L), dtype=torch.bool)
+            .tril(w // 2 - 1)
+            .triu(-w // 2)
+            .numpy()
+        )
+        i = np.arange(L)[:, None]
+        j = np.arange(L)[None, :]
+        ours = (j - i <= w // 2 - 1) & (j - i >= (-w) // 2)
+        np.testing.assert_array_equal(ours, ref), f"width {w}"

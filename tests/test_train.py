@@ -1,0 +1,204 @@
+"""Training-engine tests: schedule parity vs torch, train step, checkpoint
+round-trip, and data-parallel sharding on the 8-device virtual CPU mesh."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import seist_tpu
+from seist_tpu import taskspec
+from seist_tpu.models import api
+from seist_tpu.parallel import make_mesh, replicate, shard_batch
+from seist_tpu.train import (
+    TrainState,
+    build_optimizer,
+    create_train_state,
+    cyclic_lr,
+    jit_step,
+    load_checkpoint,
+    make_eval_step,
+    make_train_step,
+    restore_into_state,
+    save_checkpoint,
+)
+
+seist_tpu.load_all()
+
+L = 256
+
+
+# --------------------------------------------------------------------- schedule
+@pytest.mark.parametrize("mode", ["triangular", "triangular2", "exp_range"])
+def test_cyclic_lr_matches_torch(mode):
+    torch = pytest.importorskip("torch")
+    base_lr, max_lr, up, down, gamma = 8e-5, 1e-3, 7, 11, 0.999
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=base_lr)
+    sched = torch.optim.lr_scheduler.CyclicLR(
+        opt,
+        base_lr=base_lr,
+        max_lr=max_lr,
+        step_size_up=up,
+        step_size_down=down,
+        mode=mode,
+        gamma=gamma,
+        cycle_momentum=False,
+    )
+    ours = cyclic_lr(base_lr, max_lr, up, down, mode=mode, gamma=gamma)
+    torch_lrs, our_lrs = [], []
+    for step in range(50):
+        torch_lrs.append(opt.param_groups[0]["lr"])
+        our_lrs.append(float(ours(step)))
+        opt.step()
+        sched.step()
+    np.testing.assert_allclose(our_lrs, torch_lrs, rtol=1e-5)
+
+
+# ------------------------------------------------------------------- train step
+def _setup(model_name="phasenet", batch=4):
+    model = api.create_model(model_name, in_samples=L)
+    variables = api.init_variables(model, in_samples=L, batch_size=batch)
+    tx = build_optimizer("adam", 1e-3)
+    state = create_train_state(model, variables, tx)
+    spec = taskspec.get_task_spec(model_name)
+    loss_fn = taskspec.make_loss(model_name)
+    return state, spec, loss_fn
+
+
+def _fake_dpk_batch(rng, batch=4):
+    x = rng.standard_normal((batch, L, 3)).astype(np.float32)
+    ppk = np.zeros((batch, L), np.float32)
+    ppk[:, 64] = 1.0
+    spk = np.zeros((batch, L), np.float32)
+    spk[:, 128] = 1.0
+    non = 1.0 - ppk - spk
+    y = np.stack([non, ppk, spk], axis=-1)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_train_step_reduces_loss(rng):
+    state, spec, loss_fn = _setup()
+    step = jit_step(make_train_step(spec, loss_fn))
+    x, y = _fake_dpk_batch(rng)
+    key = jax.random.PRNGKey(0)
+    state, loss0, out = step(state, x, y, key)
+    assert out.shape == (4, L, 3)
+    for _ in range(10):
+        state, loss, _ = step(state, x, y, key)
+    assert float(loss) < float(loss0)
+    assert int(state.step) == 11
+
+
+def test_train_step_updates_batch_stats(rng):
+    state, spec, loss_fn = _setup()
+    step = jit_step(make_train_step(spec, loss_fn), donate_state=False)
+    x, y = _fake_dpk_batch(rng)
+    new_state, _, _ = step(state, x, y, jax.random.PRNGKey(0))
+    before = jax.tree_util.tree_leaves(state.batch_stats)
+    after = jax.tree_util.tree_leaves(new_state.batch_stats)
+    assert any(
+        not np.allclose(np.asarray(b), np.asarray(a)) for b, a in zip(before, after)
+    )
+
+
+def test_eval_step_is_deterministic(rng):
+    state, spec, loss_fn = _setup()
+    estep = jax.jit(make_eval_step(spec, loss_fn))
+    x, y = _fake_dpk_batch(rng)
+    l1, o1 = estep(state, x, y)
+    l2, o2 = estep(state, x, y)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert float(l1) == float(l2)
+
+
+def test_train_step_with_transforms(rng):
+    # baz_network uses targets->(cos,sin) transform + CombinationLoss.
+    state, spec, loss_fn = _setup("baz_network", batch=2)
+    step = jit_step(make_train_step(spec, loss_fn))
+    x = jnp.asarray(rng.standard_normal((2, L, 3)), jnp.float32)
+    baz = jnp.asarray([[45.0], [270.0]], jnp.float32)
+    state, loss, outputs = step(state, x, baz, jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+
+
+# ------------------------------------------------------------------ parallelism
+def test_dp_sharded_step_matches_single_device(rng):
+    assert jax.device_count() >= 8, "conftest must provide 8 virtual devices"
+    # SGD (linear in grads) so the comparison tests sharding semantics, not
+    # Adam's g/sqrt(v) amplification of float reassociation noise.
+    model = api.create_model("phasenet", in_samples=L)
+    variables = api.init_variables(model, in_samples=L, batch_size=8)
+    state = create_train_state(model, variables, build_optimizer("sgd", 1e-2))
+    spec = taskspec.get_task_spec("phasenet")
+    loss_fn = taskspec.make_loss("phasenet")
+    x, y = _fake_dpk_batch(rng, batch=8)
+    key = jax.random.PRNGKey(0)
+
+    single = jit_step(make_train_step(spec, loss_fn), donate_state=False)
+    s1, loss1, _ = single(state, x, y, key)
+
+    mesh = make_mesh(data=8)
+    state_r = replicate(mesh, state)
+    xb, yb = shard_batch(mesh, (x, y))
+    sharded = jit_step(make_train_step(spec, loss_fn), mesh=mesh, donate_state=False)
+    s2, loss2, _ = sharded(state_r, xb, yb, key)
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_mesh_axes():
+    mesh = make_mesh()
+    assert mesh.axis_names == ("data", "model", "seq")
+    assert mesh.devices.size == jax.device_count()
+
+
+# ------------------------------------------------------------------- checkpoint
+def test_checkpoint_roundtrip(tmp_path, rng):
+    state, spec, loss_fn = _setup()
+    step = jit_step(make_train_step(spec, loss_fn), donate_state=False)
+    x, y = _fake_dpk_batch(rng)
+    state, loss, _ = step(state, x, y, jax.random.PRNGKey(0))
+
+    path = save_checkpoint(str(tmp_path / "ckpts"), state, epoch=3, loss=float(loss))
+    fresh, _, _ = _setup()
+    restored = load_checkpoint(path, fresh)
+    assert restored["meta"]["epoch"] == 3
+    resumed = restore_into_state(fresh, restored)
+    assert int(resumed.step) == 1
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(resumed.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ----------------------------------------------------------------- l1 decay
+def test_l1_sign_decay_adds_sign_to_grads():
+    import optax
+    from seist_tpu.train import l1_sign_decay
+
+    params = {"a": jnp.asarray([1.0, -2.0, 0.0]), "b": jnp.asarray([3.0])}
+    grads = {"a": jnp.asarray([0.1, 0.1, 0.1]), "b": jnp.asarray([0.1])}
+    tx = l1_sign_decay(0.5, mask=lambda p: {"a": True, "b": False})
+    updates, _ = tx.update(grads, tx.init(params), params)
+    np.testing.assert_allclose(np.asarray(updates["a"]), [0.6, -0.4, 0.1])
+    np.testing.assert_allclose(np.asarray(updates["b"]), [0.1])
+
+
+def test_jit_eval_step_preserves_state(rng):
+    from seist_tpu.train import jit_eval_step
+
+    state, spec, loss_fn = _setup()
+    estep = jit_eval_step(make_eval_step(spec, loss_fn))
+    x, y = _fake_dpk_batch(rng)
+    estep(state, x, y)
+    # state must remain usable (no donation)
+    tstep = jit_step(make_train_step(spec, loss_fn), donate_state=False)
+    tstep(state, x, y, jax.random.PRNGKey(0))

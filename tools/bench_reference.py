@@ -1,0 +1,120 @@
+"""Measure the torch reference's training throughput (host CPU).
+
+The reference repo publishes no benchmark numbers (BASELINE.md) and this
+environment has no GPU, so the comparison baseline for bench.py is the
+reference's own training step (forward + BCE loss + backward + Adam) timed on
+this host's CPU. The reference code is *imported* from /root/reference at
+runtime (never copied); its `timm` dependency is satisfied with a minimal
+stub since only `timm.models.layers.DropPath` is used (reference
+models/seist.py:7).
+
+Writes tools/reference_baseline.json consumed by bench.py.
+
+Usage: python tools/bench_reference.py [--batch 32] [--steps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import types
+
+REFERENCE = "/root/reference"
+
+
+def _install_timm_stub() -> None:
+    import torch.nn as nn
+
+    class DropPath(nn.Module):
+        """Stochastic depth (per-sample residual drop), the standard
+        implementation every library ships."""
+
+        def __init__(self, drop_prob: float = 0.0):
+            super().__init__()
+            self.drop_prob = float(drop_prob)
+
+        def forward(self, x):
+            if self.drop_prob == 0.0 or not self.training:
+                return x
+            keep = 1.0 - self.drop_prob
+            shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+            mask = x.new_empty(shape).bernoulli_(keep)
+            return x * mask / keep
+
+    timm = types.ModuleType("timm")
+    models = types.ModuleType("timm.models")
+    layers = types.ModuleType("timm.models.layers")
+    layers.DropPath = DropPath
+    models.layers = layers
+    timm.models = models
+    sys.modules["timm"] = timm
+    sys.modules["timm.models"] = models
+    sys.modules["timm.models.layers"] = layers
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="seist_l_dpk")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--in-samples", type=int, default=8192)
+    args = ap.parse_args()
+
+    import torch
+
+    _install_timm_stub()
+    sys.path.insert(0, REFERENCE)
+    from models import create_model  # reference models/_factory.py
+
+    model = create_model(args.model, in_channels=3, in_samples=args.in_samples)
+    model.train()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+
+    x = torch.randn(args.batch, 3, args.in_samples)
+    y = torch.zeros(args.batch, 3, args.in_samples)
+    y[:, 0, :] = 1.0  # det on
+    y[:, 1, args.in_samples // 4] = 1.0
+    y[:, 2, args.in_samples // 2] = 1.0
+    weights = torch.tensor([[0.5], [1.0], [1.0]])
+
+    def step():
+        opt.zero_grad()
+        out = model(x)
+        eps = 1e-6
+        loss = -(
+            y * torch.log(out + eps) + (1 - y) * torch.log(1 - out + eps)
+        )
+        loss = (loss * weights).mean()
+        loss.backward()
+        opt.step()
+        return loss
+
+    step()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        step()
+    dt = time.perf_counter() - t0
+    wfs = args.batch * args.steps / dt
+
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "reference_baseline.json")
+    payload = {
+        "model": args.model,
+        "waveforms_per_sec": round(wfs, 2),
+        "hardware": f"host CPU ({os.cpu_count()} cores), torch {torch.__version__}",
+        "batch": args.batch,
+        "steps": args.steps,
+        "in_samples": args.in_samples,
+        "note": "torch reference train step timed on host CPU (no GPU in env; "
+        "reference publishes no numbers)",
+    }
+    with open(out_path, "w") as f:
+        json.dump(payload, f, indent=2)
+    print(json.dumps(payload))
+
+
+if __name__ == "__main__":
+    main()
