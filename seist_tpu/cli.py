@@ -1,0 +1,192 @@
+"""CLI entry point — flag-compatible with the reference's ``main.py:8-179``.
+
+Same ~60 flags, same modes (train / test / train_test), same log-dir layout.
+TPU-specific deltas: ``--device`` is gone (JAX owns device placement; the
+mesh covers every visible chip), torch-compile flags are gone (jit is always
+on), and multi-host init uses ``jax.distributed`` instead of torchrun env
+vars (seist_tpu/parallel/dist.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional
+
+from seist_tpu.utils.logger import logger
+from seist_tpu.utils.misc import dump_namespace, get_time_str, setup_seed
+
+
+def bool_(x) -> bool:
+    return (
+        False
+        if str(x).strip().lower() in ("0", "false", "f", "no", "n")
+        else bool(x)
+    )
+
+
+def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        description="seist_tpu model training/testing arguments"
+    )
+
+    # Mode
+    parser.add_argument("--mode", type=str, default="train_test",
+                        help="train/test/train_test (default:'train_test')")
+
+    # Model
+    parser.add_argument("--model-name", default="seist_m_dpk", type=str)
+    parser.add_argument("--checkpoint", default="", type=str,
+                        help="path to latest checkpoint (default: none)")
+
+    # Random seed
+    parser.add_argument("--seed", default=0, type=int)
+
+    # Logs
+    parser.add_argument("--log-base", default="./logs", type=str)
+    parser.add_argument("--log-step", default=4, type=int)
+    parser.add_argument("--use-tensorboard", default=True, type=bool_)
+
+    # Save results
+    parser.add_argument("--save-test-results", default=True, type=bool_)
+
+    # Dataset
+    parser.add_argument("--data", default="", type=str, help="path to dataset")
+    parser.add_argument("--dataset-name", default="diting_light", type=str,
+                        help="'diting', 'diting_light', 'pnw', 'pnw_light', "
+                        "'sos' or 'synthetic'")
+    parser.add_argument("--data-split", type=bool_, default=True)
+    parser.add_argument("--train-size", type=float, default=0.8)
+    parser.add_argument("--val-size", type=float, default=0.1)
+
+    # Data loader
+    parser.add_argument("--shuffle", type=bool_, default=True)
+    parser.add_argument("--workers", default=8, type=int)
+
+    # Data preprocess
+    parser.add_argument("--in-samples", default=8192, type=int)
+    parser.add_argument("--label-width", type=float, default=0.5,
+                        help="width of soft label (seconds)")
+    parser.add_argument("--label-shape", type=str, default="gaussian",
+                        help="'gaussian' 'triangle' 'box' or 'sigmoid'")
+    parser.add_argument("--coda-ratio", default=2.0, type=float)
+    parser.add_argument("--norm-mode", default="std", type=str)
+    parser.add_argument("--min-snr", type=float, default=-float("inf"))
+    parser.add_argument("--p-position-ratio", type=float, default=-1)
+
+    # Data augmentation
+    parser.add_argument("--augmentation", type=bool_, default=True)
+    parser.add_argument("--add-event-rate", default=0.0, type=float)
+    parser.add_argument("--max-event-num", default=1, type=int)
+    parser.add_argument("--shift-event-rate", default=0.2, type=float)
+    parser.add_argument("--add-noise-rate", default=0.4, type=float)
+    parser.add_argument("--add-gap-rate", default=0.4, type=float)
+    parser.add_argument("--min-event-gap", default=0.5, type=float,
+                        help="minimum event gap (seconds)")
+    parser.add_argument("--drop-channel-rate", default=0.4, type=float)
+    parser.add_argument("--scale-amplitude-rate", default=0.4, type=float)
+    parser.add_argument("--pre-emphasis-rate", default=0.4, type=float)
+    parser.add_argument("--pre-emphasis-ratio", default=0.97, type=float)
+    parser.add_argument("--generate-noise-rate", default=0.05, type=float)
+    parser.add_argument("--mask-percent", default=0, type=int)
+    parser.add_argument("--noise-percent", default=0, type=int)
+
+    # Train
+    parser.add_argument("--epochs", default=200, type=int)
+    parser.add_argument("--patience", default=30, type=int)
+    parser.add_argument("--steps", default=0, type=int,
+                        help="if steps > 0, epochs is ignored")
+    parser.add_argument("--start-epoch", default=0, type=int)
+    parser.add_argument("--batch-size", default=500, type=int,
+                        help="per-host batch size")
+    parser.add_argument("--optim", default="Adam", type=str)
+    parser.add_argument("--momentum", default=0.9, type=float)
+    parser.add_argument("--weight_decay", default=0.0, type=float)
+    parser.add_argument("--use-lr-scheduler", default=True, type=bool_)
+    parser.add_argument("--lr-scheduler-mode", default="exp_range", type=str,
+                        help="'triangular', 'triangular2' or 'exp_range'")
+    parser.add_argument("--base-lr", default=8e-5, type=float)
+    parser.add_argument("--max-lr", default=1e-3, type=float)
+    parser.add_argument("--warmup-steps", default=2000, type=float,
+                        help="<1 means ratio of total steps")
+    parser.add_argument("--down-steps", default=3000, type=float,
+                        help="<1 means ratio of total steps")
+
+    # Val/Test
+    parser.add_argument("--time-threshold", default=0.1, type=float,
+                        help="pick residual threshold (seconds)")
+    parser.add_argument("--min-peak-dist", default=1.0, type=float,
+                        help="minimum peak distance (seconds)")
+    parser.add_argument("--ppk-threshold", default=0.3, type=float)
+    parser.add_argument("--spk-threshold", default=0.3, type=float)
+    parser.add_argument("--det-threshold", default=0.5, type=float)
+    parser.add_argument("--max-detect-event-num", default=1, type=int)
+
+    # Synthetic-dataset shortcuts (no reference analogue; synthetic only)
+    parser.add_argument("--synthetic-events", default=0, type=int,
+                        help="synthetic dataset size (0 = default)")
+
+    args = parser.parse_args(argv)
+
+    if not 0 <= args.p_position_ratio <= 1:
+        args.p_position_ratio = -1
+
+    args.log_base = os.path.abspath(args.log_base)
+    if args.data:
+        args.data = os.path.abspath(args.data)
+    if args.checkpoint:
+        args.checkpoint = os.path.abspath(args.checkpoint)
+
+    args.dataset_kwargs = None
+    if args.dataset_name == "synthetic" and args.synthetic_events:
+        args.dataset_kwargs = {"num_events": args.synthetic_events}
+    return args
+
+
+def main_worker(args: argparse.Namespace) -> None:
+    """Mode dispatch (ref main.py:182-210)."""
+    from seist_tpu.train.worker import is_main_process, test_worker, train_worker
+
+    log_dir = (
+        os.path.join(
+            args.log_base,
+            f"{get_time_str()}_{args.model_name}_{args.dataset_name}",
+        )
+        if not args.checkpoint
+        else args.checkpoint.split("checkpoints")[0]
+    )
+    logger.set_logdir(log_dir)
+    logger.set_logger("global")
+    if not is_main_process():
+        logger.enable_console(False)
+    logger.info(f"pid: {os.getpid()}")
+    logger.info(f"\n{dump_namespace(args)}")
+
+    mode = args.mode.split("_")
+    if not set(("train", "test")) & set(mode):
+        raise ValueError(
+            f"`mode` must be 'train','test' or 'train_test', got '{args.mode}'"
+        )
+    if "train" in mode:
+        setup_seed(args.seed)
+        logger.set_logger("train")
+        ckpt_path = train_worker(args)
+        args.checkpoint = ckpt_path
+    if "test" in mode:
+        setup_seed(args.seed)
+        logger.set_logger("test")
+        test_worker(args)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import seist_tpu
+    from seist_tpu.parallel.dist import init_distributed_mode
+
+    args = get_args(argv)
+    args.distributed = init_distributed_mode()
+    seist_tpu.load_all()
+    main_worker(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,3 +1,12 @@
 """Datasets + preprocessing. Importing this package registers all datasets."""
 
 from seist_tpu.data.preprocess import DataPreprocessor, pad_array, pad_phases  # noqa: F401
+from seist_tpu.data.base import DatasetBase  # noqa: F401
+from seist_tpu.data import diting, pnw, sos, synthetic  # noqa: F401  (registration)
+from seist_tpu.data.pipeline import (  # noqa: F401
+    Batch,
+    Loader,
+    SeismicDataset,
+    from_task_spec,
+    prefetch_to_device,
+)

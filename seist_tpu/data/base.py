@@ -1,0 +1,107 @@
+"""Dataset base class: metadata loading + seeded shuffle/split.
+
+Counterpart of the reference's ``datasets/base.py:5-90``: a dataset owns a
+pandas metadata table and lazily reads one event's waveform + labels per
+``__getitem__``. The seeded shuffle-then-contiguous-split contract
+(ref diting.py:99-116) is hoisted here so every subclass shares it — the
+same seed must yield the same split across train and later test runs
+(ref README.md:226 warning).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Tuple
+
+import pandas as pd
+
+from seist_tpu.utils.logger import logger
+
+Event = Dict[str, Any]
+
+
+class DatasetBase:
+    _name: str = ""
+    _part_range: Optional[tuple] = None
+    _channels: list = []
+    _sampling_rate: int = 0
+
+    def __init__(
+        self,
+        seed: int,
+        mode: str,
+        data_dir: str,
+        shuffle: bool = True,
+        data_split: bool = True,
+        train_size: float = 0.8,
+        val_size: float = 0.1,
+        **kwargs,
+    ):
+        self._seed = seed
+        mode = mode.lower()
+        if mode not in ("train", "val", "test"):
+            raise ValueError(f"mode must be train/val/test, got '{mode}'")
+        self._mode = mode
+        self._data_dir = data_dir
+        self._shuffle = shuffle
+        self._data_split = data_split
+        if train_size + val_size >= 1.0:
+            raise ValueError(f"train_size:{train_size}, val_size:{val_size}")
+        self._train_size = train_size
+        self._val_size = val_size
+        self._meta_data = self._load_meta_data()
+
+    # -- subclass hooks ------------------------------------------------------
+    def _load_meta_data(self) -> pd.DataFrame:
+        raise NotImplementedError
+
+    def _load_event_data(self, idx: int) -> Tuple[Event, dict]:
+        raise NotImplementedError
+
+    # -- shared split logic --------------------------------------------------
+    def _shuffle_and_split(self, meta_df: pd.DataFrame) -> pd.DataFrame:
+        """Seeded full-frame shuffle, then contiguous train/val/test ranges
+        (ref base.py:42, diting.py:99-116)."""
+        if self._shuffle:
+            meta_df = meta_df.sample(frac=1, replace=False, random_state=self._seed)
+        meta_df = meta_df.reset_index(drop=True)
+        if self._data_split:
+            n = meta_df.shape[0]
+            t_end = int(self._train_size * n)
+            v_end = t_end + int(self._val_size * n)
+            lo, hi = {
+                "train": (0, t_end),
+                "val": (t_end, v_end),
+                "test": (v_end, n),
+            }[self._mode]
+            meta_df = meta_df.iloc[lo:hi, :]
+            logger.info(f"Data Split: {self._mode}: {lo}-{hi}")
+        return meta_df
+
+    # -- public API (ref base.py:67-90) --------------------------------------
+    def __len__(self) -> int:
+        return len(self._meta_data)
+
+    def __getitem__(self, idx: int) -> Tuple[Event, dict]:
+        return self._load_event_data(idx=idx)
+
+    def __repr__(self) -> str:
+        return (
+            f"Dataset(name:{self._name}, part_range:{self._part_range}, "
+            f"channels:{self._channels}, sampling_rate:{self._sampling_rate}, "
+            f"data_dir:{self._data_dir}, shuffle:{self._shuffle}, "
+            f"data_split:{self._data_split}, train_size:{self._train_size}, "
+            f"val_size:{self._val_size})"
+        )
+
+    @classmethod
+    def name(cls) -> str:
+        return cls._name
+
+    @classmethod
+    def sampling_rate(cls) -> int:
+        return cls._sampling_rate
+
+    @classmethod
+    def channels(cls) -> list:
+        return copy.deepcopy(cls._channels)

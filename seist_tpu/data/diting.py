@@ -1,0 +1,179 @@
+"""DiTing dataset reader (ref datasets/diting.py:23-324).
+
+DiTing [Zhao et al. 2023, doi:10.1016/j.eqs.2022.01.022]: 28 CSV+HDF5 parts,
+3-channel 50 Hz waveforms. Format quirks preserved from the reference:
+
+* trace keys are ``<evid>.<suffix>`` zero-padded to 6/4 digits before the
+  HDF5 lookup (ref diting.py:136-137);
+* magnitudes are converted to ML — ms: (m+1.08)/1.13, mb: (1.17m+0.67)/1.13 —
+  then clipped to [0, 8] (ref diting.py:183-197);
+* polarity u/c -> 0, r/d -> 1; clarity 'i' -> 0 else 1; baz %= 360
+  (ref diting.py:174-181).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+import pandas as pd
+
+from seist_tpu.data.base import DatasetBase, Event
+from seist_tpu.registry import register_dataset
+
+_META_DTYPES = {
+    "part": np.int64,
+    "key": str,
+    "ev_id": np.int64,
+    "mag_type": str,
+    "p_pick": np.int64,
+    "p_clarity": str,
+    "p_motion": str,
+    "s_pick": np.int64,
+    "net": str,
+    "sta_id": np.int64,
+    "dis": np.float32,
+    **{
+        f"{c}_{ph}_{kind}_snr": np.float32
+        for c in "ZNE"
+        for ph in "PS"
+        for kind in ("amplitude", "power")
+    },
+}
+
+
+def convert_to_ml(mag: float, mag_type: str) -> float:
+    """Magnitude-type conversion to ML (ref diting.py:183-197)."""
+    mag_type = mag_type.lower()
+    if mag_type == "ms":
+        return (mag + 1.08) / 1.13
+    if mag_type == "mb":
+        return (1.17 * mag + 0.67) / 1.13
+    if mag_type == "ml":
+        return mag
+    raise ValueError(f"Unknown 'mag_type' : '{mag_type}'")
+
+
+def normalize_key(key: str) -> str:
+    """Zero-pad the two halves of a DiTing trace key (ref diting.py:136-137)."""
+    head, tail = key.split(".")
+    return head.rjust(6, "0") + "." + tail.ljust(4, "0")
+
+
+class DiTing(DatasetBase):
+    _name = "diting"
+    _part_range = (0, 28)  # (inclusive, exclusive)
+    _channels = ["z", "n", "e"]
+    _sampling_rate = 50
+
+    # In the full release evmag/st_mag/baz arrive as strings with stray
+    # spaces (ref diting.py:62-72 dtype map + :95-97 space strip).
+    _string_numeric_cols = ("evmag", "st_mag", "baz")
+
+    def _read_csvs(self) -> pd.DataFrame:
+        start, end = self._part_range
+        dtypes = dict(_META_DTYPES)
+        for col in self._string_numeric_cols:
+            dtypes[col] = str
+        dtypes.update({"P_residual": str, "S_residual": str})
+        frames = [
+            pd.read_csv(
+                os.path.join(self._data_dir, f"DiTing330km_part_{i}.csv"),
+                dtype=dtypes,
+                low_memory=False,
+                index_col=0,
+            )
+            for i in range(start, end)
+        ]
+        return pd.concat(frames)
+
+    def _load_meta_data(self) -> pd.DataFrame:
+        meta_df = self._read_csvs()
+        for k in meta_df.columns:
+            if meta_df[k].dtype == object:
+                meta_df[k] = meta_df[k].str.replace(" ", "")
+        return self._shuffle_and_split(meta_df)
+
+    def _load_event_data(self, idx: int) -> Tuple[Event, dict]:
+        row = self._meta_data.iloc[idx]
+        key = normalize_key(str(row["key"]))
+        path = os.path.join(self._data_dir, f"DiTing330km_part_{row['part']}.hdf5")
+
+        import h5py
+
+        with h5py.File(path, "r") as f:
+            data = np.array(f.get("earthquake/" + key)).astype(np.float32).T
+
+        motion = row["p_motion"]
+        if pd.notnull(motion) and str(motion).lower() not in ("", "n"):
+            motion = {"u": 0, "c": 0, "r": 1, "d": 1}[str(motion).lower()]
+        clarity = row["p_clarity"]
+        if pd.notnull(clarity):
+            clarity = 0 if str(clarity).lower() == "i" else 1
+        baz = row["baz"]
+        if pd.notnull(baz):
+            baz = float(baz) % 360
+
+        evmag, stmag = row["evmag"], row["st_mag"]
+        if pd.notnull(evmag):
+            evmag = np.clip(
+                convert_to_ml(float(evmag), row["mag_type"]), 0, 8
+            ).astype(np.float32)
+        if pd.notnull(stmag):
+            stmag = np.clip(
+                convert_to_ml(float(stmag), row["mag_type"]), 0, 8
+            ).astype(np.float32)
+
+        snr = np.array(
+            [row["Z_P_power_snr"], row["N_S_power_snr"], row["E_S_power_snr"]]
+        )
+        event: Event = {
+            "data": data,
+            "ppks": [row["p_pick"]] if pd.notnull(row["p_pick"]) else [],
+            "spks": [row["s_pick"]] if pd.notnull(row["s_pick"]) else [],
+            "emg": [evmag] if pd.notnull(row["evmag"]) else [],
+            "smg": [stmag] if pd.notnull(row["st_mag"]) else [],
+            "pmp": [motion] if pd.notnull(motion) else [],
+            "clr": [clarity] if pd.notnull(clarity) else [],
+            "baz": [baz] if pd.notnull(baz) else [],
+            "dis": [row["dis"]] if pd.notnull(row["dis"]) else [],
+            "snr": snr,
+        }
+        return event, row.to_dict()
+
+
+class DiTingLight(DiTing):
+    """Single-CSV "light" release with numeric columns (ref diting.py:217-311)."""
+
+    _name = "diting_light"
+    _part_range = None
+    _string_numeric_cols = ()
+
+    def _read_csvs(self) -> pd.DataFrame:
+        dtypes = dict(_META_DTYPES)
+        dtypes.update(
+            {
+                "evmag": np.float32,
+                "st_mag": np.float32,
+                "baz": np.float32,
+                "P_residual": np.float32,
+                "S_residual": np.float32,
+            }
+        )
+        return pd.read_csv(
+            os.path.join(self._data_dir, "DiTing330km_light.csv"),
+            dtype=dtypes,
+            low_memory=False,
+            index_col=0,
+        )
+
+
+@register_dataset
+def diting(**kwargs):
+    return DiTing(**kwargs)
+
+
+@register_dataset
+def diting_light(**kwargs):
+    return DiTingLight(**kwargs)

@@ -1,0 +1,318 @@
+"""Input pipeline: dataset + preprocessor -> sharded device batches.
+
+TPU-native redesign of the reference's torch ``Dataset``/``DataLoader``/
+``DistributedSampler`` stack (training/preprocess.py:824-953,
+train.py:221-247):
+
+* :class:`SeismicDataset` — composes an L2 dataset reader with the
+  ``DataPreprocessor``; same io contract as the reference adapter
+  (inputs, loss_targets, metrics_targets, meta json) including the
+  2x-epoch augmentation rule — raw copy for ``idx < size``, augmented for
+  ``idx >= size`` (ref preprocess.py:918-937). Every sample's RNG is
+  ``default_rng((seed, epoch, idx))`` — reproducible regardless of worker
+  scheduling (the reference relies on global numpy state per worker).
+* :class:`Loader` — per-epoch seeded shuffle, per-host contiguous sharding
+  (the ``DistributedSampler`` equivalent: each host reads only its slice),
+  thread-pool batch assembly (h5py/numpy release the GIL for the heavy
+  parts), fixed batch shapes (``drop_last`` on train; tail batch padded and
+  masked on eval so jit never retraces).
+* :func:`prefetch_to_device` — double-buffered ``jax.device_put`` with a
+  ``NamedSharding`` so host->HBM copy of batch N+1 overlaps the step on N
+  (replaces torch ``pin_memory`` + H2D copies at train.py:77-84).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from seist_tpu import taskspec
+from seist_tpu.data.preprocess import DataPreprocessor
+from seist_tpu.registry import DATASETS
+from seist_tpu.utils.logger import logger
+
+Batch = collections.namedtuple(
+    "Batch", ["inputs", "loss_targets", "metrics_targets", "meta", "mask"]
+)
+
+
+class SeismicDataset:
+    """Dataset reader + preprocessing -> one training example
+    (ref preprocess.py:824-953)."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        mode: str,
+        *,
+        seed: int,
+        data_dir: str = "",
+        input_names: Sequence = (),
+        label_names: Sequence = (),
+        task_names: Sequence[str] = (),
+        in_samples: int = 8192,
+        augmentation: bool = False,
+        shuffle: bool = True,
+        data_split: bool = True,
+        train_size: float = 0.8,
+        val_size: float = 0.1,
+        max_event_num: int = 1,
+        dataset_kwargs: Optional[dict] = None,
+        **preprocessor_kwargs,
+    ) -> None:
+        self._seed = int(seed)
+        self._mode = mode.lower()
+        self._input_names = list(input_names)
+        self._label_names = list(label_names)
+        self._task_names = list(task_names)
+        self._max_event_num = max_event_num
+        self._epoch = 0
+
+        # val/test never augment (ref preprocess.py:858-860).
+        self._augmentation = bool(augmentation) and self._mode == "train"
+        if self._augmentation != bool(augmentation):
+            logger.warning(f"[{self._mode}] Augmentation -> {self._augmentation}")
+
+        self._dataset = DATASETS.create(
+            dataset_name,
+            seed=self._seed,
+            mode=self._mode,
+            data_dir=data_dir,
+            shuffle=shuffle,
+            data_split=data_split,
+            train_size=train_size,
+            val_size=val_size,
+            **(dataset_kwargs or {}),
+        )
+        logger.info(repr(self._dataset))
+        self._dataset_size = len(self._dataset)
+        if self._augmentation:
+            logger.warning(
+                f"Data augmentation: Dataset size -> {self._dataset_size * 2}"
+            )
+
+        label_width_sec = preprocessor_kwargs.pop("label_width", 0.5)
+        self._preprocessor = DataPreprocessor(
+            data_channels=self._dataset.channels(),
+            sampling_rate=self._dataset.sampling_rate(),
+            in_samples=in_samples,
+            max_event_num=max_event_num,
+            soft_label_width=int(label_width_sec * self._dataset.sampling_rate()),
+            **preprocessor_kwargs,
+        )
+
+    @property
+    def preprocessor(self) -> DataPreprocessor:
+        return self._preprocessor
+
+    def sampling_rate(self) -> int:
+        return self._dataset.sampling_rate()
+
+    def data_channels(self) -> list:
+        return self._dataset.channels()
+
+    def name(self) -> str:
+        return f"{self._dataset.name()}_{self._mode}"
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance the per-sample RNG stream (the reference reshuffles via
+        ``DistributedSampler.set_epoch``, train.py:381-382)."""
+        self._epoch = int(epoch)
+
+    def __len__(self) -> int:
+        # Augmentation doubles the epoch (ref preprocess.py:918-922).
+        return 2 * self._dataset_size if self._augmentation else self._dataset_size
+
+    def __getitem__(self, idx: int) -> Tuple[Any, Any, Dict[str, np.ndarray], str]:
+        event, meta_data = self._dataset[idx % self._dataset_size]
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self._seed, self._epoch, int(idx)])
+        )
+        event = self._preprocessor.process(
+            event=event,
+            augmentation=(self._augmentation and idx >= self._dataset_size),
+            rng=rng,
+        )
+        inputs = self._preprocessor.get_inputs(event, self._input_names)
+        loss_targets = self._preprocessor.get_targets_for_loss(
+            event, self._label_names
+        )
+        metrics_targets = self._preprocessor.get_targets_for_metrics(
+            event, max_event_num=self._max_event_num, task_names=self._task_names
+        )
+        meta_json = json.dumps({k: str(v) for k, v in dict(meta_data).items()})
+        return inputs, loss_targets, metrics_targets, meta_json
+
+
+def from_task_spec(
+    spec: taskspec.TaskSpec,
+    dataset_name: str,
+    mode: str,
+    **kwargs,
+) -> SeismicDataset:
+    """Build a :class:`SeismicDataset` wired to a model's task spec
+    (inputs/labels/eval lists; ref train.py:199-217)."""
+    return SeismicDataset(
+        dataset_name,
+        mode,
+        input_names=[
+            list(g) if isinstance(g, (tuple, list)) else g for g in spec.inputs
+        ],
+        label_names=[
+            list(g) if isinstance(g, (tuple, list)) else g for g in spec.labels
+        ],
+        task_names=list(spec.eval),
+        **kwargs,
+    )
+
+
+def _stack(samples: List[Any]) -> Any:
+    """Stack a list of per-sample structures (arrays / tuples of arrays)."""
+    first = samples[0]
+    if isinstance(first, tuple):
+        return tuple(
+            np.stack([s[i] for s in samples]) for i in range(len(first))
+        )
+    return np.stack(samples)
+
+
+class Loader:
+    """Host-side batch loader with per-host sharding and fixed shapes.
+
+    Each epoch: seeded global permutation -> this host's interleaved slice ->
+    fixed-size batches assembled by a thread pool. Train drops the global
+    tail (every host sees the same number of steps — the collective-sync
+    equivalent of ``drop_last``); eval pads the final batch and sets
+    ``Batch.mask`` zeros on padding rows.
+    """
+
+    def __init__(
+        self,
+        dataset: SeismicDataset,
+        batch_size: int,
+        *,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        num_workers: int = 8,
+        seed: int = 0,
+        num_shards: int = 1,
+        shard_index: int = 0,
+    ) -> None:
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.num_workers = max(1, num_workers)
+        self.seed = seed
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+        self.dataset.set_epoch(epoch)
+
+    def _indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.seed, self.epoch])
+            )
+            order = rng.permutation(n)
+        else:
+            order = np.arange(n)
+        # Interleaved host shard (DistributedSampler-style: rank::world).
+        return order[self.shard_index :: self.num_shards]
+
+    def __len__(self) -> int:
+        n = len(self._indices())
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Batch]:
+        indices = self._indices()
+        nb = len(self)
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            for b in range(nb):
+                chunk = indices[b * self.batch_size : (b + 1) * self.batch_size]
+                pad = self.batch_size - len(chunk)
+                if pad:
+                    chunk = np.concatenate([chunk, np.repeat(chunk[-1], pad)])
+                samples = list(pool.map(self.dataset.__getitem__, chunk))
+                inputs = _stack([s[0] for s in samples])
+                loss_targets = _stack([s[1] for s in samples])
+                metrics_targets = {
+                    k: np.stack([s[2][k] for s in samples])
+                    for k in samples[0][2]
+                }
+                meta = [s[3] for s in samples]
+                mask = np.ones(self.batch_size, dtype=np.float32)
+                if pad:
+                    mask[-pad:] = 0.0
+                yield Batch(inputs, loss_targets, metrics_targets, meta, mask)
+
+
+def prefetch_to_device(
+    iterator: Iterator[Batch],
+    mesh=None,
+    prefetch: int = 2,
+) -> Iterator[Batch]:
+    """Double-buffered host->device transfer of Batch arrays.
+
+    Arrays are ``device_put`` with the batch axis sharded over the mesh's
+    ``data`` axis (XLA overlaps the copy with the running step); ``meta``
+    stays on host. With ``mesh=None`` batches pass through untouched.
+    """
+    if mesh is None:
+        yield from iterator
+        return
+
+    import jax
+
+    from seist_tpu.parallel.mesh import shard_batch
+
+    def put(batch: Batch) -> Batch:
+        def _put(x):
+            # shard_batch holds the single placement rule (device_put vs
+            # make_array_from_process_local_data on multi-host).
+            return shard_batch(mesh, x) if isinstance(x, np.ndarray) else x
+
+        return Batch(
+            jax.tree.map(_put, batch.inputs),
+            jax.tree.map(_put, batch.loss_targets),
+            {k: _put(v) for k, v in batch.metrics_targets.items()},
+            batch.meta,
+            _put(batch.mask),
+        )
+
+    buf: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    sentinel = object()
+    err: List[BaseException] = []
+
+    def producer():
+        try:
+            for item in iterator:
+                buf.put(put(item))
+        except BaseException as e:  # propagate loader errors to the consumer
+            err.append(e)
+        finally:
+            buf.put(sentinel)
+
+    thread = threading.Thread(target=producer, daemon=True)
+    thread.start()
+    while True:
+        item = buf.get()
+        if item is sentinel:
+            if err:
+                raise err[0]
+            return
+        yield item

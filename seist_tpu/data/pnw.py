@@ -1,0 +1,101 @@
+"""PNW dataset reader (ref datasets/pnw.py:23-201).
+
+Curated Pacific Northwest AI-ready Seismic Dataset [Ni et al. 2023,
+doi:10.26443/seismica.v2i1.368]: ComCat CSV metadata + bucketed HDF5
+waveforms, 3-channel 100 Hz, channel order ``["e", "n", "z"]``. Quirks:
+
+* trace refs are ``"bucket$n,:c,:l"`` — bucket dataset name plus the row
+  index into it (ref pnw.py:102-104);
+* P polarity maps positive/negative/undecidable/"" -> 0/1/2/3
+  (ref pnw.py:131);
+* ``trace_snr_db`` is a '|'-separated triple, NaN entries -> 0
+  (ref pnw.py:136-138); NaNs in waveforms are zeroed (ref pnw.py:110).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+import pandas as pd
+
+from seist_tpu.data.base import DatasetBase, Event
+from seist_tpu.registry import register_dataset
+
+
+def parse_trace_name(trace_name: str) -> Tuple[str, int]:
+    """``"bucket3$42,:3,:15001"`` -> ("bucket3", 42) (ref pnw.py:102-104)."""
+    bucket, array = trace_name.split("$")
+    n = int(array.split(",:")[0])
+    return bucket, n
+
+
+class PNW(DatasetBase):
+    _name = "pnw"
+    _part_range = None
+    _channels = ["e", "n", "z"]
+    _sampling_rate = 100
+
+    _meta_filename = "comcat_metadata.csv"
+
+    def _load_meta_data(self) -> pd.DataFrame:
+        meta_df = pd.read_csv(
+            os.path.join(self._data_dir, self._meta_filename), low_memory=False
+        )
+        for k in meta_df.columns:
+            if meta_df[k].dtype in (np.dtype("float"), np.dtype("int")):
+                meta_df[k] = meta_df[k].fillna(0)
+            elif meta_df[k].dtype == object:
+                meta_df[k] = meta_df[k].str.replace(" ", "").fillna("")
+        return self._shuffle_and_split(meta_df)
+
+    def _load_event_data(self, idx: int) -> Tuple[Event, dict]:
+        row = self._meta_data.iloc[idx]
+        bucket, n = parse_trace_name(row["trace_name"])
+
+        import h5py
+
+        path = os.path.join(self._data_dir, "comcat_waveforms.hdf5")
+        with h5py.File(path, "r") as f:
+            data = np.nan_to_num(np.array(f.get(f"data/{bucket}")[n], dtype=np.float32))
+
+        motion = {"positive": 0, "negative": 1, "undecidable": 2, "": 3}[
+            str(row["trace_P_polarity"]).lower()
+        ]
+        mag_type = str(row["preferred_source_magnitude_type"]).lower()
+        if mag_type != "ml":
+            raise AssertionError(f"PNW magnitudes must be ml, got '{mag_type}'")
+        evmag = np.clip(row["preferred_source_magnitude"], 0, 8).astype(np.float32)
+        snrs = [s.strip() for s in str(row["trace_snr_db"]).split("|")]
+        snr = np.array([float(s) if s != "nan" else 0.0 for s in snrs])
+
+        ppk = row["trace_P_arrival_sample"]
+        spk = row["trace_S_arrival_sample"]
+        event: Event = {
+            "data": data,
+            "ppks": [ppk] if pd.notnull(ppk) else [],
+            "spks": [spk] if pd.notnull(spk) else [],
+            "emg": [evmag] if pd.notnull(evmag) else [],
+            "pmp": [motion],
+            "clr": [0],  # compatibility with other datasets (ref pnw.py:146)
+            "snr": snr,
+        }
+        return event, row.to_dict()
+
+
+class PNWLight(PNW):
+    """PNW with undecidable-polarity events removed (ref pnw.py:153-188)."""
+
+    _name = "pnw_light"
+    _meta_filename = "comcat_metadata_light.csv"
+
+
+@register_dataset
+def pnw(**kwargs):
+    return PNW(**kwargs)
+
+
+@register_dataset
+def pnw_light(**kwargs):
+    return PNWLight(**kwargs)

@@ -1,0 +1,58 @@
+"""SOS dataset reader (ref datasets/sos.py:11-91).
+
+Single-channel 500 Hz waveforms stored as one ``.npz`` per trace, already
+split on disk into ``train/ val/ test/`` subdirectories each holding an
+``_all_label.csv`` index — so ``data_split`` is ignored (ref sos.py:43-46).
+The reference's attribute bugs (``self.data_dir``/``self.mode`` without
+underscore, sos.py:71) are fixed here, per SURVEY.md Appendix A.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+import pandas as pd
+
+from seist_tpu.data.base import DatasetBase, Event
+from seist_tpu.registry import register_dataset
+from seist_tpu.utils.logger import logger
+from seist_tpu.utils.misc import cal_snr
+
+
+class SOS(DatasetBase):
+    _name = "sos"
+    _part_range = None
+    _channels = ["z"]
+    _sampling_rate = 500
+
+    def __init__(self, *, data_split: bool = False, **kwargs):
+        super().__init__(data_split=data_split, **kwargs)
+
+    def _load_meta_data(self) -> pd.DataFrame:
+        if self._data_split:
+            logger.warning(
+                "dataset 'sos' is pre-split on disk; 'data_split' is ignored."
+            )
+        csv_path = os.path.join(self._data_dir, self._mode, "_all_label.csv")
+        return pd.read_csv(csv_path, dtype={"fname": str, "itp": int, "its": int})
+
+    def _load_event_data(self, idx: int) -> Tuple[Event, dict]:
+        row = self._meta_data.iloc[idx]
+        fpath = os.path.join(self._data_dir, self._mode, row["fname"])
+        npz = np.load(fpath)
+        data = np.stack(npz["data"].astype(np.float32), axis=1)
+        ppk, spk = int(row["itp"]), int(row["its"])
+        event: Event = {
+            "data": data,
+            "ppks": [ppk] if ppk > 0 else [],
+            "spks": [spk] if spk > 0 else [],
+            "snr": cal_snr(data=data, pat=ppk) if ppk > 0 else 0.0,
+        }
+        return event, row.to_dict()
+
+
+@register_dataset
+def sos(**kwargs):
+    return SOS(**kwargs)

@@ -152,6 +152,16 @@ class CombinationLoss:
             self.losses_weights = [1.0] * len(losses)
         self.losses = [L() for L in losses]
 
+    @property
+    def reduction(self) -> str:
+        """'sum' if any component is sum-reduced (a weighted sum of sums is
+        still a sum over the batch), else 'mean'."""
+        return (
+            "sum"
+            if any(getattr(fn, "reduction", "mean") == "sum" for fn in self.losses)
+            else "mean"
+        )
+
     def __call__(self, preds: Tuple[Array, ...], targets: Tuple[Array, ...]) -> Array:
         total = 0.0
         for pred, target, loss_fn, w in zip(
@@ -163,7 +173,14 @@ class CombinationLoss:
 
 class MousaviLoss:
     """Heteroscedastic regression loss for MagNet / dist-PT
-    (ref: loss.py:193-210). ``preds`` is ``(N, 2)``: (y_hat, log sigma^2)."""
+    (ref: loss.py:193-210). ``preds`` is ``(N, 2)``: (y_hat, log sigma^2).
+
+    Sum-reduced over the batch (matching the reference's ``torch.sum``) —
+    consumers that decompose losses per-sample (the masked eval step) check
+    ``reduction`` to pick the right recombination.
+    """
+
+    reduction = "sum"
 
     def __call__(self, preds: Array, targets: Array) -> Array:
         y_hat = preds[:, 0].reshape(-1, 1)

@@ -1,0 +1,16 @@
+"""On-device output processing: picking, event detection, metrics.
+
+The reference runs these on host inside the train loop (a per-trace
+numpy/obspy loop at training/postprocess.py:129,181 — hot-loop #2 in
+SURVEY.md §3). Here they are fixed-shape vectorized XLA ops so eval math
+stays on device and fuses into the jitted step.
+"""
+
+from seist_tpu.ops.postprocess import (  # noqa: F401
+    detect_events,
+    pick_peaks,
+    process_outputs,
+    PAD_VALUE,
+)
+from seist_tpu.ops.metrics import Metrics, batch_counters, finalize, merge  # noqa: F401
+from seist_tpu.ops.results import ResultSaver  # noqa: F401

@@ -1,0 +1,97 @@
+"""Test-time result accumulation -> CSV (ref training/postprocess.py:253-338).
+
+``ResultSaver`` collects per-batch meta data, targets and processed results
+and writes one CSV with ``<meta>``, ``pred_<task>`` and ``tgt_<task>``
+columns — the same file contract as the reference's
+``test_results_<dataset>.csv`` (validate.py:129-131).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import defaultdict
+from typing import Any, Dict, List, Sequence, Tuple, Union
+
+import numpy as np
+
+from seist_tpu import taskspec
+from seist_tpu.utils.logger import logger
+
+
+class ResultSaver:
+    def __init__(self, item_names: Sequence[str]):
+        self._item_names = list(item_names)
+        self._results_dict: Dict[str, list] = defaultdict(list)
+        self._warned_unknown = False
+
+    @staticmethod
+    def _to_list(v: Any) -> list:
+        if isinstance(v, (np.ndarray,)) or hasattr(v, "__array__"):
+            v = np.asarray(v).tolist()
+        if not isinstance(v, list):
+            raise TypeError(f"Unknown data type: {type(v)}")
+        return v
+
+    def _convert_type(self, v: Any) -> list:
+        """Flatten nested per-row lists to CSV-friendly cells
+        (ref postprocess.py:258-274): [] -> '', [x] -> x, [a,b] -> 'a,b'."""
+        v = self._to_list(v)
+        for i in range(len(v)):
+            if isinstance(v[i], list):
+                if len(v[i]) == 0:
+                    v[i] = ""
+                elif len(v[i]) == 1:
+                    v[i] = v[i][0]
+                else:
+                    v[i] = ",".join(str(x) for x in v[i])
+        return v
+
+    def _process_item(self, k: str, v: Any, prefix: str = "") -> Tuple[str, Any]:
+        """One-hot -> argmax index; strip ppk/spk padding (> 0 kept)
+        (ref postprocess.py:276-289)."""
+        if k in taskspec.IO_ITEMS and taskspec.get_kind(k) == taskspec.ONEHOT:
+            v = np.argmax(np.asarray(v), axis=-1)
+        if k in ("ppk", "spk"):
+            v = self._to_list(v)
+            v = [[x for x in row if x > 0] for row in v]
+        return f"{prefix}{k}", v
+
+    def append(
+        self,
+        batch_meta_data: Dict[str, list],
+        targets: Dict[str, Any],
+        results: Dict[str, Any],
+    ) -> None:
+        """Append one batch of rows (ref postprocess.py:291-329)."""
+        assert isinstance(batch_meta_data, dict), f"{type(batch_meta_data)}"
+        known = set(results) | set(targets)
+        unknown = known - set(self._item_names)
+        missing = set(self._item_names) - known
+        if unknown and not self._warned_unknown:
+            logger.warning(
+                f"[ResultSaver] unknown names in outputs: {unknown}, "
+                f"expected: {self._item_names}"
+            )
+            self._warned_unknown = True
+        if missing:
+            raise AttributeError(
+                f"[ResultSaver] not found names: {missing}, "
+                f"expected: {self._item_names}"
+            )
+
+        for k, v in batch_meta_data.items():
+            self._results_dict[k].extend(self._convert_type(list(v)))
+
+        for k in self._item_names:
+            pred_k, pred_v = self._process_item(k, results[k], prefix="pred_")
+            self._results_dict[pred_k].extend(self._convert_type(pred_v))
+            tgt_k, tgt_v = self._process_item(k, targets[k], prefix="tgt_")
+            self._results_dict[tgt_k].extend(self._convert_type(tgt_v))
+
+    def save_as_csv(self, path: str) -> None:
+        import pandas as pd
+
+        sdir = os.path.dirname(path)
+        if sdir and not os.path.exists(sdir):
+            os.makedirs(sdir, exist_ok=True)
+        pd.DataFrame(self._results_dict).to_csv(path)

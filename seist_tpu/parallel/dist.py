@@ -39,10 +39,15 @@ def init_distributed_mode(
         process_id = int(os.environ["PROCESS_ID"])
 
     explicit = coordinator_address is not None
-    auto_tpu = os.environ.get("TPU_WORKER_HOSTNAMES") is not None
+    tpu_hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    auto_tpu = len([h for h in tpu_hosts.split(",") if h]) > 1
     if not (explicit or auto_tpu):
         return False
 
+    # No silent fallback: both trigger conditions (explicit coordinator, or
+    # >1 worker in TPU metadata) mean a genuinely multi-host launch, and a
+    # host that degrades to single-process would strand the others inside
+    # initialize() and corrupt shared checkpoint dirs.
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -76,10 +81,26 @@ def barrier(name: str = "barrier") -> None:
 
 
 def broadcast_object(obj: Any) -> Any:
-    """Broadcast a host-side python object from process 0 to all
-    (ref: misc.py:134-140 broadcast_object_list)."""
+    """Broadcast any picklable host-side python object from process 0 to all
+    (ref: misc.py:134-140 broadcast_object_list).
+
+    ``multihost_utils.broadcast_one_to_all`` only moves numeric arrays, so
+    the object is pickled to a uint8 buffer; the length is broadcast first so
+    every host allocates the same padded shape.
+    """
     if jax.process_count() <= 1:
         return obj
+    import pickle
+
+    import numpy as np
     from jax.experimental import multihost_utils
 
-    return multihost_utils.broadcast_one_to_all(obj)
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    length = int(
+        multihost_utils.broadcast_one_to_all(np.int64(payload.size))
+    )
+    buf = np.zeros(length, dtype=np.uint8)
+    if jax.process_index() == 0:
+        buf[: payload.size] = payload
+    buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return pickle.loads(buf.tobytes())

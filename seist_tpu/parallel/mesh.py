@@ -76,11 +76,38 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(mesh: Mesh, batch: Any) -> Any:
-    """Device-put a host batch pytree with the leading axis sharded on
-    ``data`` (the `DistributedSampler`-equivalent placement; each host passes
-    its local shard and jax builds the global array)."""
+    """Place a host batch pytree with the leading axis sharded on ``data``
+    (the `DistributedSampler`-equivalent placement). Single-process: a plain
+    sharded device_put of the full batch. Multi-host: each host passes its
+    *local* shard and the global array is assembled without gathering."""
     sharding = batch_sharding(mesh)
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+            batch,
+        )
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def to_local(x: Any) -> np.ndarray:
+    """Materialize this host's rows of a batch-sharded array as numpy.
+
+    Single-process: the whole array. Multi-host: the addressable shards in
+    global-index order — the same rows (same order) this host fed in via
+    :func:`shard_batch` / the input pipeline. Replication over other mesh
+    axes (model/seq) makes several local devices hold the same row range —
+    deduped by range start so each row appears once.
+    """
+    if isinstance(x, np.ndarray):
+        return x
+    if jax.process_count() <= 1 or not hasattr(x, "addressable_shards"):
+        return np.asarray(x)
+    by_start = {}
+    for s in x.addressable_shards:
+        start = s.index[0].start or 0
+        by_start.setdefault(start, s)
+    shards = [by_start[k] for k in sorted(by_start)]
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
 
 
 def replicate(mesh: Mesh, tree: Any) -> Any:

@@ -39,10 +39,14 @@ def save_checkpoint(
     """Write ``<ckpt_dir>/model-<epoch>`` (ref naming: `model-{epoch}.pth`,
     train.py:411). Returns the checkpoint path."""
     path = os.path.join(os.path.abspath(ckpt_dir), f"model-{epoch}")
+    # opt_state is stored as a flat leaves list: optax state trees contain
+    # empty-namedtuple nodes (EmptyState) that do not round-trip through a
+    # structured orbax restore; the treedef comes from the live TrainState at
+    # restore time (restore_into_state).
     payload = {
         "params": state.params,
         "batch_stats": state.batch_stats if state.batch_stats is not None else {},
-        "opt_state": state.opt_state,
+        "opt_state": list(jax.tree_util.tree_leaves(state.opt_state)),
         "meta": {"epoch": epoch, "loss": float(loss), "step": int(state.step)},
     }
     with ocp.StandardCheckpointer() as saver:
@@ -72,7 +76,9 @@ def load_checkpoint(
             "batch_stats": _as_abstract(
                 state.batch_stats if state.batch_stats is not None else {}
             ),
-            "opt_state": _as_abstract(state.opt_state),
+            "opt_state": _as_abstract(
+                list(jax.tree_util.tree_leaves(state.opt_state))
+            ),
             "meta": {"epoch": 0, "loss": 0.0, "step": 0},
         }
         return restorer.restore(path, target)

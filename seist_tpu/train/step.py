@@ -72,16 +72,33 @@ def make_train_step(spec: TaskSpec, loss_fn: Callable) -> Callable:
 
 
 def make_eval_step(spec: TaskSpec, loss_fn: Callable) -> Callable:
-    """Build ``eval_step(state, inputs, targets) -> (loss, outputs)``
-    (the reference's no-grad validate body, validate.py:54-127)."""
+    """Build ``eval_step(state, inputs, targets, mask) -> (loss, outputs)``
+    (the reference's no-grad validate body, validate.py:54-127).
 
-    def eval_step(state: TrainState, inputs, targets):
+    ``mask`` (float, shape (N,)) zeroes padded tail rows: the input pipeline
+    pads the final eval batch to keep jit shapes static, so the loss is
+    recombined from *per-sample* losses (vmap over batch-of-1 slices) —
+    a mask-weighted mean for mean-reduced losses, a masked sum for
+    sum-reduced ones (``loss_fn.reduction == 'sum'``, e.g. MousaviLoss).
+    """
+    sum_reduced = getattr(loss_fn, "reduction", "mean") == "sum"
+
+    def eval_step(state: TrainState, inputs, targets, mask):
         variables = {"params": state.params}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
         outputs = state.apply_fn(variables, inputs, train=False)
         o, t = _apply_transforms(spec, outputs, targets)
-        loss = loss_fn(o, t)
+
+        def one(o1, t1):
+            ob = jax.tree.map(lambda a: a[None], o1)
+            tb = jax.tree.map(lambda a: a[None], t1)
+            return loss_fn(ob, tb)
+
+        per_sample = jax.vmap(one)(o, t)
+        w = mask.astype(per_sample.dtype)
+        masked = (per_sample * w).sum()
+        loss = masked if sum_reduced else masked / jnp.maximum(w.sum(), 1.0)
         return loss, outputs
 
     return eval_step
@@ -112,13 +129,14 @@ def jit_step(
 
 
 def jit_eval_step(step_fn: Callable, mesh: Optional[Mesh] = None) -> Callable:
-    """Jit an eval step ``(state, inputs, targets) -> (loss, outputs)``.
+    """Jit an eval step ``(state, inputs, targets, mask) -> (loss, outputs)``.
 
     Never donates the state (eval does not return one — donating would
-    invalidate the live TrainState) and has no trailing rng arg.
+    invalidate the live TrainState); inputs, targets and mask are all
+    batch-sharded on ``data``.
     """
     return jit_step(
-        step_fn, mesh=mesh, donate_state=False, n_batch_args=2, n_extra_args=0
+        step_fn, mesh=mesh, donate_state=False, n_batch_args=3, n_extra_args=0
     )
 
 

@@ -1,0 +1,445 @@
+"""Train / validate / test orchestration.
+
+Counterpart of the reference's L6 layer (training/train.py:182-484,
+training/validate.py:10-134, training/test.py:10-88), redesigned around one
+jitted step over a device mesh:
+
+* no DDP wrap, no SyncBatchNorm conversion, no explicit collectives — the
+  batch is sharded on the mesh's ``data`` axis and XLA emits gradient/BN
+  reductions over ICI;
+* the epoch structure, best-val-loss checkpointing, patience early stop,
+  per-step cyclic LR, TensorBoard scalars, loss-curve ``.npy`` dumps and
+  test-time CSV results all mirror the reference's workflow contract.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from seist_tpu import taskspec
+from seist_tpu.data import pipeline
+from seist_tpu.models import api
+from seist_tpu.ops import Metrics, ResultSaver, process_outputs
+from seist_tpu.parallel import mesh as mesh_lib
+from seist_tpu.train import (
+    build_cyclic_schedule,
+    build_optimizer,
+    create_train_state,
+    jit_eval_step,
+    jit_step,
+    load_checkpoint,
+    make_eval_step,
+    make_train_step,
+    restore_into_state,
+    save_checkpoint,
+)
+from seist_tpu.utils.logger import logger
+from seist_tpu.utils.meters import AverageMeter, ProgressMeter
+from seist_tpu.utils.misc import count_params, strftimedelta
+from seist_tpu.utils.tb import ScalarWriter
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0
+
+
+def _build_loader(args: Any, spec: taskspec.TaskSpec, mode: str) -> pipeline.Loader:
+    sds = pipeline.from_task_spec(
+        spec,
+        args.dataset_name,
+        mode,
+        seed=args.seed,
+        data_dir=args.data,
+        in_samples=args.in_samples,
+        augmentation=args.augmentation,
+        shuffle=args.shuffle,
+        data_split=args.data_split,
+        train_size=args.train_size,
+        val_size=args.val_size,
+        max_event_num=args.max_event_num,
+        min_snr=args.min_snr,
+        p_position_ratio=args.p_position_ratio,
+        coda_ratio=args.coda_ratio,
+        norm_mode=args.norm_mode,
+        add_event_rate=args.add_event_rate,
+        add_noise_rate=args.add_noise_rate,
+        add_gap_rate=args.add_gap_rate,
+        drop_channel_rate=args.drop_channel_rate,
+        scale_amplitude_rate=args.scale_amplitude_rate,
+        pre_emphasis_rate=args.pre_emphasis_rate,
+        pre_emphasis_ratio=args.pre_emphasis_ratio,
+        generate_noise_rate=args.generate_noise_rate,
+        shift_event_rate=args.shift_event_rate,
+        mask_percent=args.mask_percent,
+        noise_percent=args.noise_percent,
+        min_event_gap_sec=args.min_event_gap,
+        soft_label_shape=args.label_shape,
+        label_width=args.label_width,
+        dataset_kwargs=getattr(args, "dataset_kwargs", None),
+    )
+    return pipeline.Loader(
+        sds,
+        batch_size=args.batch_size,
+        shuffle=(mode == "train" and args.shuffle),
+        drop_last=(mode == "train"),
+        num_workers=args.workers,
+        seed=args.seed,
+        num_shards=jax.process_count(),
+        shard_index=jax.process_index(),
+    )
+
+
+def _make_metrics(args: Any, tasks: List[str], fs: int) -> Dict[str, Metrics]:
+    return {
+        task: Metrics(
+            task=task,
+            metric_names=taskspec.get_metrics(task),
+            sampling_rate=fs,
+            time_threshold=args.time_threshold,
+            num_samples=args.in_samples,
+        )
+        for task in tasks
+    }
+
+
+def _postprocess_batch(
+    args: Any,
+    spec: taskspec.TaskSpec,
+    outputs,
+    fs: int,
+):
+    if spec.outputs_transform_for_results is not None:
+        outputs = spec.outputs_transform_for_results(outputs)
+    return process_outputs(
+        outputs,
+        spec.labels,
+        sampling_rate=fs,
+        ppk_threshold=args.ppk_threshold,
+        spk_threshold=args.spk_threshold,
+        det_threshold=args.det_threshold,
+        min_peak_dist=args.min_peak_dist,
+        max_detect_event_num=args.max_detect_event_num,
+    )
+
+
+def _update_task_metrics(
+    metrics_merged: Dict[str, Metrics],
+    batch_metrics: Dict[str, Metrics],
+    results: Dict[str, Any],
+    metrics_targets: Dict[str, np.ndarray],
+    valid: int,
+) -> None:
+    """Feed one batch into fresh per-batch metrics + running accumulators
+    (ref train.py:144-164). ``valid`` trims eval tail padding. Results may be
+    globally-sharded device arrays — ``to_local`` keeps only this host's
+    rows, which line up with the host-local metrics_targets."""
+    for task, m in batch_metrics.items():
+        tgt = mesh_lib.to_local(metrics_targets[task])[:valid]
+        prd = mesh_lib.to_local(results[task])[:valid]
+        if prd.ndim < 2:
+            prd = prd[:, None]
+        m.compute(tgt, prd)
+        metrics_merged[task].add(m)
+
+
+def validate(
+    args: Any,
+    state,
+    eval_step,
+    spec: taskspec.TaskSpec,
+    val_loader: pipeline.Loader,
+    mesh,
+    *,
+    testing: bool = False,
+    save_results: bool = False,
+) -> Tuple[float, Dict[str, Metrics]]:
+    """Eval loop (ref validate.py:10-134): loss + per-task metrics; at test
+    time optionally accumulate the results CSV."""
+    tasks = list(spec.eval)
+    fs = val_loader.dataset.sampling_rate()
+    metrics_merged = _make_metrics(args, tasks, fs)
+    loss_meter = AverageMeter("loss", ":.4e")
+    saver = (
+        ResultSaver(item_names=tasks) if (save_results and is_main_process()) else None
+    )
+
+    for step, batch in enumerate(
+        pipeline.prefetch_to_device(iter(val_loader), mesh)
+    ):
+        loss, outputs = eval_step(
+            state, batch.inputs, batch.loss_targets, batch.mask
+        )
+        valid = int(mesh_lib.to_local(batch.mask).sum())
+        # Weight by the GLOBAL valid count so every host's running val loss
+        # is identical — checkpoint/early-stop decisions must not diverge
+        # across hosts (tail padding lives on one host's shard only).
+        global_valid = int(np.asarray(jax.device_get(batch.mask.sum())))
+        loss_meter.update(float(loss), max(global_valid, 1))
+        results = _postprocess_batch(args, spec, outputs, fs)
+        batch_metrics = _make_metrics(args, tasks, fs)
+        _update_task_metrics(
+            metrics_merged, batch_metrics, results, batch.metrics_targets, valid
+        )
+        if saver is not None:
+            import json as _json
+
+            metas = [_json.loads(m) for m in batch.meta[:valid]]
+            meta_cols = {k: [m[k] for m in metas] for k in metas[0]} if metas else {}
+            saver.append(
+                meta_cols,
+                {
+                    t: mesh_lib.to_local(batch.metrics_targets[t])[:valid]
+                    for t in tasks
+                },
+                {t: mesh_lib.to_local(results[t])[:valid] for t in tasks},
+            )
+
+    for m in metrics_merged.values():
+        m.synchronize_between_processes()
+
+    if saver is not None:
+        out_csv = os.path.join(
+            logger.logdir(), f"test_results_{val_loader.dataset.name()}.csv"
+        )
+        saver.save_as_csv(out_csv)
+        logger.info(f"Test results saved: {out_csv}")
+
+    phase = "test" if testing else "val"
+    for task, m in metrics_merged.items():
+        logger.info(f"[{phase}] {args.model_name} {task}: {m}")
+    return loss_meter.avg, metrics_merged
+
+
+def train_worker(args: Any) -> str:
+    """Full training run; returns the best checkpoint path
+    (ref train.py:182-484)."""
+    spec = taskspec.get_task_spec(args.model_name)
+    loss_fn = spec.loss()
+    mesh = mesh_lib.make_mesh()
+    logger.info(
+        f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}, "
+        f"process {jax.process_index()}/{jax.process_count()}"
+    )
+    data_axis = mesh.shape[mesh_lib.AXIS_DATA]
+    if (args.batch_size * jax.process_count()) % data_axis:
+        raise ValueError(
+            f"global batch size {args.batch_size * jax.process_count()} must "
+            f"be divisible by the mesh 'data' axis ({data_axis} devices)"
+        )
+
+    train_loader = _build_loader(args, spec, "train")
+    val_loader = _build_loader(args, spec, "val")
+    fs = train_loader.dataset.sampling_rate()
+
+    steps_per_epoch = len(train_loader)
+    if steps_per_epoch == 0:
+        raise ValueError("Train split is empty — check data_dir / split sizes")
+    # `--steps > 0` overrides epochs (ref train.py:250-253).
+    epochs = args.epochs
+    if args.steps > 0:
+        epochs = max(1, int(np.ceil(args.steps / steps_per_epoch)))
+    total_steps = steps_per_epoch * epochs
+
+    # Model + optimizer + state.
+    in_channels = taskspec.get_num_inchannels(args.model_name)
+    model = api.create_model(
+        args.model_name, in_channels=in_channels, in_samples=args.in_samples
+    )
+    variables = api.init_variables(
+        model, seed=args.seed, in_samples=args.in_samples, in_channels=in_channels
+    )
+    logger.info(f"{args.model_name} params: {count_params(variables['params']):,}")
+
+    if args.use_lr_scheduler:
+        schedule = build_cyclic_schedule(
+            base_lr=args.base_lr,
+            max_lr=args.max_lr,
+            total_steps=total_steps,
+            warmup_steps=args.warmup_steps,
+            down_steps=args.down_steps,
+            mode=args.lr_scheduler_mode,
+        )
+    else:
+        schedule = args.max_lr
+    tx = build_optimizer(
+        args.optim,
+        schedule,
+        weight_decay=args.weight_decay,
+        momentum=args.momentum,
+    )
+    state = create_train_state(model, variables, tx)
+
+    start_epoch = args.start_epoch
+    if args.checkpoint:
+        restored = load_checkpoint(args.checkpoint, state)
+        state = restore_into_state(state, restored)
+        start_epoch = int(restored["meta"]["epoch"]) + 1
+        logger.info(
+            f"Resumed from {args.checkpoint} (epoch {start_epoch}, "
+            f"loss {restored['meta']['loss']:.4f})"
+        )
+
+    train_step = jit_step(make_train_step(spec, loss_fn), mesh)
+    eval_step = jit_eval_step(make_eval_step(spec, loss_fn), mesh)
+    base_rng = jax.random.PRNGKey(args.seed)
+
+    writer = (
+        ScalarWriter(os.path.join(logger.logdir(), "tensorboard"))
+        if (args.use_tensorboard and is_main_process())
+        else None
+    )
+    ckpt_dir = os.path.join(logger.logdir(), "checkpoints")
+
+    best_loss = float("inf")
+    best_ckpt_path = ""
+    patience_counter = 0
+    tasks = list(spec.eval)
+    train_losses: List[float] = []
+    val_losses: List[float] = []
+    epoch_times: List[float] = []
+
+    for epoch in range(start_epoch, epochs):
+        t0 = time.time()
+        train_loader.set_epoch(epoch)
+        epoch_rng = jax.random.fold_in(base_rng, epoch)
+
+        # -- train epoch (ref train.py:20-179) --------------------------------
+        loss_meter = AverageMeter("loss", ":.4e")
+        wps_meter = AverageMeter("wave/s", ":.1f")
+        metrics_merged = _make_metrics(args, tasks, fs)
+        progress = ProgressMeter(
+            steps_per_epoch, [loss_meter, wps_meter], prefix=f"Epoch[{epoch}] "
+        )
+        t_step = time.time()
+        for step, batch in enumerate(
+            pipeline.prefetch_to_device(iter(train_loader), mesh)
+        ):
+            state, loss, outputs = train_step(
+                state, batch.inputs, batch.loss_targets, epoch_rng
+            )
+            loss = float(loss)
+            gstep = epoch * steps_per_epoch + step
+            global_bs = args.batch_size * jax.process_count()
+            loss_meter.update(loss, global_bs)
+            train_losses.append(loss)
+
+            results = _postprocess_batch(args, spec, outputs, fs)
+            batch_metrics = _make_metrics(args, tasks, fs)
+            _update_task_metrics(
+                metrics_merged,
+                batch_metrics,
+                results,
+                batch.metrics_targets,
+                args.batch_size,
+            )
+            now = time.time()
+            wps_meter.update(global_bs / max(now - t_step, 1e-9))
+            t_step = now
+
+            if writer is not None:
+                writer.add_scalar("train-loss/step", loss, gstep)
+                for task, m in batch_metrics.items():
+                    writer.add_scalars(
+                        f"train.{task}.metrics/step", m.get_all_metrics(), gstep
+                    )
+            if step % args.log_step == 0 and is_main_process():
+                logger.info(
+                    f"{args.model_name}_train {progress.get_str(step)}"
+                )
+
+        for m in metrics_merged.values():
+            m.synchronize_between_processes()
+
+        # -- validate + checkpoint (ref train.py:402-415) ---------------------
+        val_loss, val_metrics = validate(
+            args, state, eval_step, spec, val_loader, mesh
+        )
+        val_losses.append(val_loss)
+        if writer is not None:
+            writer.add_scalar("train-loss/epoch", loss_meter.avg, epoch)
+            writer.add_scalar("val-loss/epoch", val_loss, epoch)
+            for task, m in val_metrics.items():
+                writer.add_scalars(
+                    f"val.{task}.metrics/epoch", m.get_all_metrics(), epoch
+                )
+
+        if val_loss < best_loss:
+            best_loss = val_loss
+            patience_counter = 0
+            # Checkpoint path is deterministic across hosts (epoch-numbered),
+            # replacing the reference's rank0 broadcast (train.py:481-482).
+            best_ckpt_path = save_checkpoint(ckpt_dir, state, epoch, val_loss)
+        else:
+            patience_counter += 1
+            if patience_counter > args.patience:
+                logger.info(
+                    f"Early stopping at epoch {epoch} "
+                    f"(no val improvement in {args.patience} epochs)"
+                )
+                break
+
+        dt = time.time() - t0
+        epoch_times.append(dt)
+        eta = float(np.mean(epoch_times)) * (epochs - epoch - 1)
+        logger.info(
+            f"Epoch {epoch}: train-loss {loss_meter.avg:.4e} "
+            f"val-loss {val_loss:.4e} best {best_loss:.4e} "
+            f"time {strftimedelta(dt)} ETA {strftimedelta(eta)}"
+        )
+
+    if is_main_process():
+        np.save(os.path.join(logger.logdir(), "train_losses.npy"), train_losses)
+        np.save(os.path.join(logger.logdir(), "val_losses.npy"), val_losses)
+    if writer is not None:
+        writer.close()
+    return best_ckpt_path
+
+
+def test_worker(args: Any) -> float:
+    """Test run on the held-out split (ref test.py:10-88). Returns loss."""
+    spec = taskspec.get_task_spec(args.model_name)
+    loss_fn = spec.loss()
+    mesh = mesh_lib.make_mesh()
+
+    test_loader = _build_loader(args, spec, "test")
+
+    in_channels = taskspec.get_num_inchannels(args.model_name)
+    model = api.create_model(
+        args.model_name, in_channels=in_channels, in_samples=args.in_samples
+    )
+    variables = api.init_variables(
+        model, seed=args.seed, in_samples=args.in_samples, in_channels=in_channels
+    )
+    tx = build_optimizer(args.optim, args.max_lr)
+    state = create_train_state(model, variables, tx)
+
+    if not args.checkpoint:
+        raise ValueError("test mode requires --checkpoint")
+    # Raw (target-free) restore: test never steps the optimizer, and the
+    # test-time tx may have a different state structure (float LR vs
+    # schedule) — params + batch_stats are all that matter (the reference
+    # likewise tolerates bare state-dicts, _factory.py:101-102).
+    restored = load_checkpoint(args.checkpoint)
+    state = state.replace(
+        params=restored["params"],
+        batch_stats=restored.get("batch_stats") or state.batch_stats,
+    )
+    logger.info(f"Loaded checkpoint: {args.checkpoint}")
+
+    eval_step = jit_eval_step(make_eval_step(spec, loss_fn), mesh)
+    loss, _ = validate(
+        args,
+        state,
+        eval_step,
+        spec,
+        test_loader,
+        mesh,
+        testing=True,
+        save_results=args.save_test_results,
+    )
+    return loss

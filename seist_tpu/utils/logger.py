@@ -30,6 +30,12 @@ class _Logger:
         lg = logging.getLogger(f"seist_tpu.{name}")
         lg.setLevel(logging.INFO)
         lg.propagate = False
+        # logging.getLogger returns process-cached instances — drop any
+        # handlers from a previous configuration so set_logdir /
+        # enable_console rebuilds never duplicate output.
+        for h in list(lg.handlers):
+            lg.removeHandler(h)
+            h.close()
         if self._console_enabled:
             h = logging.StreamHandler(sys.stdout)
             h.setFormatter(logging.Formatter(self._FMT))
@@ -53,6 +59,13 @@ class _Logger:
     def set_logger(self, name: str) -> None:
         self._active = name
         self._ensure(name)
+
+    def logdir(self) -> str:
+        """Active log dir (ref logger.py usage at validate.py:130); defaults
+        to ./logs if never set."""
+        if self._logdir is None:
+            self.set_logdir(os.path.abspath("./logs"))
+        return self._logdir
 
     def enable_console(self, enabled: bool) -> None:
         self._console_enabled = enabled

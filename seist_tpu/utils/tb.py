@@ -1,0 +1,55 @@
+"""Scalar logging: TensorBoard event files with a JSONL fallback.
+
+The reference writes per-step/per-epoch scalars through
+``torch.utils.tensorboard.SummaryWriter`` (train.py:166-173,420-442). Here
+the writer is pluggable: if the tensorboard package is importable we emit
+real event files (same dashboards work); otherwise scalars append to
+``scalars.jsonl`` in the log dir — machine-readable either way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+
+class ScalarWriter:
+    def __init__(self, logdir: str):
+        self._logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._tb = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._tb = SummaryWriter(logdir)
+        except Exception:
+            self._jsonl = open(os.path.join(logdir, "scalars.jsonl"), "a")
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+        else:
+            self._jsonl.write(
+                json.dumps(
+                    {"tag": tag, "value": float(value), "step": int(step), "ts": time.time()}
+                )
+                + "\n"
+            )
+
+    def add_scalars(self, prefix: str, values: Dict[str, float], step: int) -> None:
+        for k, v in values.items():
+            self.add_scalar(f"{prefix}/{k}", v, step)
+
+    def flush(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+        else:
+            self._jsonl.flush()
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+        else:
+            self._jsonl.close()

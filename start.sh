@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Single-host launch (ref start.sh). All visible TPU chips join the data mesh.
+nohup python main.py "$@" > /dev/null 2>&1 &

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Multi-host launch (ref start_distributed.sh, which used torchrun).
+#
+# On Cloud TPU pods, run the same command on every worker VM; JAX discovers
+# the topology from TPU metadata:
+#   python main.py --model-name seist_m_dpk ...
+#
+# Off-TPU (or forcing an explicit rendezvous), set the env contract of
+# seist_tpu/parallel/dist.py on each process:
+#   COORDINATOR_ADDRESS=host0:1234 NUM_PROCESSES=2 PROCESS_ID=$i \
+#     python main.py ...
+set -e
+: "${NUM_PROCESSES:?set NUM_PROCESSES (and COORDINATOR_ADDRESS, PROCESS_ID per worker)}"
+python main.py "$@"

@@ -1,0 +1,147 @@
+"""Tests for dataset readers, split logic, and the input pipeline."""
+
+import json
+
+import numpy as np
+import pytest
+
+import seist_tpu
+from seist_tpu.data import pipeline
+from seist_tpu.data.diting import convert_to_ml, normalize_key
+from seist_tpu.data.pnw import parse_trace_name
+from seist_tpu.data.synthetic import Synthetic
+from seist_tpu import taskspec
+
+seist_tpu.load_all()
+
+
+class TestFormatQuirks:
+    def test_diting_key_padding(self):
+        assert normalize_key("123.45") == "000123.4500"
+        assert normalize_key("123456.7890") == "123456.7890"
+
+    def test_mag_conversion(self):
+        assert convert_to_ml(2.0, "ml") == 2.0
+        assert convert_to_ml(2.0, "ms") == pytest.approx((2.0 + 1.08) / 1.13)
+        assert convert_to_ml(2.0, "mb") == pytest.approx((1.17 * 2.0 + 0.67) / 1.13)
+        with pytest.raises(ValueError):
+            convert_to_ml(2.0, "mw")
+
+    def test_pnw_trace_name(self):
+        assert parse_trace_name("bucket3$42,:3,:15001") == ("bucket3", 42)
+
+
+class TestSplit:
+    def test_split_disjoint_and_seeded(self):
+        parts = {}
+        for mode in ("train", "val", "test"):
+            ds = Synthetic(
+                seed=7, mode=mode, num_events=100, trace_samples=2000
+            )
+            parts[mode] = set(int(ds._meta_data.iloc[i]["idx"]) for i in range(len(ds)))
+        assert len(parts["train"]) == 80
+        assert len(parts["val"]) == 10
+        assert len(parts["test"]) == 10
+        assert not (parts["train"] & parts["val"])
+        assert not (parts["train"] & parts["test"])
+        # Same seed -> same split
+        ds2 = Synthetic(seed=7, mode="val", num_events=100, trace_samples=2000)
+        assert set(int(ds2._meta_data.iloc[i]["idx"]) for i in range(len(ds2))) == parts["val"]
+        # Different seed -> different membership (overwhelmingly likely)
+        ds3 = Synthetic(seed=8, mode="val", num_events=100, trace_samples=2000)
+        assert set(int(ds3._meta_data.iloc[i]["idx"]) for i in range(len(ds3))) != parts["val"]
+
+
+def make_sds(mode="train", augmentation=False, n=24, in_samples=1024):
+    spec = taskspec.get_task_spec("seist_s_dpk")
+    return pipeline.from_task_spec(
+        spec,
+        "synthetic",
+        mode,
+        seed=3,
+        in_samples=in_samples,
+        augmentation=augmentation,
+        dataset_kwargs={"num_events": n, "trace_samples": 4 * in_samples},
+    )
+
+
+class TestSeismicDataset:
+    def test_item_contract(self):
+        sds = make_sds()
+        inputs, loss_targets, metrics_targets, meta = sds[0]
+        assert inputs.shape == (1024, 3)  # channels-last (L, C)
+        assert loss_targets.shape == (1024, 3)  # (non, ppk, spk) soft labels
+        assert set(metrics_targets) == {"det", "ppk", "spk"}
+        assert metrics_targets["ppk"].shape == (1,)
+        assert metrics_targets["det"].shape == (2,)
+        json.loads(meta)
+
+    def test_augmentation_doubles_epoch(self):
+        plain = make_sds(augmentation=False)
+        aug = make_sds(augmentation=True)
+        assert len(aug) == 2 * len(plain)
+
+    def test_augmentation_off_for_val(self):
+        sds = make_sds(mode="val", augmentation=True)
+        assert len(sds) == len(sds._dataset)
+
+    def test_deterministic(self):
+        a = make_sds(augmentation=True)
+        b = make_sds(augmentation=True)
+        idx = len(a) - 1  # augmented half
+        ia, la, _, _ = a[idx]
+        ib, lb, _, _ = b[idx]
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(la, lb)
+
+
+class TestLoader:
+    def test_batches_fixed_shape(self):
+        sds = make_sds(n=20)
+        loader = pipeline.Loader(sds, batch_size=8, drop_last=False, num_workers=2)
+        batches = list(loader)
+        assert len(batches) == 2  # 16 train events (80% of 20) -> 2 batches of 8
+        for b in batches:
+            assert b.inputs.shape == (8, 1024, 3)
+            assert b.mask.shape == (8,)
+        assert batches[0].mask.sum() == 8
+
+    def test_drop_last(self):
+        sds = make_sds(n=20)  # 16 train events
+        loader = pipeline.Loader(sds, batch_size=5, drop_last=True)
+        assert len(loader) == 3
+        assert len(list(loader)) == 3
+
+    def test_shard_partition(self):
+        sds = make_sds(n=30)
+        all_meta = []
+        for shard in range(2):
+            loader = pipeline.Loader(
+                sds, batch_size=4, num_shards=2, shard_index=shard
+            )
+            for b in loader:
+                all_meta.extend(m for i, m in enumerate(b.meta) if b.mask[i] > 0)
+        # Each event appears exactly once across the two shards.
+        assert len(all_meta) == len(sds)
+        assert len(set(all_meta)) == len(sds)
+
+    def test_epoch_reshuffle(self):
+        sds = make_sds(n=30)
+        loader = pipeline.Loader(sds, batch_size=8, shuffle=True, drop_last=True)
+        loader.set_epoch(0)
+        first = [b.meta for b in loader]
+        loader.set_epoch(1)
+        second = [b.meta for b in loader]
+        assert first != second
+
+    def test_prefetch_to_device(self):
+        import jax
+        from seist_tpu.parallel.mesh import make_mesh
+
+        sds = make_sds(n=20)
+        loader = pipeline.Loader(sds, batch_size=8, drop_last=True)
+        mesh = make_mesh(data=8)
+        out = list(pipeline.prefetch_to_device(iter(loader), mesh))
+        assert len(out) == 2
+        assert isinstance(out[0].inputs, jax.Array)
+        assert out[0].inputs.sharding.spec[0] == "data"

@@ -1,0 +1,157 @@
+"""Tests for seist_tpu.ops.metrics — per-task semantics from the reference
+(utils/metrics.py:101-332), hand fixtures + formula cross-checks."""
+
+import numpy as np
+import pytest
+
+from seist_tpu.ops import metrics as M
+
+
+def make(task, names, fs=100, thr=0.1, n=64):
+    return M.Metrics(
+        task=task,
+        metric_names=names,
+        sampling_rate=fs,
+        time_threshold=thr,
+        num_samples=n,
+    )
+
+
+class TestPhasePicking:
+    def test_tp_within_tolerance(self):
+        m = make("ppk", ["precision", "recall", "f1"], fs=100, thr=0.1, n=1000)
+        # tolerance = 10 samples
+        t = np.array([[100], [200], [300]])
+        p = np.array([[105], [215], [-(10**7)]])  # hit, miss(>10), padded miss
+        m.compute(t, p)
+        r = m.get_all_metrics()
+        assert r["precision"] == pytest.approx(1 / 2, abs=1e-4)
+        assert r["recall"] == pytest.approx(1 / 3, abs=1e-4)
+        f1 = 2 * (1 / 2) * (1 / 3) / (1 / 2 + 1 / 3)
+        assert r["f1"] == pytest.approx(f1, abs=1e-4)
+
+    def test_out_of_range_not_counted(self):
+        m = make("ppk", ["precision", "recall"], n=100)
+        t = np.array([[150]])  # target outside num_samples -> not a possp
+        p = np.array([[50]])
+        m.compute(t, p)
+        r = m.get_all_metrics()
+        assert r["recall"] == pytest.approx(0.0, abs=1e-3)
+
+    def test_masked_residual_metrics(self):
+        m = make("ppk", ["f1", "mae", "rmse"], fs=100, thr=0.1, n=1000)
+        t = np.array([[100], [200]])
+        p = np.array([[103], [500]])  # only first is TP -> only it contributes
+        m.compute(t, p)
+        r = m.get_all_metrics()
+        assert r["mae"] == pytest.approx(3 / 2, abs=1e-4)  # masked sum / data_size
+        assert r["rmse"] == pytest.approx(np.sqrt(9 / 2), abs=1e-4)
+
+    def test_order_phases_matching(self):
+        # Two phases predicted in swapped order still match greedily.
+        m = make("ppk", ["precision", "recall"], fs=100, thr=0.1, n=1000)
+        t = np.array([[100, 400]])
+        p = np.array([[398, 102]])
+        m.compute(t, p)
+        r = m.get_all_metrics()
+        assert r["precision"] == pytest.approx(1.0, abs=1e-4)
+        assert r["recall"] == pytest.approx(1.0, abs=1e-4)
+
+    def test_order_phases_function(self):
+        t = np.array([[10, 50, 90]])
+        p = np.array([[88, 12, 49]])
+        ordered = np.asarray(M.order_phases(t, p))
+        np.testing.assert_array_equal(ordered, [[12, 49, 88]])
+
+
+class TestDetection:
+    def test_overlap(self):
+        m = make("det", ["precision", "recall", "f1"], n=100)
+        t = np.array([[20, 40], [60, 80]])
+        p = np.array([[25, 45], [0, 10]])  # overlap / disjoint
+        m.compute(t, p)
+        c = {k: float(np.asarray(v)) for k, v in m.counters.items() if k != "data_size"}
+        # row0: target covers 21, pred 21, overlap 16; row1: 21 / 11 / 0
+        assert c["tp"] == 16
+        assert c["predp"] == 32
+        assert c["possp"] == 42
+
+    def test_padding_pair_inert(self):
+        m = make("det", ["precision", "recall"], n=100)
+        t = np.array([[20, 40, 1, 0]])  # padded second interval [1,0]
+        p = np.array([[20, 40, 1, 0]])
+        m.compute(t, p)
+        c = m.counters
+        assert float(np.asarray(c["possp"])) == 21  # [1,0] adds nothing
+
+
+class TestOneHot:
+    def test_confusion(self):
+        m = make("pmp", ["precision", "recall", "f1"])
+        t = np.array([[1, 0], [0, 1], [1, 0], [0, 1]], dtype=np.float32)
+        p = np.array(
+            [[0.9, 0.1], [0.2, 0.8], [0.3, 0.7], [0.6, 0.4]], dtype=np.float32
+        )
+        m.compute(t, p)
+        r = m.get_all_metrics()
+        # per-class: c0 tp=1 predp=2 possp=2; c1 tp=1 predp=2 possp=2 -> macro 0.5
+        assert r["precision"] == pytest.approx(0.5, abs=1e-4)
+        assert r["recall"] == pytest.approx(0.5, abs=1e-4)
+
+
+class TestRegression:
+    def test_value_metrics(self):
+        m = make("emg", ["mean", "rmse", "mae", "mape", "r2"])
+        t = np.array([[2.0], [4.0], [6.0]])
+        p = np.array([[2.5], [3.0], [6.0]])
+        m.compute(t, p)
+        r = m.get_all_metrics()
+        res = t - p
+        assert r["mean"] == pytest.approx(res.mean(), abs=1e-5)
+        assert r["rmse"] == pytest.approx(np.sqrt((res**2).mean()), abs=1e-5)
+        assert r["mae"] == pytest.approx(np.abs(res).mean(), abs=1e-5)
+        ss_res = (res**2).mean(-1).sum()
+        tc = t - t.mean()
+        ss_tot = (tc**2).mean(-1).sum()
+        assert r["r2"] == pytest.approx(1 - ss_res / (ss_tot + 1e-6), abs=1e-5)
+
+    def test_baz_wraparound(self):
+        m = make("baz", ["mae"])
+        t = np.array([[359.0]])
+        p = np.array([[1.0]])
+        m.compute(t, p)
+        assert m.get_all_metrics()["mae"] == pytest.approx(2.0, abs=1e-4)
+
+    def test_streaming_equals_single_batch(self):
+        rng = np.random.default_rng(3)
+        t = rng.normal(3, 1, size=(32, 1))
+        p = t + rng.normal(0, 0.5, size=(32, 1))
+        whole = make("emg", ["mean", "rmse", "mae", "r2"])
+        whole.compute(t, p)
+        parts = make("emg", ["mean", "rmse", "mae", "r2"])
+        for i in range(0, 32, 8):
+            parts.compute(t[i : i + 8], p[i : i + 8])
+        for k, v in whole.get_all_metrics().items():
+            assert parts.get_all_metrics()[k] == pytest.approx(v, abs=1e-5), k
+
+
+class TestAccumulation:
+    def test_add_and_dunder_add(self):
+        a = make("emg", ["mae"])
+        b = make("emg", ["mae"])
+        a.compute(np.array([[1.0]]), np.array([[2.0]]))
+        b.compute(np.array([[5.0]]), np.array([[1.0]]))
+        c = a + b
+        assert c.get_all_metrics()["mae"] == pytest.approx((1 + 4) / 2, abs=1e-5)
+        a.add(b)
+        assert a.get_all_metrics()["mae"] == pytest.approx((1 + 4) / 2, abs=1e-5)
+
+    def test_merge_counters_pytree(self):
+        x = M.init_counters(["precision"])
+        y = {k: v + 1 for k, v in x.items()}
+        z = M.merge(x, y)
+        assert float(np.asarray(z["tp"])) == 1.0
+
+    def test_merge_mismatch_raises(self):
+        with pytest.raises(TypeError):
+            M.merge({"tp": np.zeros(())}, {"predp": np.zeros(())})

@@ -209,4 +209,4 @@ def test_eqt_banded_mask_matches_torch():
         i = np.arange(L)[:, None]
         j = np.arange(L)[None, :]
         ours = (j - i <= w // 2 - 1) & (j - i >= (-w) // 2)
-        np.testing.assert_array_equal(ours, ref), f"width {w}"
+        np.testing.assert_array_equal(ours, ref, err_msg=f"width {w}")

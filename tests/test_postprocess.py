@@ -1,0 +1,181 @@
+"""Tests for seist_tpu.ops.postprocess against hand fixtures and an
+independent numpy re-derivation of the reference algorithms
+(training/postprocess.py:15-158 semantics)."""
+
+import numpy as np
+import pytest
+
+from seist_tpu.ops import postprocess as pp
+
+PAD = pp.PAD_VALUE
+
+
+def ref_peaks(x, mph, mpd, topk):
+    """Host-side re-derivation of the BMC detect_peaks subset the pipeline
+    uses (edge='rising', threshold=0, kpsh=False, valley=False; ref
+    postprocess.py:51-111)."""
+    x = np.asarray(x, dtype=np.float32)
+    dx = x[1:] - x[:-1]
+    dxn = np.concatenate([dx, [0.0]])
+    dxp = np.concatenate([[0.0], dx])
+    ind = np.where((dxn <= 0) & (dxp > 0))[0]
+    if ind.size and ind[0] == 0:
+        ind = ind[1:]
+    if ind.size and ind[-1] == x.size - 1:
+        ind = ind[:-1]
+    if ind.size:
+        ind = ind[x[ind] >= mph]
+    if ind.size and mpd > 1:
+        ind = ind[np.argsort(x[ind], kind="stable")][::-1]
+        ind = ind[:topk]
+        idel = np.zeros(ind.size, dtype=bool)
+        for i in range(ind.size):
+            if not idel[i]:
+                idel = idel | (ind >= ind[i] - mpd) & (ind <= ind[i] + mpd)
+                idel[i] = False
+        ind = np.sort(ind[~idel])
+    out = np.full(topk, PAD, dtype=np.int64)
+    out[: min(ind.size, topk)] = ind[:topk]
+    return out
+
+
+def ref_events(x, thr, topk):
+    """Maximal runs of x > thr, sorted by duration desc (stable),
+    truncated/padded to topk with [1, 0] (ref postprocess.py:114-158 with
+    obspy trigger_onset equal-threshold semantics)."""
+    x = np.asarray(x)
+    above = x > thr
+    pairs = []
+    i = 0
+    while i < len(x):
+        if above[i]:
+            j = i
+            while j + 1 < len(x) and above[j + 1]:
+                j += 1
+            pairs.append([i, j])
+            i = j + 1
+        else:
+            i += 1
+    pairs.sort(key=lambda v: v[1] - v[0], reverse=True)
+    pairs = pairs[:topk]
+    pairs += [[1, 0]] * (topk - len(pairs))
+    return np.asarray(pairs, dtype=np.int64).reshape(-1)
+
+
+class TestPickPeaks:
+    def test_simple_peak(self):
+        x = np.zeros((1, 16), dtype=np.float32)
+        x[0, 5] = 1.0
+        out = np.asarray(pp.pick_peaks(x, 0.3, 2, 2))
+        assert out.tolist() == [[5, PAD]]
+
+    def test_plateau_keeps_rising_edge(self):
+        x = np.zeros((1, 16), dtype=np.float32)
+        x[0, 5:8] = 1.0
+        out = np.asarray(pp.pick_peaks(x, 0.3, 2, 1))
+        assert out.tolist() == [[5]]
+
+    def test_below_threshold_dropped(self):
+        x = np.zeros((1, 16), dtype=np.float32)
+        x[0, 5] = 0.2
+        out = np.asarray(pp.pick_peaks(x, 0.3, 2, 1))
+        assert out.tolist() == [[PAD]]
+
+    def test_min_peak_dist_suppression(self):
+        x = np.zeros((1, 32), dtype=np.float32)
+        x[0, 10] = 1.0
+        x[0, 13] = 0.8  # within mpd=5 of the taller peak -> suppressed
+        x[0, 20] = 0.6
+        out = np.asarray(pp.pick_peaks(x, 0.3, 5, 3))
+        assert out.tolist() == [[10, 20, PAD]]
+
+    def test_first_last_excluded(self):
+        x = np.zeros((1, 8), dtype=np.float32)
+        x[0, 0] = 1.0
+        x[0, 7] = 1.0
+        out = np.asarray(pp.pick_peaks(x, 0.3, 1, 2))
+        assert out.tolist() == [[PAD, PAD]]
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_parity_with_reference_algorithm(self, seed):
+        rng = np.random.default_rng(seed)
+        # Smooth-ish random prob curves with distinct values (ties are the
+        # one documented divergence).
+        x = rng.random((4, 256)).astype(np.float32)
+        k = np.ones(9) / 9
+        x = np.stack([np.convolve(r, k, mode="same") for r in x]).astype(np.float32)
+        got = np.asarray(pp.pick_peaks(x, 0.45, 20, 3))
+        want = np.stack([ref_peaks(r, 0.45, 20, 3) for r in x])
+        np.testing.assert_array_equal(got, want)
+
+
+class TestDetectEvents:
+    def test_single_run(self):
+        x = np.zeros((1, 16), dtype=np.float32)
+        x[0, 4:9] = 0.9
+        out = np.asarray(pp.detect_events(x, 0.5, 2))
+        assert out.tolist() == [[4, 8, 1, 0]]
+
+    def test_sorted_by_duration(self):
+        x = np.zeros((1, 32), dtype=np.float32)
+        x[0, 2:4] = 0.9  # len 1
+        x[0, 10:20] = 0.9  # len 9
+        out = np.asarray(pp.detect_events(x, 0.5, 2))
+        assert out.tolist() == [[10, 19, 2, 3]]
+
+    def test_run_to_edge(self):
+        x = np.zeros((1, 16), dtype=np.float32)
+        x[0, 12:] = 0.9
+        out = np.asarray(pp.detect_events(x, 0.5, 1))
+        assert out.tolist() == [[12, 15]]
+
+    def test_no_events_padding(self):
+        x = np.zeros((2, 16), dtype=np.float32)
+        out = np.asarray(pp.detect_events(x, 0.5, 2))
+        assert out.tolist() == [[1, 0, 1, 0], [1, 0, 1, 0]]
+
+    def test_strictly_greater(self):
+        x = np.full((1, 8), 0.5, dtype=np.float32)
+        out = np.asarray(pp.detect_events(x, 0.5, 1))
+        assert out.tolist() == [[1, 0]]
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_parity_with_reference_algorithm(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        x = (rng.random((4, 128)) > 0.6).astype(np.float32)
+        got = np.asarray(pp.detect_events(x, 0.5, 3))
+        want = np.stack([ref_events(r, 0.5, 3) for r in x])
+        np.testing.assert_array_equal(got, want)
+
+
+class TestProcessOutputs:
+    def test_dpk_group(self):
+        n, length = 2, 64
+        out = np.zeros((n, length, 3), dtype=np.float32)
+        out[:, 20:30, 0] = 0.9  # det
+        out[0, 24, 1] = 0.8  # ppk
+        out[1, 40, 2] = 0.7  # spk
+        res = pp.process_outputs(
+            out,
+            [("det", "ppk", "spk")],
+            sampling_rate=10,
+            min_peak_dist=1.0,
+            max_detect_event_num=2,
+        )
+        assert set(res) == {"det", "ppk", "spk"}
+        assert np.asarray(res["det"]).shape == (n, 4)
+        assert np.asarray(res["ppk"])[0, 0] == 24
+        assert np.asarray(res["spk"])[1, 0] == 40
+
+    def test_scalar_group_passthrough(self):
+        out = np.full((3, 1), 4.2, dtype=np.float32)
+        res = pp.process_outputs(out, ["emg"], sampling_rate=50)
+        np.testing.assert_allclose(np.asarray(res["emg"]), out)
+
+    def test_tuple_outputs(self):
+        outs = (
+            np.full((2, 1), 1.0, dtype=np.float32),
+            np.full((2, 1), 2.0, dtype=np.float32),
+        )
+        res = pp.process_outputs(outs, ["emg", "smg"], sampling_rate=50)
+        assert np.asarray(res["smg"])[0, 0] == 2.0

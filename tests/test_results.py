@@ -1,0 +1,44 @@
+"""Tests for seist_tpu.ops.results.ResultSaver (ref postprocess.py:253-338)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from seist_tpu.ops.results import ResultSaver
+
+
+def test_csv_roundtrip(tmp_path):
+    saver = ResultSaver(item_names=["ppk", "spk"])
+    meta = {"idx": [0, 1], "mag": [3.5, 4.2]}
+    targets = {
+        "ppk": np.array([[100, -(10**7)], [200, 300]]),
+        "spk": np.array([[150, -(10**7)], [250, 400]]),
+    }
+    results = {
+        "ppk": np.array([[102, -(10**7)], [205, 298]]),
+        "spk": np.array([[149, -(10**7)], [260, 390]]),
+    }
+    saver.append(meta, targets, results)
+    path = str(tmp_path / "out" / "results.csv")
+    saver.save_as_csv(path)
+    df = pd.read_csv(path)
+    assert list(df["idx"]) == [0, 1]
+    # padding stripped; multi values joined with commas
+    assert str(df["pred_ppk"][0]) == "102"
+    assert df["tgt_ppk"][1] == "200,300"
+
+
+def test_onehot_argmax():
+    saver = ResultSaver(item_names=["pmp"])
+    meta = {"idx": [0]}
+    targets = {"pmp": np.array([[0.0, 1.0]])}
+    results = {"pmp": np.array([[0.7, 0.3]])}
+    saver.append(meta, targets, results)
+    assert saver._results_dict["pred_pmp"] == [0]
+    assert saver._results_dict["tgt_pmp"] == [1]
+
+
+def test_missing_item_raises():
+    saver = ResultSaver(item_names=["ppk", "det"])
+    with pytest.raises(AttributeError):
+        saver.append({"idx": [0]}, {"ppk": np.array([[1]])}, {"ppk": np.array([[1]])})

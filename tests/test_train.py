@@ -108,8 +108,9 @@ def test_eval_step_is_deterministic(rng):
     state, spec, loss_fn = _setup()
     estep = jax.jit(make_eval_step(spec, loss_fn))
     x, y = _fake_dpk_batch(rng)
-    l1, o1 = estep(state, x, y)
-    l2, o2 = estep(state, x, y)
+    mask = np.ones(x.shape[0], dtype=np.float32)
+    l1, o1 = estep(state, x, y, mask)
+    l2, o2 = estep(state, x, y, mask)
     np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
     assert float(l1) == float(l2)
 
@@ -198,7 +199,7 @@ def test_jit_eval_step_preserves_state(rng):
     state, spec, loss_fn = _setup()
     estep = jit_eval_step(make_eval_step(spec, loss_fn))
     x, y = _fake_dpk_batch(rng)
-    estep(state, x, y)
+    estep(state, x, y, np.ones(x.shape[0], dtype=np.float32))
     # state must remain usable (no donation)
     tstep = jit_step(make_train_step(spec, loss_fn), donate_state=False)
     tstep(state, x, y, jax.random.PRNGKey(0))
