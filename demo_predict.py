@@ -70,10 +70,10 @@ def main() -> None:
     variables = api.init_variables(model, in_samples=args.in_samples, in_channels=3)
     if args.checkpoint:
         restored = load_checkpoint(args.checkpoint)
-        variables = {
-            "params": restored["params"],
-            "batch_stats": restored.get("batch_stats") or variables.get("batch_stats"),
-        }
+        variables = {"params": restored["params"]}
+        stats = restored.get("batch_stats")
+        if stats:  # omit the collection entirely for models without BN
+            variables["batch_stats"] = stats
 
     data = normalize(load_data(args.input, args.in_samples), args.norm_mode)
     x = data.T[None, ...]  # (1, L, C) channels-last

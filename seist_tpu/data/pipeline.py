@@ -229,6 +229,14 @@ class Loader:
             order = rng.permutation(n)
         else:
             order = np.arange(n)
+        if self.num_shards > 1:
+            # Equalize shard sizes by wrapping the head (exactly torch
+            # DistributedSampler's pad-to-even rule): every host must see
+            # the SAME number of batches or the collective-bearing jitted
+            # steps deadlock mid-epoch.
+            target = -(-n // self.num_shards) * self.num_shards
+            if target > n:
+                order = np.concatenate([order, order[: target - n]])
         # Interleaved host shard (DistributedSampler-style: rank::world).
         return order[self.shard_index :: self.num_shards]
 

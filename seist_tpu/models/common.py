@@ -200,6 +200,15 @@ class ScaledActivation(nn.Module):
         return self.act(x) * self.scale_factor
 
 
+def gelu(x: Array) -> Array:
+    """Exact (erf) GELU — torch ``nn.GELU()`` parity. flax's ``nn.gelu``
+    defaults to the tanh approximation, which drifts up to ~1e-3 per layer
+    and breaks golden-parity comparison against the shipped checkpoints."""
+    import jax
+
+    return jax.nn.gelu(x, approximate=False)
+
+
 def make_norm(
     norm: str, *, use_running_average: bool, name: Optional[str] = None
 ) -> nn.Module:

@@ -60,7 +60,7 @@ class MLP(nn.Module):
     mlp_ratio: float
     bias: bool
     mlp_drop_rate: float
-    act: Callable = nn.gelu
+    act: Callable = common.gelu
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
@@ -80,7 +80,7 @@ class DSConvNormAct(nn.Module):
     kernel_size: int
     stride: int
     norm: str = "batch"
-    act: Callable = nn.gelu
+    act: Callable = common.gelu
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
@@ -109,7 +109,7 @@ class StemBlock(nn.Module):
     kernel_size: int
     stride: int
     norm: str = "batch"
-    act: Callable = nn.gelu
+    act: Callable = common.gelu
     npath: int = 3
 
     @nn.compact
@@ -143,7 +143,7 @@ class GroupConvBlock(nn.Module):
     mlp_ratio: float
     mlp_bias: bool
     norm: str = "batch"
-    act: Callable = nn.gelu
+    act: Callable = common.gelu
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
@@ -183,7 +183,7 @@ class MultiScaleMixedConv(nn.Module):
     mlp_ratio: float
     mlp_bias: bool
     norm: str = "batch"
-    act: Callable = nn.gelu
+    act: Callable = common.gelu
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
@@ -283,7 +283,7 @@ class MultiPathTransformerLayer(nn.Module):
     attn_out_drop_rate: float
     mlp_drop_rate: float
     norm: str = "batch"
-    act: Callable = nn.gelu
+    act: Callable = common.gelu
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
@@ -350,7 +350,7 @@ class HeadDetectionPicking(nn.Module):
     out_channels: int = 1
     out_act: Optional[Callable] = None
     norm: str = "batch"
-    act: Callable = nn.gelu
+    act: Callable = common.gelu
 
     def _upsampling_sizes(self, in_size: int, out_size: int) -> Sequence[int]:
         depth = len(self.layer_channels)
@@ -435,7 +435,7 @@ class SeismogramTransformer(nn.Module):
     qkv_bias: bool = True
     mlp_bias: bool = True
     norm: str = "batch"
-    act: Callable = nn.gelu
+    act: Callable = common.gelu
     use_checkpoint: bool = False
     head_type: str = "dpk"  # dpk | cls | reg
     head_out_channels: int = 3

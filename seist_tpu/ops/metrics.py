@@ -69,7 +69,11 @@ def order_phases(targets: jnp.ndarray, preds: jnp.ndarray) -> jnp.ndarray:
     reordered predictions, shape (N, P).
     """
     num_phases = targets.shape[-1]
-    big = 1.0 / EPSILON
+    # Consumed rows/cols are masked with +inf. (Deliberate divergence: the
+    # reference masks with 1/epsilon = 1e6, metrics.py:120-121, which is
+    # SMALLER than the ~1e7 distance to a PAD_VALUE prediction — its argmin
+    # can re-select a masked cell and overwrite a correct assignment.)
+    big = jnp.inf
 
     def one_row(t_row, p_row):
         dmat0 = jnp.abs(t_row[:, None] - p_row[None, :]).astype(jnp.float32)
@@ -308,9 +312,22 @@ class Metrics:
                 self._counters,
             )
         if self._tgts:
+            # Per-host row counts differ when the split doesn't divide
+            # evenly; process_allgather needs identical shapes, so pad to
+            # the global max and trim each host's segment by its count.
             local = np.concatenate(self._tgts, axis=0)
-            gathered = multihost_utils.process_allgather(local)
-            self._tgts = [gathered.reshape((-1,) + local.shape[1:])]
+            counts = np.asarray(
+                multihost_utils.process_allgather(np.int64(local.shape[0]))
+            ).reshape(-1)
+            max_n = int(counts.max())
+            padded = np.zeros((max_n,) + local.shape[1:], dtype=local.dtype)
+            padded[: local.shape[0]] = local
+            gathered = np.asarray(multihost_utils.process_allgather(padded))
+            self._tgts = [
+                np.concatenate(
+                    [gathered[p, : counts[p]] for p in range(len(counts))], axis=0
+                )
+            ]
         self._results = None
 
     def _all(self) -> Dict[str, float]:

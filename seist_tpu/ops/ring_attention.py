@@ -1,0 +1,133 @@
+"""Ring attention: sequence-parallel exact attention over the ``seq`` mesh axis.
+
+The reference has no long-context support at all (SURVEY.md §5: sequence
+length is a flag and the whole window lives on one device). This module is
+the TPU-native capability the mesh's ``seq`` axis exists for: shard the
+sequence over devices, keep Q blocks resident, and rotate K/V blocks around
+the ring with ``lax.ppermute`` while accumulating the softmax online
+(flash-attention style running max/denominator), so attention over a
+sequence of length L uses O(L/D) memory per device and the K/V transfers
+ride ICI neighbor links.
+
+Math: per-block scores s_i = q k_i^T * scale; with running (o, m, l):
+    m' = max(m, max_j s_ij);  corr = exp(m - m')
+    l' = l * corr + sum_j exp(s_ij - m')
+    o' = o * corr + exp(s_i - m') v_i
+and o / l at the end equals exact softmax attention — every device sees
+every K/V block after axis_size rotations, so no approximation is made.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from seist_tpu.parallel.mesh import AXIS_SEQ
+
+
+def _rotate(x, axis_name: str, axis_size: int):
+    """Send this device's block to the next ring neighbor."""
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def ring_attention_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = AXIS_SEQ,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Per-device body (call inside ``shard_map``): local blocks
+    ``q (N, Lq, H, E)``, ``k/v (N, Lk, H, E)`` sharded on the sequence axis.
+
+    Returns the local ``(N, Lq, H, E)`` output block of exact attention over
+    the *global* sequence.
+    """
+    n, lq, h, e = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(e)
+    axis_size = lax.psum(1, axis_name)
+
+    o = jnp.zeros((n, h, lq, e), dtype=jnp.float32)
+    m = jnp.full((n, h, lq), -jnp.inf, dtype=jnp.float32)
+    l = jnp.zeros((n, h, lq), dtype=jnp.float32)
+    if hasattr(lax, "pvary"):
+        # Newer shard_map tracks varying-axis types through scan: the carry
+        # becomes seq-varying after one step, so the initial values must be
+        # marked varying too.
+        o, m, l = (lax.pvary(t, (axis_name,)) for t in (o, m, l))
+
+    def body(carry, _):
+        o, m, l, k_blk, v_blk = carry
+        s = jnp.einsum(
+            "nlhe,nmhe->nhlm", q * scale, k_blk, preferred_element_type=jnp.float32
+        )
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "nhlm,nmhe->nhle", p, v_blk, preferred_element_type=jnp.float32
+        )
+        k_blk = _rotate(k_blk, axis_name, axis_size)
+        v_blk = _rotate(v_blk, axis_name, axis_size)
+        return (o_new, m_new, l_new, k_blk, v_blk), None
+
+    (o, m, l, _, _), _ = lax.scan(
+        body, (o, m, l, k.astype(jnp.float32), v.astype(jnp.float32)),
+        None, length=axis_size,
+    )
+    out = o / l[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    seq_axis: str = AXIS_SEQ,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact attention with Q/K/V ``(N, L, H, E)`` sequence-sharded over
+    ``mesh[seq_axis]``. Global L must divide evenly by the axis size."""
+    spec = P(None, seq_axis, None, None)
+    body = partial(ring_attention_local, axis_name=seq_axis, scale=scale)
+    try:
+        from jax import shard_map
+
+        fn = shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
+    except ImportError:  # older jax keeps the experimental path + check_rep
+        from jax.experimental.shard_map import shard_map
+
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_rep=False,
+        )
+    return fn(q, k, v)
+
+
+def dense_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Single-device reference: plain softmax attention over (N, L, H, E)."""
+    e = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(e)
+    s = jnp.einsum("nlhe,nmhe->nhlm", q * scale, k)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("nhlm,nmhe->nlhe", p, v)
+    return out

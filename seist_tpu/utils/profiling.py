@@ -1,0 +1,66 @@
+"""Profiling / tracing — a first-class subsystem the reference lacks
+(SURVEY.md §5: only coarse epoch timing + TensorBoard scalars).
+
+* :func:`trace` — context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable trace (XLA ops, fusion, HBM traffic) to the log dir.
+* :func:`device_memory_stats` — per-device HBM usage snapshot.
+* :class:`ThroughputMeter` — waveforms/sec with warmup skip, the number
+  BASELINE.md's north-star metric is quoted in.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, List, Optional
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """``with trace(dir):`` profiles everything inside; view with
+    TensorBoard's profile plugin or Perfetto."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def device_memory_stats() -> List[Dict[str, float]]:
+    """Per-device memory stats (bytes). Empty list on backends without
+    memory_stats support (CPU)."""
+    import jax
+
+    out = []
+    for d in jax.devices():
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            out.append({"device": str(d), **{k: float(v) for k, v in stats.items()}})
+    return out
+
+
+class ThroughputMeter:
+    """Waveforms/sec over a sliding run, skipping compile-time warmup steps."""
+
+    def __init__(self, warmup_steps: int = 2):
+        self._warmup = warmup_steps
+        self._count = 0
+        self._items = 0
+        self._start: Optional[float] = None
+
+    def step(self, n_items: int) -> None:
+        self._count += 1
+        if self._count == self._warmup + 1:
+            self._start = time.perf_counter()
+            self._items = 0
+        if self._count > self._warmup:
+            self._items += n_items
+
+    @property
+    def items_per_sec(self) -> float:
+        if self._start is None or self._items == 0:
+            return 0.0
+        dt = time.perf_counter() - self._start
+        return self._items / dt if dt > 0 else 0.0

@@ -125,6 +125,20 @@ class TestLoader:
         assert len(all_meta) == len(sds)
         assert len(set(all_meta)) == len(sds)
 
+    def test_uneven_shards_equal_batch_counts(self):
+        # 19 train events (80% of 24), 2 shards, batch 3: without wrap
+        # padding host0 gets 10 rows / host1 9 -> different batch counts ->
+        # multi-host collective deadlock (code-review finding).
+        sds = make_sds(n=24)
+        lens = set()
+        for shard in range(2):
+            loader = pipeline.Loader(
+                sds, batch_size=3, num_shards=2, shard_index=shard, drop_last=True
+            )
+            lens.add(len(loader))
+            assert len(list(loader)) == len(loader)
+        assert len(lens) == 1
+
     def test_epoch_reshuffle(self):
         sds = make_sds(n=30)
         loader = pipeline.Loader(sds, batch_size=8, shuffle=True, drop_last=True)

@@ -63,6 +63,21 @@ class TestPhasePicking:
         ordered = np.asarray(M.order_phases(t, p))
         np.testing.assert_array_equal(ordered, [[12, 49, 88]])
 
+    def test_order_phases_with_padding(self):
+        # A PAD prediction (-1e7) is ~1e7 away from real targets — masked
+        # cells must never be re-selected over it (divergence from the
+        # reference's 1e6 mask constant, which loses the true match here).
+        pad = -(10**7)
+        t = np.array([[1000, 2000]])
+        p = np.array([[1010, pad]])
+        ordered = np.asarray(M.order_phases(t, p))
+        np.testing.assert_array_equal(ordered, [[1010, pad]])
+        m = make("ppk", ["precision", "recall"], fs=100, thr=0.1, n=8192)
+        m.compute(t, p)
+        r = m.get_all_metrics()
+        assert r["recall"] == pytest.approx(1 / 2, abs=1e-4)
+        assert r["precision"] == pytest.approx(1 / 1, abs=1e-4)
+
 
 class TestDetection:
     def test_overlap(self):

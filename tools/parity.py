@@ -1,0 +1,175 @@
+"""torch -> flax checkpoint converter for golden-parity testing.
+
+One-shot tooling (NOT in the product path, per SURVEY.md §7.9): maps the
+reference's shipped ``pretrained/*.pth`` state-dicts (raw tensors, torch
+layout) onto this framework's flax variable tree so the same weights can be
+forward-compared. The reference's SeisT param naming
+(models/seist.py:613-852) and ours were designed to correspond 1:1:
+
+    stem.{i}.*                    -> params/stem{i}/*
+    encoder_layers.{i}.0.*        -> params/stage{i}_aggr/*
+    encoder_layers.{i}.{j+1}.*    -> params/stage{i}_block{j}/*
+    out_head.up_layers.{i}.conv   -> params/out_head/conv{i}   (+ norm{i})
+    out_head.out_conv / linear    -> params/out_head/...
+    convs.{k}/norms.{k}/projs.{k} -> conv{k}/norm{k}/proj{k}
+
+Per-leaf layout transforms are shape-driven:
+    torch Conv1d  (out, in/g, k) -> flax Conv kernel (k, in/g, out)
+    torch Linear / 1x1 Conv1d    -> flax Dense kernel (in, out)
+    BatchNorm weight/bias        -> params .../scale, .../bias
+    BatchNorm running_mean/var   -> batch_stats .../mean, .../var
+    num_batches_tracked          -> dropped
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_BN_MAP = {
+    "weight": ("params", "scale"),
+    "bias": ("params", "bias"),
+    "running_mean": ("batch_stats", "mean"),
+    "running_var": ("batch_stats", "var"),
+}
+_BN_LEAVES = set(_BN_MAP) | {"num_batches_tracked"}
+
+
+def torch_key_to_flax(key: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
+    """Map one torch state-dict key to (collection, flax path) or None to skip."""
+    parts = key.split(".")
+    leaf = parts[-1]
+    parent = parts[-2] if len(parts) > 1 else ""
+
+    # Norm leaves re-route by collection. Norm modules are named "norm",
+    # "norm{k}", "out_norm", or live in a "norms.{k}" list.
+    collection = "params"
+    if parent.isdigit():
+        norm_parent = len(parts) > 2 and parts[-3] == "norms"
+    else:
+        norm_parent = parent == "out_norm" or re.fullmatch(r"norm\d*", parent)
+    is_norm_leaf = leaf in _BN_LEAVES and bool(norm_parent)
+    if leaf == "num_batches_tracked":
+        return None
+    if is_norm_leaf:
+        collection, leaf = _BN_MAP[leaf]
+    elif leaf == "weight":
+        leaf = "kernel"
+
+    out: list = []
+    i = 0
+    while i < len(parts) - 1:
+        p = parts[i]
+        if p == "stem":
+            out.append(f"stem{parts[i + 1]}")
+            i += 2
+        elif p == "encoder_layers":
+            stage, blk = int(parts[i + 1]), int(parts[i + 2])
+            out.append(
+                f"stage{stage}_aggr" if blk == 0 else f"stage{stage}_block{blk - 1}"
+            )
+            i += 3
+        elif p == "out_head":
+            out.append("out_head")
+            i += 1
+        elif p == "up_layers":
+            # up_layers.{k}.conv -> conv{k}; up_layers.{k}.norm -> norm{k}
+            k = parts[i + 1]
+            nxt = parts[i + 2]
+            out.append(f"{nxt}{k}")
+            i += 3
+        elif (
+            p in ("convs", "norms", "projs")
+            and i + 1 < len(parts)
+            and parts[i + 1].isdigit()
+        ):
+            out.append(f"{p[:-1]}{parts[i + 1]}")
+            i += 2
+        else:
+            out.append(p)
+            i += 1
+    out.append(leaf)
+    return collection, tuple(out)
+
+
+def _fit_leaf(value: np.ndarray, target_shape: Tuple[int, ...], key: str) -> np.ndarray:
+    """Layout-transform a torch tensor to the flax leaf shape."""
+    v = np.asarray(value)
+    if tuple(v.shape) == tuple(target_shape):
+        return v
+    if len(target_shape) == 3 and v.ndim == 3:
+        t = v.transpose(2, 1, 0)  # (out,in,k) -> (k,in,out)
+        if tuple(t.shape) == tuple(target_shape):
+            return t
+    if len(target_shape) == 2:
+        if v.ndim == 3 and v.shape[-1] == 1:
+            v = v[:, :, 0]
+        if v.ndim == 2:
+            t = v.T  # (out,in) -> (in,out)
+            if tuple(t.shape) == tuple(target_shape):
+                return t
+    raise ValueError(
+        f"Cannot fit '{key}' {v.shape} into flax leaf {target_shape}"
+    )
+
+
+def convert_state_dict(
+    state_dict: Dict[str, Any], flax_variables: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Convert a torch state-dict into {'params', 'batch_stats'} matching
+    ``flax_variables``'s tree. Raises on unmapped or missing leaves."""
+    import jax
+
+    flat_target = {}
+    for coll in ("params", "batch_stats"):
+        if coll not in flax_variables:
+            continue
+        leaves = jax.tree_util.tree_flatten_with_path(flax_variables[coll])[0]
+        for path, leaf in leaves:
+            key = tuple(str(k.key) for k in path)
+            flat_target[(coll, key)] = np.shape(leaf)
+
+    converted: Dict[Tuple[str, Tuple[str, ...]], np.ndarray] = {}
+    for tkey, tval in state_dict.items():
+        mapped = torch_key_to_flax(tkey)
+        if mapped is None:
+            continue
+        coll, path = mapped
+        if (coll, path) not in flat_target:
+            raise KeyError(
+                f"torch key '{tkey}' mapped to unknown flax leaf {coll}/{'/'.join(path)}"
+            )
+        converted[(coll, path)] = _fit_leaf(
+            tval.detach().cpu().numpy() if hasattr(tval, "detach") else tval,
+            flat_target[(coll, path)],
+            tkey,
+        )
+
+    missing = set(flat_target) - set(converted)
+    if missing:
+        raise KeyError(f"flax leaves not covered by checkpoint: {sorted(missing)[:8]}")
+
+    out: Dict[str, Any] = {"params": {}, "batch_stats": {}}
+    for (coll, path), val in converted.items():
+        node = out[coll]
+        for piece in path[:-1]:
+            node = node.setdefault(piece, {})
+        node[path[-1]] = val
+    if not out["batch_stats"]:
+        out.pop("batch_stats")
+    return out
+
+
+def load_reference_checkpoint(model_name: str, dataset: str = "diting"):
+    """Load a shipped reference checkpoint and convert it for our model."""
+    import torch
+
+    from seist_tpu.models import api
+
+    path = f"/root/reference/pretrained/{model_name}_{dataset}.pth"
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    model = api.create_model(model_name, in_samples=8192)
+    shapes = api.param_shapes(model, in_samples=8192)
+    return model, convert_state_dict(sd, shapes)
