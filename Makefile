@@ -1,0 +1,18 @@
+# seist_tpu build targets.
+
+NATIVE_DIR := seist_tpu/native
+CXX ?= g++
+CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
+
+.PHONY: native test clean
+
+native: $(NATIVE_DIR)/libwavekit.so
+
+$(NATIVE_DIR)/libwavekit.so: $(NATIVE_DIR)/wavekit.cpp
+	$(CXX) $(CXXFLAGS) -o $@ $<
+
+test:
+	python -m pytest tests/ -x -q
+
+clean:
+	rm -f $(NATIVE_DIR)/libwavekit.so

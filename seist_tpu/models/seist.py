@@ -254,10 +254,20 @@ class AttentionBlock(nn.Module):
         v = v.reshape(N, M, num_heads, E)
         k = nn.Dropout(self.key_drop_rate, deterministic=not train)(k)
 
-        attn = jnp.einsum("nlhe,nmhe->nhlm", q / math.sqrt(E), k)
-        attn = nn.softmax(attn, axis=-1)
-        attn = nn.Dropout(self.attn_drop_rate, deterministic=not train)(attn)
-        out = jnp.einsum("nhlm,nmhe->nlhe", attn, v).reshape(N, L, C)
+        if self.attn_drop_rate > 0 and train:
+            # Probability-space dropout forces materializing the attention
+            # matrix — plain XLA path.
+            attn = jnp.einsum("nlhe,nmhe->nhlm", q / math.sqrt(E), k)
+            attn = nn.softmax(attn, axis=-1)
+            attn = nn.Dropout(self.attn_drop_rate, deterministic=False)(attn)
+            out = jnp.einsum("nhlm,nmhe->nlhe", attn, v).reshape(N, L, C)
+        else:
+            # Fused Pallas kernel on TPU (qk + softmax + pv in VMEM, no
+            # (N,H,L,M) HBM tensor); identical-math einsum fallback elsewhere.
+            from seist_tpu.ops.pallas_attention import fused_pooled_attention
+
+            out = fused_pooled_attention(q, k, v, 1.0 / math.sqrt(E))
+            out = out.reshape(N, L, C)
 
         out = nn.Dense(
             self.io_dim, use_bias=self.qkv_bias, name="out_proj", **_dense_kw

@@ -1,0 +1,92 @@
+"""ctypes bindings for the native wavekit kernels (see wavekit.cpp).
+
+Loads ``libwavekit.so`` from this directory if present (build with
+``make native``); all callers fall back to the pure-numpy implementations
+when the library is absent, so the build is optional. Set
+``SEIST_TPU_NATIVE=0`` to force the numpy path even when built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libwavekit.so")
+_lib: Optional[ctypes.CDLL] = None
+
+if os.environ.get("SEIST_TPU_NATIVE", "auto") != "0" and os.path.exists(_LIB_PATH):
+    try:
+        _lib = ctypes.CDLL(_LIB_PATH)
+        _lib.znorm_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_int,
+        ]
+        _lib.soft_label_add_f64.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+        ]
+    except OSError:
+        _lib = None
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+_NORM_MODES = {"std": 0, "max": 1, "": 2}
+
+
+def znorm(data: np.ndarray, mode: str) -> bool:
+    """In-place per-channel normalize of a C-contiguous (C, L) float32
+    array. Returns False (caller should use numpy) when unsupported."""
+    if (
+        _lib is None
+        or data.dtype != np.float32
+        or not data.flags.c_contiguous
+        or data.ndim != 2
+        or mode not in _NORM_MODES
+    ):
+        return False
+    _lib.znorm_f32(
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        data.shape[0],
+        data.shape[1],
+        _NORM_MODES[mode],
+    )
+    return True
+
+
+def soft_label_add(
+    out: np.ndarray, idxs: np.ndarray, window: np.ndarray, width: int
+) -> bool:
+    """Add label windows into ``out`` (float64, length L) at ``idxs``.
+    Returns False when the native path is unavailable (including windows
+    wider than the array — the numpy path raises loudly on that config and
+    the native kernel must not silently clip it)."""
+    if (
+        _lib is None
+        or out.dtype != np.float64
+        or not out.flags.c_contiguous
+        or width + 1 > out.shape[0]
+    ):
+        return False
+    idxs = np.ascontiguousarray(idxs, dtype=np.int64)
+    window = np.ascontiguousarray(window, dtype=np.float64)
+    _lib.soft_label_add_f64(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        out.shape[0],
+        idxs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        idxs.shape[0],
+        window.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        width,
+    )
+    return True

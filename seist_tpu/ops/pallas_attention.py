@@ -1,0 +1,167 @@
+"""Pallas TPU kernel: fused pooled-KV attention.
+
+The SeisT encoder's attention keeps full-length Q but pools K/V by
+``attn_aggr_ratio`` (ref seist.py:321-393), so scores are (L x M) with
+M = L/r. XLA's unfused path materializes the (N, H, L, M) probability
+tensor in HBM — at the reference training shape (batch 500, stage 1:
+L=1024, M=128) that is ~0.5 GB of HBM traffic per layer per direction.
+This kernel fuses qk-matmul + softmax + pv-matmul in VMEM (one grid step
+per batch-head; L, M and E are small enough that a whole batch-head's
+Q/K/V fit on-chip), writing only the (L, E) output.
+
+Training works through a custom VJP whose backward is a second fused
+kernel (recompute-p flash-style backward), so no probability tensor is
+ever materialized in either direction.
+
+``fused_pooled_attention`` is numerically identical (fp32) to the einsum
+path the model uses elsewhere; on non-TPU backends it falls back to that
+einsum, and ``interpret=True`` drives the same kernels through the Pallas
+interpreter for CPU testing.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _einsum_attention(q, k, v, scale):
+    s = jnp.einsum("nlhe,nmhe->nhlm", q * scale, k)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("nhlm,nmhe->nlhe", p, v)
+
+
+# -- kernels (operate on one (batch*head) slice in VMEM) ---------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale):
+    q = q_ref[0].astype(jnp.float32)  # (L, E)
+    k = k_ref[0].astype(jnp.float32)  # (M, E)
+    v = v_ref[0].astype(jnp.float32)  # (M, E)
+    s = jnp.dot(q * scale, k.T, preferred_element_type=jnp.float32)  # (L, M)
+    s = s - s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s)
+    p = p / p.sum(axis=-1, keepdims=True)
+    o_ref[0] = jnp.dot(p, v, preferred_element_type=jnp.float32).astype(
+        o_ref.dtype
+    )
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, g_ref, dq_ref, dk_ref, dv_ref, *, scale):
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)  # (L, E) upstream grad
+    s = jnp.dot(q * scale, k.T, preferred_element_type=jnp.float32)
+    s = s - s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s)
+    p = p / p.sum(axis=-1, keepdims=True)  # recomputed probs (L, M)
+    dv = jnp.dot(p.T, g, preferred_element_type=jnp.float32)
+    dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)  # (L, M)
+    ds = p * (dp - (dp * p).sum(axis=-1, keepdims=True))  # softmax jvp
+    dq = jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+    dk = jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * scale
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flatten_heads(x):
+    n, l, h, e = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(n * h, l, e)
+
+
+def _unflatten_heads(x, n, h):
+    nh, l, e = x.shape
+    return jnp.transpose(x.reshape(n, h, l, e), (0, 2, 1, 3))
+
+
+def _call_fused(kernel, out_shapes, inputs, interpret):
+    from jax.experimental import pallas as pl
+
+    nh = inputs[0].shape[0]
+
+    def spec(x):
+        return pl.BlockSpec((1,) + x.shape[1:], lambda i: (i, 0, 0))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nh,),
+        in_specs=[spec(x) for x in inputs],
+        out_specs=(
+            [spec_like(o) for o in out_shapes]
+            if isinstance(out_shapes, (list, tuple))
+            else spec_like(out_shapes)
+        ),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(*inputs)
+
+
+def spec_like(sds):
+    from jax.experimental import pallas as pl
+
+    return pl.BlockSpec((1,) + sds.shape[1:], lambda i: (i, 0, 0))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused(q3, k3, v3, scale, interpret):
+    o = _call_fused(
+        partial(_fwd_kernel, scale=scale),
+        jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        (q3, k3, v3),
+        interpret,
+    )
+    return o
+
+
+def _fused_fwd(q3, k3, v3, scale, interpret):
+    return _fused(q3, k3, v3, scale, interpret), (q3, k3, v3)
+
+
+def _fused_bwd(scale, interpret, res, g):
+    q3, k3, v3 = res
+    dq, dk, dv = _call_fused(
+        partial(_bwd_kernel, scale=scale),
+        (
+            jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+        ),
+        (q3, k3, v3, g),
+        interpret,
+    )
+    return dq, dk, dv
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_pooled_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    scale: Optional[float] = None,
+    *,
+    interpret: bool = False,
+    force: bool = False,
+) -> jnp.ndarray:
+    """Fused attention for ``q (N, L, H, E)``, ``k/v (N, M, H, E)``.
+
+    Uses the Pallas kernel on TPU (or when ``interpret``/``force`` is set);
+    otherwise the XLA einsum path — both compute identical fp32 math.
+    """
+    e = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(e)
+    on_tpu = jax.default_backend() == "tpu"
+    if not (on_tpu or interpret or force):
+        return _einsum_attention(q, k, v, scale)
+    n, _, h, _ = q.shape
+    o3 = _fused(
+        _flatten_heads(q), _flatten_heads(k), _flatten_heads(v), scale, interpret
+    )
+    return _unflatten_heads(o3, n, h)

@@ -54,17 +54,7 @@ def ring_attention_local(
     scale = scale if scale is not None else 1.0 / math.sqrt(e)
     axis_size = lax.psum(1, axis_name)
 
-    o = jnp.zeros((n, h, lq, e), dtype=jnp.float32)
-    m = jnp.full((n, h, lq), -jnp.inf, dtype=jnp.float32)
-    l = jnp.zeros((n, h, lq), dtype=jnp.float32)
-    if hasattr(lax, "pvary"):
-        # Newer shard_map tracks varying-axis types through scan: the carry
-        # becomes seq-varying after one step, so the initial values must be
-        # marked varying too.
-        o, m, l = (lax.pvary(t, (axis_name,)) for t in (o, m, l))
-
-    def body(carry, _):
-        o, m, l, k_blk, v_blk = carry
+    def accumulate(o, m, l, k_blk, v_blk):
         s = jnp.einsum(
             "nlhe,nmhe->nhlm", q * scale, k_blk, preferred_element_type=jnp.float32
         )
@@ -75,14 +65,36 @@ def ring_attention_local(
         o_new = o * corr[..., None] + jnp.einsum(
             "nhlm,nmhe->nhle", p, v_blk, preferred_element_type=jnp.float32
         )
+        return o_new, m_new, l_new
+
+    o = jnp.zeros((n, h, lq, e), dtype=jnp.float32)
+    m = jnp.full((n, h, lq), -jnp.inf, dtype=jnp.float32)
+    l = jnp.zeros((n, h, lq), dtype=jnp.float32)
+    if hasattr(lax, "pvary"):
+        # Newer shard_map tracks varying-axis types through scan: the carry
+        # becomes seq-varying after one step, so the initial values must be
+        # marked varying too.
+        o, m, l = (lax.pvary(t, (axis_name,)) for t in (o, m, l))
+
+    # Peel the first (local-block) step so the scan rotates BEFORE each
+    # accumulation — axis_size-1 rotations total, none wasted on a block
+    # that would be discarded.
+    o, m, l = accumulate(o, m, l, k.astype(jnp.float32), v.astype(jnp.float32))
+
+    def body(carry, _):
+        o, m, l, k_blk, v_blk = carry
         k_blk = _rotate(k_blk, axis_name, axis_size)
         v_blk = _rotate(v_blk, axis_name, axis_size)
-        return (o_new, m_new, l_new, k_blk, v_blk), None
+        o, m, l = accumulate(o, m, l, k_blk, v_blk)
+        return (o, m, l, k_blk, v_blk), None
 
-    (o, m, l, _, _), _ = lax.scan(
-        body, (o, m, l, k.astype(jnp.float32), v.astype(jnp.float32)),
-        None, length=axis_size,
-    )
+    if axis_size > 1:
+        (o, m, l, _, _), _ = lax.scan(
+            body,
+            (o, m, l, k.astype(jnp.float32), v.astype(jnp.float32)),
+            None,
+            length=axis_size - 1,
+        )
     out = o / l[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
@@ -124,10 +136,10 @@ def dense_attention(
     v: jnp.ndarray,
     scale: Optional[float] = None,
 ) -> jnp.ndarray:
-    """Single-device reference: plain softmax attention over (N, L, H, E)."""
+    """Single-device reference: plain softmax attention over (N, L, H, E).
+    Shared implementation — see pallas_attention._einsum_attention."""
+    from seist_tpu.ops.pallas_attention import _einsum_attention
+
     e = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(e)
-    s = jnp.einsum("nlhe,nmhe->nhlm", q * scale, k)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("nhlm,nmhe->nlhe", p, v)
-    return out
+    return _einsum_attention(q, k, v, scale)

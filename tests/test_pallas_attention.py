@@ -1,0 +1,69 @@
+"""Fused pooled-KV attention kernel == einsum attention (Pallas interpreter
+on CPU; the same kernel compiles for TPU)."""
+
+import jax
+import numpy as np
+import pytest
+
+from seist_tpu.ops.pallas_attention import (
+    _einsum_attention,
+    fused_pooled_attention,
+)
+
+
+def _qkv(rng, n=2, l=64, m=16, h=2, e=8):
+    q = rng.normal(size=(n, l, h, e)).astype(np.float32)
+    k = rng.normal(size=(n, m, h, e)).astype(np.float32)
+    v = rng.normal(size=(n, m, h, e)).astype(np.float32)
+    return q, k, v
+
+
+def test_forward_matches_einsum(rng):
+    q, k, v = _qkv(rng)
+    want = np.asarray(_einsum_attention(q, k, v, 1.0 / np.sqrt(q.shape[-1])))
+    got = np.asarray(fused_pooled_attention(q, k, v, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_forward_pooled_shapes(rng):
+    # L != M (pooled K/V) and E not a lane multiple.
+    q, k, v = _qkv(rng, l=128, m=16, e=24)
+    want = np.asarray(_einsum_attention(q, k, v, 1.0 / np.sqrt(24)))
+    got = np.asarray(fused_pooled_attention(q, k, v, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_extreme_logits(rng):
+    q, k, v = _qkv(rng)
+    q *= 40.0
+    want = np.asarray(_einsum_attention(q, k, v, 1.0 / np.sqrt(q.shape[-1])))
+    got = np.asarray(fused_pooled_attention(q, k, v, interpret=True))
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_custom_vjp_matches_einsum_grads(rng):
+    q, k, v = _qkv(rng, n=1, l=32, m=8)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    def loss_fused(q, k, v):
+        return (fused_pooled_attention(q, k, v, interpret=True) ** 2).sum()
+
+    def loss_einsum(q, k, v):
+        return (_einsum_attention(q, k, v, scale) ** 2).sum()
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    ge = jax.grad(loss_einsum, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, ge, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+            err_msg=f"d{name}",
+        )
+
+
+def test_cpu_fallback_is_einsum(rng):
+    # Without interpret/force on CPU the public API silently uses einsum.
+    q, k, v = _qkv(rng)
+    got = np.asarray(fused_pooled_attention(q, k, v))
+    want = np.asarray(_einsum_attention(q, k, v, 1.0 / np.sqrt(q.shape[-1])))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)

@@ -107,8 +107,9 @@ def test_normalize_std():
     pp = make_pp()
     data = np.random.default_rng(0).normal(3.0, 5.0, size=(3, 256))
     out = pp._normalize(data.copy(), "std")
-    np.testing.assert_allclose(out.mean(axis=1), 0, atol=1e-9)
-    np.testing.assert_allclose(out.std(axis=1), 1, atol=1e-9)
+    # fp32 tolerance: the native wavekit path computes in float32.
+    np.testing.assert_allclose(out.mean(axis=1), 0, atol=1e-6)
+    np.testing.assert_allclose(out.std(axis=1), 1, atol=1e-6)
 
 
 def test_normalize_max_zero_guard():
@@ -122,7 +123,7 @@ def test_normalize_empty_mode_only_demeans():
     pp = make_pp()
     data = np.random.default_rng(0).normal(3.0, 5.0, size=(3, 64))
     out = pp._normalize(data.copy(), "")
-    np.testing.assert_allclose(out.mean(axis=1), 0, atol=1e-9)
+    np.testing.assert_allclose(out.mean(axis=1), 0, atol=1e-6)
     assert out.std() > 1.5  # not scaled
 
 

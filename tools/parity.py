@@ -95,24 +95,31 @@ def torch_key_to_flax(key: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
 
 
 def _fit_leaf(value: np.ndarray, target_shape: Tuple[int, ...], key: str) -> np.ndarray:
-    """Layout-transform a torch tensor to the flax leaf shape."""
+    """Layout-transform a torch tensor to the flax leaf shape.
+
+    The transform is decided by the tensors' RANKS, never by a shape match:
+    a square Linear (in==out) or a conv with out_channels==kernel_size is
+    coincidentally target-shaped untransposed, and an equality early-return
+    would silently convert it wrong. Every 2-D/3-D torch weight needs its
+    transpose; only 1-D vectors pass through.
+    """
     v = np.asarray(value)
-    if tuple(v.shape) == tuple(target_shape):
-        return v
-    if len(target_shape) == 3 and v.ndim == 3:
+    if v.ndim <= 1:
+        t = v
+    elif len(target_shape) == 3 and v.ndim == 3:
         t = v.transpose(2, 1, 0)  # (out,in,k) -> (k,in,out)
-        if tuple(t.shape) == tuple(target_shape):
-            return t
-    if len(target_shape) == 2:
+    elif len(target_shape) == 2:
         if v.ndim == 3 and v.shape[-1] == 1:
-            v = v[:, :, 0]
-        if v.ndim == 2:
-            t = v.T  # (out,in) -> (in,out)
-            if tuple(t.shape) == tuple(target_shape):
-                return t
-    raise ValueError(
-        f"Cannot fit '{key}' {v.shape} into flax leaf {target_shape}"
-    )
+            v = v[:, :, 0]  # 1x1 Conv1d used as a Linear
+        t = v.T  # (out,in) -> (in,out)
+    else:
+        t = v
+    if tuple(t.shape) != tuple(target_shape):
+        raise ValueError(
+            f"Cannot fit '{key}' {np.asarray(value).shape} into flax leaf "
+            f"{target_shape}"
+        )
+    return t
 
 
 def convert_state_dict(
