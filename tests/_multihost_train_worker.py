@@ -1,0 +1,50 @@
+"""Subprocess body for the 2-host end-to-end training test.
+
+Runs one epoch of phasenet on the synthetic dataset through the REAL
+train_worker + validate path: per-host loader shards, global batch assembly,
+mask-weighted global eval loss, cross-host metric sync, orbax multi-host
+checkpoint save. Exit 0 = finished and produced a checkpoint.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+proc_id, nprocs, port, logdir = (
+    int(sys.argv[1]),
+    int(sys.argv[2]),
+    sys.argv[3],
+    sys.argv[4],
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}",
+    num_processes=nprocs,
+    process_id=proc_id,
+)
+
+import seist_tpu  # noqa: E402
+from seist_tpu.utils.logger import logger  # noqa: E402
+
+seist_tpu.load_all()
+logger.set_logdir(os.path.join(logdir, f"proc{proc_id}"))
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_worker_e2e import make_args  # noqa: E402
+
+from seist_tpu.train.worker import train_worker  # noqa: E402
+
+args = make_args(
+    epochs=1,
+    batch_size=4,  # per-host; global 8 over the 8-device mesh
+    workers=2,
+    dataset_kwargs={"num_events": 30, "trace_samples": 4096},
+)
+ckpt = train_worker(args)
+assert ckpt and os.path.exists(ckpt), ckpt
+print(f"train worker {proc_id}: OK ckpt={ckpt}")

@@ -1,0 +1,77 @@
+"""Subprocess body for tests/test_multihost.py — one simulated host.
+
+Run as: python _multihost_worker.py <process_id> <num_processes> <port>
+Each process gets 4 virtual CPU devices; together they form one 8-device
+JAX runtime, exercising the real multi-host code paths (global array
+assembly from local shards, counter/target sync, object broadcast).
+Exit code 0 = all checks passed.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import numpy as np  # noqa: E402
+
+proc_id, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}",
+    num_processes=nprocs,
+    process_id=proc_id,
+)
+assert jax.process_count() == nprocs, jax.process_count()
+assert len(jax.devices()) == 4 * nprocs, len(jax.devices())
+
+import jax.numpy as jnp  # noqa: E402
+
+from seist_tpu.ops.metrics import Metrics  # noqa: E402
+from seist_tpu.parallel.dist import broadcast_object  # noqa: E402
+from seist_tpu.parallel.mesh import make_mesh, shard_batch, to_local  # noqa: E402
+
+# --- 1. object broadcast (checkpoint-path use case) -------------------------
+obj = {"path": "checkpoints/model-7", "loss": 0.25} if proc_id == 0 else None
+got = broadcast_object(obj)
+assert got == {"path": "checkpoints/model-7", "loss": 0.25}, got
+
+# --- 2. global array from per-host shards + jitted global reduction ---------
+mesh = make_mesh()  # (8, 1, 1) over both processes
+local = np.full((4, 3), float(proc_id + 1), dtype=np.float32)  # host rows
+gbl = shard_batch(mesh, local)
+assert gbl.shape == (8, 3), gbl.shape  # global batch = 2 hosts x 4
+
+total = float(jax.jit(jnp.sum)(gbl))
+assert total == (1.0 * 12 + 2.0 * 12), total
+
+# --- 3. to_local returns exactly this host's rows ---------------------------
+back = to_local(gbl)
+np.testing.assert_array_equal(back, local)
+
+# --- 4. metrics sync with UNEQUAL per-host row counts (r2 target gather) ----
+m = Metrics("emg", ["mae", "r2"], sampling_rate=50, time_threshold=0.1,
+            num_samples=8192)
+if proc_id == 0:
+    t = np.array([[1.0], [2.0], [3.0]])
+    p = np.array([[1.5], [2.0], [2.0]])
+else:
+    t = np.array([[4.0], [6.0]])
+    p = np.array([[5.0], [6.0]])
+m.compute(t, p)
+m.synchronize_between_processes()
+r = m.get_all_metrics()
+
+t_all = np.array([[1.0], [2.0], [3.0], [4.0], [6.0]])
+p_all = np.array([[1.5], [2.0], [2.0], [5.0], [6.0]])
+res = t_all - p_all
+mae_want = np.abs(res).mean()
+tc = t_all - t_all.mean()
+r2_want = 1 - (res**2).mean(-1).sum() / ((tc**2).mean(-1).sum() + 1e-6)
+assert abs(r["mae"] - mae_want) < 1e-5, (r["mae"], mae_want)
+assert abs(r["r2"] - r2_want) < 1e-5, (r["r2"], r2_want)
+
+print(f"worker {proc_id}: OK")
