@@ -38,6 +38,26 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--model-name", default="seist_m_dpk", type=str)
     parser.add_argument("--checkpoint", default="", type=str,
                         help="path to latest checkpoint (default: none)")
+    parser.add_argument("--seq-shards", default=1, type=int,
+                        dest="seq_shards",
+                        help="shard the sequence axis over this many devices "
+                        "(ring attention through the SeisT attention blocks; "
+                        "device count must be divisible; use for long "
+                        "--in-samples). Default 1 = pure data parallel")
+    parser.add_argument("--conv-kernel-l1-alpha", default=0.0, type=float,
+                        dest="conv_kernel_l1_alpha",
+                        help="L1 (sign) regularization strength on "
+                        "eqtransformer's encoder/decoder conv kernels "
+                        "(ref eqtransformer.py conv_kernel_l1_regularization)")
+    parser.add_argument("--conv-bias-l1-alpha", default=0.0, type=float,
+                        dest="conv_bias_l1_alpha",
+                        help="as --conv-kernel-l1-alpha, for conv biases")
+    parser.add_argument("--dtype", default="fp32", type=str,
+                        choices=["fp32", "bf16"],
+                        help="compute dtype for train/eval steps: bf16 runs "
+                        "matmuls/activations in bfloat16 on the MXU with "
+                        "fp32 params/optimizer/BN-stats/softmax/loss "
+                        "(default: fp32)")
 
     # Random seed
     parser.add_argument("--seed", default=0, type=int)
@@ -155,6 +175,15 @@ def main_worker(args: argparse.Namespace) -> None:
         if not args.checkpoint
         else args.checkpoint.split("checkpoints")[0]
     )
+    # Multi-host: the timestamped dir is built from per-host wall clocks
+    # that can straddle a second boundary; every process must agree on one
+    # path before the collective orbax save (ref broadcasts the ckpt path
+    # rank0->all, train.py:481-482 — here the whole log dir is agreed up
+    # front instead).
+    from seist_tpu.parallel.dist import broadcast_object, process_count
+
+    if process_count() > 1:
+        log_dir = broadcast_object(log_dir)
     logger.set_logdir(log_dir)
     logger.set_logger("global")
     if not is_main_process():

@@ -90,8 +90,13 @@ class DiTing(DatasetBase):
 
     def _load_meta_data(self) -> pd.DataFrame:
         meta_df = self._read_csvs()
+        # Dtype-kind check, not `== object`: pandas >= 3 infers text columns
+        # as the `str` dtype (not `object`), and the stray-space strip
+        # (ref diting.py:95-97) must still run for them.
         for k in meta_df.columns:
-            if meta_df[k].dtype == object:
+            if pd.api.types.is_string_dtype(
+                meta_df[k]
+            ) or meta_df[k].dtype == object:
                 meta_df[k] = meta_df[k].str.replace(" ", "")
         return self._shuffle_and_split(meta_df)
 

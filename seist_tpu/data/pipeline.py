@@ -215,10 +215,24 @@ class Loader:
         self.num_shards = num_shards
         self.shard_index = shard_index
         self.epoch = 0
+        self._pool: Optional[ThreadPoolExecutor] = None
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = int(epoch)
         self.dataset.set_epoch(epoch)
+
+    def close(self) -> None:
+        """Release the worker threads. Safe to call multiple times; the
+        loader remains usable (a new pool spins up on the next __iter__)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+
+    def __del__(self):  # best-effort: Loaders built in loops must not leak
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _indices(self) -> np.ndarray:
         n = len(self.dataset)
@@ -249,24 +263,31 @@ class Loader:
     def __iter__(self) -> Iterator[Batch]:
         indices = self._indices()
         nb = len(self)
-        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-            for b in range(nb):
-                chunk = indices[b * self.batch_size : (b + 1) * self.batch_size]
-                pad = self.batch_size - len(chunk)
-                if pad:
-                    chunk = np.concatenate([chunk, np.repeat(chunk[-1], pad)])
-                samples = list(pool.map(self.dataset.__getitem__, chunk))
-                inputs = _stack([s[0] for s in samples])
-                loss_targets = _stack([s[1] for s in samples])
-                metrics_targets = {
-                    k: np.stack([s[2][k] for s in samples])
-                    for k in samples[0][2]
-                }
-                meta = [s[3] for s in samples]
-                mask = np.ones(self.batch_size, dtype=np.float32)
-                if pad:
-                    mask[-pad:] = 0.0
-                yield Batch(inputs, loss_targets, metrics_targets, meta, mask)
+        # One persistent pool for the loader's lifetime (threads are reused
+        # across epochs instead of re-spawned each __iter__).
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.num_workers,
+                thread_name_prefix="seist-loader",
+            )
+        pool = self._pool
+        for b in range(nb):
+            chunk = indices[b * self.batch_size : (b + 1) * self.batch_size]
+            pad = self.batch_size - len(chunk)
+            if pad:
+                chunk = np.concatenate([chunk, np.repeat(chunk[-1], pad)])
+            samples = list(pool.map(self.dataset.__getitem__, chunk))
+            inputs = _stack([s[0] for s in samples])
+            loss_targets = _stack([s[1] for s in samples])
+            metrics_targets = {
+                k: np.stack([s[2][k] for s in samples])
+                for k in samples[0][2]
+            }
+            meta = [s[3] for s in samples]
+            mask = np.ones(self.batch_size, dtype=np.float32)
+            if pad:
+                mask[-pad:] = 0.0
+            yield Batch(inputs, loss_targets, metrics_targets, meta, mask)
 
 
 def prefetch_to_device(

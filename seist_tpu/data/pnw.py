@@ -43,10 +43,16 @@ class PNW(DatasetBase):
         meta_df = pd.read_csv(
             os.path.join(self._data_dir, self._meta_filename), low_memory=False
         )
+        # Dtype-kind checks, not `== object`: pandas >= 3 infers text
+        # columns as the dedicated `str` dtype, which is not `object` —
+        # the NaN->"" fill (empty polarity cells!) and space-strip must
+        # still run there (ref pnw.py normalization + polarity "" key).
         for k in meta_df.columns:
-            if meta_df[k].dtype in (np.dtype("float"), np.dtype("int")):
+            if pd.api.types.is_numeric_dtype(meta_df[k]):
                 meta_df[k] = meta_df[k].fillna(0)
-            elif meta_df[k].dtype == object:
+            elif pd.api.types.is_string_dtype(
+                meta_df[k]
+            ) or meta_df[k].dtype == object:
                 meta_df[k] = meta_df[k].str.replace(" ", "").fillna("")
         return self._shuffle_and_split(meta_df)
 

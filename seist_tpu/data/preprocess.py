@@ -227,7 +227,11 @@ class DataPreprocessor:
         from seist_tpu import native
 
         if native.available() and mode in ("std", "max", "") and data.ndim == 2:
-            buf = np.ascontiguousarray(data, dtype=np.float32)
+            # Explicit copy: ascontiguousarray returns the caller's array
+            # unchanged when it is already float32 C-contiguous, and the
+            # in-place native kernel would then mutate the caller's data —
+            # the numpy fallback below never does.
+            buf = np.array(data, dtype=np.float32, copy=True, order="C")
             if native.znorm(buf, mode):
                 return buf
         data = data - np.mean(data, axis=1, keepdims=True)

@@ -107,7 +107,7 @@ def avg_pool_1d_ceil(x: Array, kernel_size: int) -> Array:
     counts = jnp.full((n_out,), float(kernel_size))
     last_valid = L - (n_out - 1) * kernel_size
     counts = counts.at[-1].set(float(last_valid))
-    return sums / counts[None, :, None]
+    return sums / counts[None, :, None].astype(x.dtype)
 
 
 def max_pool_1d(x: Array, kernel_size: int) -> Array:
@@ -146,7 +146,7 @@ def interpolate_linear(x: Array, out_size: int) -> Array:
     src = jnp.clip(src, 0.0, L_in - 1)
     lo = jnp.floor(src).astype(jnp.int32)
     hi = jnp.minimum(lo + 1, L_in - 1)
-    w = (src - lo.astype(jnp.float32))[None, :, None]
+    w = (src - lo.astype(jnp.float32))[None, :, None].astype(x.dtype)
     return x[:, lo, :] * (1.0 - w) + x[:, hi, :] * w
 
 
@@ -218,17 +218,26 @@ def make_norm(
     batch, which is exactly the reference's SyncBatchNorm semantics
     (train.py:374) with zero extra code.
     """
+    # Under a bf16 precision policy the norm's *output* dtype is pinned to
+    # bf16: its fp32 running stats would otherwise promote every activation
+    # back to fp32 and silently undo mixed precision for the whole network.
+    # Statistics are still computed in >=fp32 internally (flax guarantees
+    # this for half-precision inputs) and running stats stay fp32.
+    from seist_tpu.train.precision import policy_dtype
+
+    dtype = policy_dtype()
     if norm == "batch":
         return nn.BatchNorm(
             use_running_average=use_running_average,
             momentum=0.9,
             epsilon=1e-5,
+            dtype=dtype,
             name=name,
         )
     if norm == "layer":
-        return nn.LayerNorm(name=name)
+        return nn.LayerNorm(dtype=dtype, name=name)
     if norm == "group":
-        return nn.GroupNorm(num_groups=8, name=name)
+        return nn.GroupNorm(num_groups=8, dtype=dtype, name=name)
     raise NotImplementedError(f"Unknown norm '{norm}'")
 
 

@@ -310,6 +310,29 @@ class EQTransformer(nn.Module):
         return jnp.concatenate(outputs, axis=-1)
 
 
+def l1_param_mask(params, kind: str):
+    """Bool pytree selecting the params the reference L1-regularizes via
+    gradient hooks (ref eqtransformer.py:43-51,388-396): the encoder
+    ConvBlock convs (``encoder/conv{i}/conv``) and the decoder Upsampling
+    convs (``decoder{d}/up{i}/conv``). ``kind`` is 'kernel' or 'bias'.
+
+    Feed to ``train.optim.l1_sign_decay`` (the optax equivalent of the
+    reference's grad hooks) via ``build_optimizer``'s l1 arguments.
+    """
+    import re
+
+    import jax
+
+    assert kind in ("kernel", "bias"), kind
+    pat = re.compile(r"^/(encoder/conv\d+|decoder\d+/up\d+)/conv$")
+
+    def sel(path, _):
+        keys = [str(getattr(k, "key", k)) for k in path]
+        return keys[-1] == kind and bool(pat.match("/" + "/".join(keys[:-1])))
+
+    return jax.tree_util.tree_map_with_path(sel, params)
+
+
 @register_model
 def eqtransformer(**kwargs) -> EQTransformer:
     kwargs = {k: v for k, v in kwargs.items() if k in EQTransformer.__dataclass_fields__}

@@ -21,6 +21,7 @@ from __future__ import annotations
 import math
 from typing import Any, Callable, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -32,6 +33,17 @@ Array = jnp.ndarray
 
 _dense_kw = dict(kernel_init=trunc_normal_init)
 _conv_kw = dict(kernel_init=trunc_normal_init)
+
+
+def _active_seq_mesh():
+    """The active mesh when its `seq` axis is sharded (--seq-shards > 1),
+    else None. Trace-time lookup — see parallel.mesh.set_active_mesh."""
+    from seist_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.active_mesh()
+    if m is not None and m.shape.get(mesh_lib.AXIS_SEQ, 1) > 1:
+        return m
+    return None
 
 
 class LocalAwareAggregationBlock(nn.Module):
@@ -254,20 +266,50 @@ class AttentionBlock(nn.Module):
         v = v.reshape(N, M, num_heads, E)
         k = nn.Dropout(self.key_drop_rate, deterministic=not train)(k)
 
-        if self.attn_drop_rate > 0 and train:
-            # Probability-space dropout forces materializing the attention
-            # matrix — plain XLA path.
-            attn = jnp.einsum("nlhe,nmhe->nhlm", q / math.sqrt(E), k)
-            attn = nn.softmax(attn, axis=-1)
-            attn = nn.Dropout(self.attn_drop_rate, deterministic=False)(attn)
-            out = jnp.einsum("nhlm,nmhe->nlhe", attn, v).reshape(N, L, C)
+        rate = self.attn_drop_rate if train else 0.0
+        mesh = _active_seq_mesh()
+        if mesh is not None:
+            # --seq-shards: sequence-parallel exact attention over the
+            # mesh's `seq` axis (Q blocks resident, K/V rotating on ICI —
+            # ops/ring_attention.py). Long-context path the reference lacks;
+            # probability dropout is not applied here (key-dropout above
+            # still is) — logged once by the worker when rates are nonzero.
+            from seist_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(
+                q,
+                k,
+                v,
+                mesh,
+                batch_axis="data",
+                scale=1.0 / math.sqrt(E),
+            )
         else:
-            # Fused Pallas kernel on TPU (qk + softmax + pv in VMEM, no
-            # (N,H,L,M) HBM tensor); identical-math einsum fallback elsewhere.
+            # Fused Pallas kernel on TPU (qk + softmax + dropout + pv in
+            # VMEM, no (N,H,L,M) HBM tensor); identical-math einsum fallback
+            # elsewhere. Probability dropout (ref seist.py:383-388) runs
+            # *inside* the kernel from a counter-based PRNG seeded off the
+            # flax 'dropout' stream.
             from seist_tpu.ops.pallas_attention import fused_pooled_attention
 
-            out = fused_pooled_attention(q, k, v, 1.0 / math.sqrt(E))
-            out = out.reshape(N, L, C)
+            seed = None
+            if rate > 0.0:
+                seed = jax.random.randint(
+                    self.make_rng("dropout"),
+                    (1,),
+                    0,
+                    jnp.iinfo(jnp.int32).max,
+                    dtype=jnp.int32,
+                )
+            out = fused_pooled_attention(
+                q,
+                k,
+                v,
+                1.0 / math.sqrt(E),
+                dropout_rate=rate,
+                dropout_seed=seed,
+            )
+        out = out.reshape(N, L, C)
 
         out = nn.Dense(
             self.io_dim, use_bias=self.qkv_bias, name="out_proj", **_dense_kw

@@ -1,22 +1,30 @@
-"""Pallas TPU kernel: fused pooled-KV attention.
+"""Pallas TPU kernel: fused pooled-KV attention, with in-kernel dropout.
 
 The SeisT encoder's attention keeps full-length Q but pools K/V by
 ``attn_aggr_ratio`` (ref seist.py:321-393), so scores are (L x M) with
 M = L/r. XLA's unfused path materializes the (N, H, L, M) probability
 tensor in HBM — at the reference training shape (batch 500, stage 1:
 L=1024, M=128) that is ~0.5 GB of HBM traffic per layer per direction.
-This kernel fuses qk-matmul + softmax + pv-matmul in VMEM (one grid step
-per batch-head; L, M and E are small enough that a whole batch-head's
-Q/K/V fit on-chip), writing only the (L, E) output.
+This kernel fuses qk-matmul + softmax + (dropout) + pv-matmul in VMEM
+(one grid step per batch-head; L, M and E are small enough that a whole
+batch-head's Q/K/V fit on-chip), writing only the (L, E) output.
 
 Training works through a custom VJP whose backward is a second fused
 kernel (recompute-p flash-style backward), so no probability tensor is
 ever materialized in either direction.
 
-``fused_pooled_attention`` is numerically identical (fp32) to the einsum
-path the model uses elsewhere; on non-TPU backends it falls back to that
-einsum, and ``interpret=True`` drives the same kernels through the Pallas
-interpreter for CPU testing.
+Attention-probability dropout (ref seist.py:383-388 applies
+``attn_drop`` after softmax) is generated *inside* the kernel from a
+counter-based hash PRNG written in plain jnp ops, so the exact same
+mask math runs in three places: the compiled TPU kernel, the Pallas
+interpreter (CPU tests), and the XLA einsum fallback. The backward
+kernel regenerates the identical mask from the saved seed, so no mask
+tensor is materialized either.
+
+``fused_pooled_attention`` is numerically identical (fp32) to the
+einsum path for the same seed; on non-TPU backends it falls back to
+that einsum, and ``interpret=True`` drives the same kernels through the
+Pallas interpreter for CPU testing.
 """
 
 from __future__ import annotations
@@ -27,42 +35,106 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
 
-def _einsum_attention(q, k, v, scale):
+def _uniform01(seed, pid, l: int, m: int) -> jnp.ndarray:
+    """Deterministic (L, M) uniforms in [0, 1) for batch-head slice ``pid``.
+
+    Counter-based (murmur3-finalizer over a linear element index), pure jnp
+    — runs identically inside a Pallas kernel, under the interpreter, and in
+    the XLA fallback, so all three paths agree bit-for-bit on the mask.
+    """
+    # int32 throughout (Mosaic lacks uint32<->float casts): multiplies wrap
+    # two's-complement — identical low 32 bits to the uint32 murmur mix —
+    # and shifts are explicit logical shifts.
+    def c(u):  # uint32 constant as wrapped int32
+        return jnp.int32(np.uint32(u).astype(np.int32))
+
+    shr = lambda x, n: lax.shift_right_logical(x, jnp.int32(n))
+    row = lax.broadcasted_iota(jnp.int32, (l, m), 0)
+    col = lax.broadcasted_iota(jnp.int32, (l, m), 1)
+    x = pid.astype(jnp.int32) * jnp.int32(l * m) + row * jnp.int32(m) + col
+    x = x ^ (seed.astype(jnp.int32) * c(0x9E3779B9))
+    x = x ^ shr(x, 16)
+    x = x * c(0x85EBCA6B)
+    x = x ^ shr(x, 13)
+    x = x * c(0xC2B2AE35)
+    x = x ^ shr(x, 16)
+    return shr(x, 8).astype(jnp.float32) * (1.0 / (1 << 24))
+
+
+def _apply_dropout(p, seed, pid, rate: float):
+    """Zero entries where u < rate; scale survivors by 1/(1-rate)."""
+    l, m = p.shape[-2], p.shape[-1]
+    u = _uniform01(seed, pid, l, m)
+    keep = u >= jnp.float32(rate)
+    return jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
+
+
+def _einsum_attention(q, k, v, scale, dropout_rate=0.0, dropout_seed=None):
     s = jnp.einsum("nlhe,nmhe->nhlm", q * scale, k)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        n, h, l, m = p.shape
+        pid = lax.broadcasted_iota(jnp.int32, (n * h, 1, 1), 0)
+        u = jax.vmap(
+            lambda i: _uniform01(dropout_seed[0], i.reshape(()), l, m)
+        )(pid.reshape(n * h))
+        keep = u.reshape(n, h, l, m) >= jnp.float32(dropout_rate)
+        p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
     return jnp.einsum("nhlm,nmhe->nlhe", p, v)
 
 
 # -- kernels (operate on one (batch*head) slice in VMEM) ---------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale):
-    q = q_ref[0].astype(jnp.float32)  # (L, E)
-    k = k_ref[0].astype(jnp.float32)  # (M, E)
-    v = v_ref[0].astype(jnp.float32)  # (M, E)
+def _softmax_rows(q, k, scale):
     s = jnp.dot(q * scale, k.T, preferred_element_type=jnp.float32)  # (L, M)
     s = s - s.max(axis=-1, keepdims=True)
     p = jnp.exp(s)
-    p = p / p.sum(axis=-1, keepdims=True)
+    return p / p.sum(axis=-1, keepdims=True)
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, *, scale, rate):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)  # (L, E)
+    k = k_ref[0].astype(jnp.float32)  # (M, E)
+    v = v_ref[0].astype(jnp.float32)  # (M, E)
+    p = _softmax_rows(q, k, scale)
+    if rate > 0.0:
+        p = _apply_dropout(p, seed_ref[0], pl.program_id(0), rate)
     o_ref[0] = jnp.dot(p, v, preferred_element_type=jnp.float32).astype(
         o_ref.dtype
     )
 
 
-def _bwd_kernel(q_ref, k_ref, v_ref, g_ref, dq_ref, dk_ref, dv_ref, *, scale):
+def _bwd_kernel(
+    seed_ref, q_ref, k_ref, v_ref, g_ref, dq_ref, dk_ref, dv_ref, *, scale, rate
+):
+    from jax.experimental import pallas as pl
+
     q = q_ref[0].astype(jnp.float32)
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     g = g_ref[0].astype(jnp.float32)  # (L, E) upstream grad
-    s = jnp.dot(q * scale, k.T, preferred_element_type=jnp.float32)
-    s = s - s.max(axis=-1, keepdims=True)
-    p = jnp.exp(s)
-    p = p / p.sum(axis=-1, keepdims=True)  # recomputed probs (L, M)
-    dv = jnp.dot(p.T, g, preferred_element_type=jnp.float32)
-    dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)  # (L, M)
-    ds = p * (dp - (dp * p).sum(axis=-1, keepdims=True))  # softmax jvp
+    p = _softmax_rows(q, k, scale)  # recomputed probs (L, M)
+    if rate > 0.0:
+        pd = _apply_dropout(p, seed_ref[0], pl.program_id(0), rate)
+    else:
+        pd = p
+    dv = jnp.dot(pd.T, g, preferred_element_type=jnp.float32)
+    dpd = jnp.dot(g, v.T, preferred_element_type=jnp.float32)  # (L, M)
+    if rate > 0.0:
+        # d(dropout)/dp is the same keep/scale mask; reuse via pd = mask*p/kp:
+        # where p > 0, mask*inv_keep = pd / p. Regenerate instead (exact,
+        # avoids 0/0): mask comes from the same counter stream.
+        dp = _apply_dropout(dpd, seed_ref[0], pl.program_id(0), rate)
+    else:
+        dp = dpd
+    ds = p * (dp - (dp * p).sum(axis=-1, keepdims=True))  # softmax vjp
     dq = jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
     dk = jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * scale
     dq_ref[0] = dq.astype(dq_ref.dtype)
@@ -80,62 +152,63 @@ def _unflatten_heads(x, n, h):
     return jnp.transpose(x.reshape(n, h, l, e), (0, 2, 1, 3))
 
 
-def _call_fused(kernel, out_shapes, inputs, interpret):
+def _call_fused(kernel, out_shapes, seed, inputs, interpret):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     nh = inputs[0].shape[0]
 
     def spec(x):
-        return pl.BlockSpec((1,) + x.shape[1:], lambda i: (i, 0, 0))
+        return pl.BlockSpec((1,) + x.shape[1:], lambda i, s: (i, 0, 0))
 
-    return pl.pallas_call(
-        kernel,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(nh,),
         in_specs=[spec(x) for x in inputs],
         out_specs=(
-            [spec_like(o) for o in out_shapes]
+            [spec(o) for o in out_shapes]
             if isinstance(out_shapes, (list, tuple))
-            else spec_like(out_shapes)
+            else spec(out_shapes)
         ),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
         out_shape=out_shapes,
         interpret=interpret,
-    )(*inputs)
+    )(seed, *inputs)
 
 
-def spec_like(sds):
-    from jax.experimental import pallas as pl
-
-    return pl.BlockSpec((1,) + sds.shape[1:], lambda i: (i, 0, 0))
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _fused(q3, k3, v3, scale, interpret):
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused(q3, k3, v3, seed, scale, rate, interpret):
     o = _call_fused(
-        partial(_fwd_kernel, scale=scale),
+        partial(_fwd_kernel, scale=scale, rate=rate),
         jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        seed,
         (q3, k3, v3),
         interpret,
     )
     return o
 
 
-def _fused_fwd(q3, k3, v3, scale, interpret):
-    return _fused(q3, k3, v3, scale, interpret), (q3, k3, v3)
+def _fused_fwd(q3, k3, v3, seed, scale, rate, interpret):
+    return _fused(q3, k3, v3, seed, scale, rate, interpret), (q3, k3, v3, seed)
 
 
-def _fused_bwd(scale, interpret, res, g):
-    q3, k3, v3 = res
+def _fused_bwd(scale, rate, interpret, res, g):
+    q3, k3, v3, seed = res
     dq, dk, dv = _call_fused(
-        partial(_bwd_kernel, scale=scale),
+        partial(_bwd_kernel, scale=scale, rate=rate),
         (
             jax.ShapeDtypeStruct(q3.shape, q3.dtype),
             jax.ShapeDtypeStruct(k3.shape, k3.dtype),
             jax.ShapeDtypeStruct(v3.shape, v3.dtype),
         ),
+        seed,
         (q3, k3, v3, g),
         interpret,
     )
-    return dq, dk, dv
+    return dq, dk, dv, np.zeros(seed.shape, dtype=jax.dtypes.float0)
 
 
 _fused.defvjp(_fused_fwd, _fused_bwd)
@@ -147,21 +220,39 @@ def fused_pooled_attention(
     v: jnp.ndarray,
     scale: Optional[float] = None,
     *,
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jnp.ndarray] = None,
     interpret: bool = False,
     force: bool = False,
 ) -> jnp.ndarray:
     """Fused attention for ``q (N, L, H, E)``, ``k/v (N, M, H, E)``.
 
     Uses the Pallas kernel on TPU (or when ``interpret``/``force`` is set);
-    otherwise the XLA einsum path — both compute identical fp32 math.
+    otherwise the XLA einsum path — both compute identical fp32 math,
+    including the dropout mask (same counter-based PRNG in both).
+
+    ``dropout_rate`` > 0 applies post-softmax probability dropout (ref
+    seist.py:383-388) and requires ``dropout_seed``, an int32 array of
+    shape (1,) — derive it per step from the flax 'dropout' rng stream.
     """
     e = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(e)
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
+    if dropout_seed is None:
+        dropout_seed = jnp.zeros((1,), jnp.int32)
+    dropout_seed = dropout_seed.astype(jnp.int32)
     on_tpu = jax.default_backend() == "tpu"
     if not (on_tpu or interpret or force):
-        return _einsum_attention(q, k, v, scale)
+        return _einsum_attention(q, k, v, scale, dropout_rate, dropout_seed)
     n, _, h, _ = q.shape
     o3 = _fused(
-        _flatten_heads(q), _flatten_heads(k), _flatten_heads(v), scale, interpret
+        _flatten_heads(q),
+        _flatten_heads(k),
+        _flatten_heads(v),
+        dropout_seed,
+        scale,
+        float(dropout_rate),
+        interpret,
     )
     return _unflatten_heads(o3, n, h)

@@ -105,11 +105,15 @@ def ring_attention(
     v: jnp.ndarray,
     mesh: Mesh,
     seq_axis: str = AXIS_SEQ,
+    batch_axis: Optional[str] = None,
     scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """Exact attention with Q/K/V ``(N, L, H, E)`` sequence-sharded over
-    ``mesh[seq_axis]``. Global L must divide evenly by the axis size."""
-    spec = P(None, seq_axis, None, None)
+    ``mesh[seq_axis]``. Global L (and K/V's M) must divide evenly by the
+    axis size. ``batch_axis`` additionally shards the batch dim — pass
+    ``'data'`` when calling inside a data-parallel jitted step so the
+    shard_map composes with DP instead of gathering the batch."""
+    spec = P(batch_axis, seq_axis, None, None)
     body = partial(ring_attention_local, axis_name=seq_axis, scale=scale)
     try:
         from jax import shard_map

@@ -32,6 +32,39 @@ AXIS_MODEL = "model"
 AXIS_SEQ = "seq"
 MESH_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_SEQ)
 
+# Trace-time active mesh: models consult this to route through
+# mesh-axis-aware paths (e.g. SeisT attention -> ring attention when
+# ``seq`` > 1, --seq-shards). Set once by the worker (set_active_mesh) or
+# scoped in tests (use_mesh).
+#
+# CAVEAT: this is read at TRACE time and is NOT part of any jit cache key.
+# A function jitted under one mesh keeps that routing even if the active
+# mesh changes later — always (re)build/jit step functions AFTER setting
+# the mesh, as train_worker/test_worker do. Don't reuse a jitted step
+# across different active meshes.
+_ACTIVE_MESH: list = [None]
+
+
+def set_active_mesh(mesh: Optional[Mesh]) -> None:
+    _ACTIVE_MESH[0] = mesh
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH[0]
+
+
+from contextlib import contextmanager  # noqa: E402
+
+
+@contextmanager
+def use_mesh(mesh: Optional[Mesh]):
+    old = _ACTIVE_MESH[0]
+    _ACTIVE_MESH[0] = mesh
+    try:
+        yield
+    finally:
+        _ACTIVE_MESH[0] = old
+
 
 def make_mesh(
     data: Optional[int] = None,

@@ -81,7 +81,31 @@ def load_checkpoint(
             ),
             "meta": {"epoch": 0, "loss": 0.0, "step": 0},
         }
-        return restorer.restore(path, target)
+        try:
+            return restorer.restore(path, target)
+        except Exception:
+            raw = restorer.restore(path)
+            if "opt_state" in raw:
+                # The checkpoint IS a full one — the structured restore
+                # failed for a real reason (shape mismatch from a wrong
+                # --model-name, partial write, ...). Surface that, don't
+                # silently resume with fresh optimizer moments.
+                raise
+    # Params(+stats)-only checkpoint — e.g. written by
+    # tools/import_pretrained.py from the reference's raw .pth state-dicts.
+    # Adopt the weights, keep the fresh optimizer state: the reference's
+    # loader has the same tolerance (_factory.py:101-102 treats a bare
+    # state-dict as the model dict and resumes with epoch -1).
+    logger.info(
+        f"Checkpoint {path} has no optimizer state; loading params only"
+    )
+    return {
+        "params": raw["params"],
+        "batch_stats": raw.get("batch_stats") or {},
+        "opt_state": list(jax.tree_util.tree_leaves(state.opt_state)),
+        "meta": raw.get("meta")
+        or {"epoch": -1, "loss": float("inf"), "step": 0},
+    }
 
 
 def restore_into_state(state, restored: Dict[str, Any]):

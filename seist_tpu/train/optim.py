@@ -53,19 +53,40 @@ def build_optimizer(
     learning_rate: Schedule,
     weight_decay: float = 0.0,
     momentum: float = 0.9,
+    l1_kernel_alpha: float = 0.0,
+    l1_bias_alpha: float = 0.0,
+    l1_mask_fn: Optional[Callable] = None,
 ) -> optax.GradientTransformation:
+    """Optimizer chain. ``l1_kernel_alpha``/``l1_bias_alpha`` prepend
+    :func:`l1_sign_decay` transforms (the reference EQTransformer's L1
+    grad hooks); ``l1_mask_fn(params, kind)`` scopes them — e.g.
+    ``models.eqtransformer.l1_param_mask`` selects exactly the conv params
+    the reference hooks.
+    """
     name = name.lower()
     if name == "adam":
         tx = optax.adam(learning_rate)
         # torch Adam's `weight_decay` is L2-into-gradient, not decoupled.
         if weight_decay:
             tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
-        return tx
-    if name == "adamw":
-        return optax.adamw(learning_rate, weight_decay=weight_decay)
-    if name == "sgd":
+    elif name == "adamw":
+        tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+    elif name == "sgd":
         tx = optax.sgd(learning_rate, momentum=momentum)
         if weight_decay:
             tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
-        return tx
-    raise NotImplementedError(f"Unsupported optimizer: '{name}' (adam/adamw/sgd)")
+    else:
+        raise NotImplementedError(
+            f"Unsupported optimizer: '{name}' (adam/adamw/sgd)"
+        )
+
+    pre = []
+    for alpha, kind in ((l1_kernel_alpha, "kernel"), (l1_bias_alpha, "bias")):
+        if alpha:
+            mask = (
+                (lambda p, _kind=kind: l1_mask_fn(p, _kind))
+                if l1_mask_fn is not None
+                else None
+            )
+            pre.append(l1_sign_decay(alpha, mask=mask))
+    return optax.chain(*pre, tx) if pre else tx

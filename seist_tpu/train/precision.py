@@ -1,0 +1,78 @@
+"""Mixed-precision policy: bf16 compute, fp32 params/optimizer/stats.
+
+TPU-first lever the torch reference lacks entirely (its only nod is the
+TF32 matmul hint at ref main.py:224-226): run matmuls/convs/elementwise in
+bfloat16 on the MXU/VPU while keeping everything stateful — params,
+optimizer moments, BatchNorm running stats — and everything numerically
+delicate — BN statistics (flax computes them in >=fp32 internally),
+attention softmax (the Pallas kernel upcasts to fp32 in VMEM), the loss —
+in float32.
+
+Implementation is jmp-style step-level casting, not per-module dtype
+threading: the train/eval step casts params and inputs to the compute dtype
+before ``apply`` and casts outputs back to fp32 before the loss. Gradients
+flow through the cast back to the fp32 master params, so the optimizer
+update is full precision. BatchNorm modules additionally need their
+*output* dtype pinned (their fp32 running stats would otherwise promote
+every activation back to fp32) — ``models/common.py::make_norm`` consults
+the trace-time policy below for that.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+_POLICY: dict = {"dtype": None}
+
+
+def resolve_dtype(name: Optional[str]):
+    """Map a CLI-level dtype name to a jnp dtype (None = full fp32)."""
+    if name is None:
+        return None
+    key = str(name).lower()
+    if key in ("fp32", "float32", "f32", "none"):
+        return None
+    if key in ("bf16", "bfloat16"):
+        return jnp.bfloat16
+    raise ValueError(f"Unknown compute dtype '{name}' (use fp32 or bf16)")
+
+
+def policy_dtype():
+    """The active compute dtype (None outside a ``precision_policy`` block)."""
+    return _POLICY["dtype"]
+
+
+@contextmanager
+def precision_policy(dtype):
+    """Activate a compute dtype for the duration of a model trace."""
+    old = _POLICY["dtype"]
+    _POLICY["dtype"] = dtype
+    try:
+        yield
+    finally:
+        _POLICY["dtype"] = old
+
+
+def cast_floating(tree: Any, dtype) -> Any:
+    """Cast floating leaves of a pytree; leave ints/bools/None untouched."""
+    if dtype is None:
+        return tree
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )
+
+
+def cast_to_float32(tree: Any) -> Any:
+    return jax.tree.map(
+        lambda x: x.astype(jnp.float32)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        tree,
+    )

@@ -22,6 +22,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from seist_tpu.taskspec import TaskSpec
+from seist_tpu.train.precision import (
+    cast_floating,
+    cast_to_float32,
+    precision_policy,
+    resolve_dtype,
+)
 from seist_tpu.train.state import TrainState
 
 
@@ -33,29 +39,40 @@ def _apply_transforms(spec: TaskSpec, outputs, targets):
     return outputs, targets
 
 
-def make_train_step(spec: TaskSpec, loss_fn: Callable) -> Callable:
+def make_train_step(
+    spec: TaskSpec, loss_fn: Callable, compute_dtype: Optional[str] = None
+) -> Callable:
     """Build ``train_step(state, inputs, targets, rng) -> (state, loss, outputs)``.
 
     ``rng`` is a base key; the global step is folded in so every step gets
     fresh dropout/droppath noise while the traced program stays static.
+
+    ``compute_dtype`` 'bf16' runs the forward/backward in bfloat16 (fp32
+    master params, optimizer, BN stats, softmax, loss — see
+    train/precision.py); gradients flow through the cast back to the fp32
+    params, so the optimizer update is full precision.
     """
+    cdtype = resolve_dtype(compute_dtype)
 
     def train_step(state: TrainState, inputs, targets, rng):
         step_rng = jax.random.fold_in(rng, state.step)
+        inputs_c = cast_floating(inputs, cdtype)
 
         def compute_loss(params):
-            variables = {"params": params}
+            variables = {"params": cast_floating(params, cdtype)}
             has_stats = state.batch_stats is not None
             if has_stats:
                 variables["batch_stats"] = state.batch_stats
-            out = state.apply_fn(
-                variables,
-                inputs,
-                train=True,
-                mutable=["batch_stats"] if has_stats else [],
-                rngs={"dropout": step_rng},
-            )
+            with precision_policy(cdtype):
+                out = state.apply_fn(
+                    variables,
+                    inputs_c,
+                    train=True,
+                    mutable=["batch_stats"] if has_stats else [],
+                    rngs={"dropout": step_rng},
+                )
             outputs, mutated = out if has_stats else (out[0], {})
+            outputs = cast_to_float32(outputs)
             o, t = _apply_transforms(spec, outputs, targets)
             loss = loss_fn(o, t)
             return loss, (outputs, mutated.get("batch_stats"))
@@ -65,13 +82,15 @@ def make_train_step(spec: TaskSpec, loss_fn: Callable) -> Callable:
         )(state.params)
         state = state.apply_gradients(grads=grads)
         if new_stats is not None:
-            state = state.replace(batch_stats=new_stats)
+            state = state.replace(batch_stats=cast_to_float32(new_stats))
         return state, loss, outputs
 
     return train_step
 
 
-def make_eval_step(spec: TaskSpec, loss_fn: Callable) -> Callable:
+def make_eval_step(
+    spec: TaskSpec, loss_fn: Callable, compute_dtype: Optional[str] = None
+) -> Callable:
     """Build ``eval_step(state, inputs, targets, mask) -> (loss, outputs)``
     (the reference's no-grad validate body, validate.py:54-127).
 
@@ -82,12 +101,17 @@ def make_eval_step(spec: TaskSpec, loss_fn: Callable) -> Callable:
     sum-reduced ones (``loss_fn.reduction == 'sum'``, e.g. MousaviLoss).
     """
     sum_reduced = getattr(loss_fn, "reduction", "mean") == "sum"
+    cdtype = resolve_dtype(compute_dtype)
 
     def eval_step(state: TrainState, inputs, targets, mask):
-        variables = {"params": state.params}
+        variables = {"params": cast_floating(state.params, cdtype)}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
-        outputs = state.apply_fn(variables, inputs, train=False)
+        with precision_policy(cdtype):
+            outputs = state.apply_fn(
+                variables, cast_floating(inputs, cdtype), train=False
+            )
+        outputs = cast_to_float32(outputs)
         o, t = _apply_transforms(spec, outputs, targets)
 
         def one(o1, t1):

@@ -40,7 +40,7 @@ from seist_tpu.train import (
 )
 from seist_tpu.utils.logger import logger
 from seist_tpu.utils.meters import AverageMeter, ProgressMeter
-from seist_tpu.utils.misc import count_params, strftimedelta
+from seist_tpu.utils.misc import count_params, get_safe_path, strftimedelta
 from seist_tpu.utils.tb import ScalarWriter
 
 
@@ -203,8 +203,12 @@ def validate(
         m.synchronize_between_processes()
 
     if saver is not None:
-        out_csv = os.path.join(
-            logger.logdir(), f"test_results_{val_loader.dataset.name()}.csv"
+        # No-clobber contract (ref validate.py:130 get_safe_path): test mode
+        # reusing an existing log dir must not overwrite prior results.
+        out_csv = get_safe_path(
+            os.path.join(
+                logger.logdir(), f"test_results_{val_loader.dataset.name()}.csv"
+            )
         )
         saver.save_as_csv(out_csv)
         logger.info(f"Test results saved: {out_csv}")
@@ -220,11 +224,19 @@ def train_worker(args: Any) -> str:
     (ref train.py:182-484)."""
     spec = taskspec.get_task_spec(args.model_name)
     loss_fn = spec.loss()
-    mesh = mesh_lib.make_mesh()
+    seq_shards = int(getattr(args, "seq_shards", 1) or 1)
+    mesh = mesh_lib.make_mesh(seq=seq_shards)
+    mesh_lib.set_active_mesh(mesh)
     logger.info(
         f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}, "
         f"process {jax.process_index()}/{jax.process_count()}"
     )
+    if seq_shards > 1:
+        logger.info(
+            f"Sequence parallelism: ring attention over {seq_shards} shards "
+            f"(--seq-shards); attention-probability dropout is not applied "
+            f"on the ring path (key/proj dropout still are)"
+        )
     data_axis = mesh.shape[mesh_lib.AXIS_DATA]
     if (args.batch_size * jax.process_count()) % data_axis:
         raise ValueError(
@@ -266,11 +278,28 @@ def train_worker(args: Any) -> str:
         )
     else:
         schedule = args.max_lr
+    l1_kernel = getattr(args, "conv_kernel_l1_alpha", 0.0)
+    l1_bias = getattr(args, "conv_bias_l1_alpha", 0.0)
+    l1_mask_fn = None
+    if l1_kernel or l1_bias:
+        # Reference scope: these L1 grad hooks exist only on EQTransformer's
+        # encoder/decoder convs (ref eqtransformer.py:43-51,388-396).
+        if args.model_name != "eqtransformer":
+            raise ValueError(
+                "--conv-{kernel,bias}-l1-alpha apply only to eqtransformer "
+                f"(got --model-name {args.model_name})"
+            )
+        from seist_tpu.models.eqtransformer import l1_param_mask
+
+        l1_mask_fn = l1_param_mask
     tx = build_optimizer(
         args.optim,
         schedule,
         weight_decay=args.weight_decay,
         momentum=args.momentum,
+        l1_kernel_alpha=l1_kernel,
+        l1_bias_alpha=l1_bias,
+        l1_mask_fn=l1_mask_fn,
     )
     state = create_train_state(model, variables, tx)
 
@@ -284,8 +313,13 @@ def train_worker(args: Any) -> str:
             f"loss {restored['meta']['loss']:.4f})"
         )
 
-    train_step = jit_step(make_train_step(spec, loss_fn), mesh)
-    eval_step = jit_eval_step(make_eval_step(spec, loss_fn), mesh)
+    dtype = getattr(args, "dtype", "fp32")
+    train_step = jit_step(
+        make_train_step(spec, loss_fn, compute_dtype=dtype), mesh
+    )
+    eval_step = jit_eval_step(
+        make_eval_step(spec, loss_fn, compute_dtype=dtype), mesh
+    )
     base_rng = jax.random.PRNGKey(args.seed)
 
     writer = (
@@ -316,42 +350,59 @@ def train_worker(args: Any) -> str:
             steps_per_epoch, [loss_meter, wps_meter], prefix=f"Epoch[{epoch}] "
         )
         t_step = time.time()
+        # Device->host transfers are confined to every --log-step steps:
+        # pulling loss/outputs every step serializes JAX's async dispatch
+        # and stalls the chip on host postprocess (the per-step numbers are
+        # only diagnostics — TB scalars and the progress line). Per-step
+        # losses are kept as device scalars and fetched once per epoch.
+        deferred_losses: List[Any] = []
         for step, batch in enumerate(
             pipeline.prefetch_to_device(iter(train_loader), mesh)
         ):
             state, loss, outputs = train_step(
                 state, batch.inputs, batch.loss_targets, epoch_rng
             )
-            loss = float(loss)
+            deferred_losses.append(loss)
             gstep = epoch * steps_per_epoch + step
             global_bs = args.batch_size * jax.process_count()
-            loss_meter.update(loss, global_bs)
-            train_losses.append(loss)
 
-            results = _postprocess_batch(args, spec, outputs, fs)
-            batch_metrics = _make_metrics(args, tasks, fs)
-            _update_task_metrics(
-                metrics_merged,
-                batch_metrics,
-                results,
-                batch.metrics_targets,
-                args.batch_size,
-            )
-            now = time.time()
-            wps_meter.update(global_bs / max(now - t_step, 1e-9))
-            t_step = now
-
-            if writer is not None:
-                writer.add_scalar("train-loss/step", loss, gstep)
-                for task, m in batch_metrics.items():
-                    writer.add_scalars(
-                        f"train.{task}.metrics/step", m.get_all_metrics(), gstep
-                    )
-            if step % args.log_step == 0 and is_main_process():
-                logger.info(
-                    f"{args.model_name}_train {progress.get_str(step)}"
+            if step % args.log_step == 0:
+                loss_f = float(loss)
+                loss_meter.update(loss_f, 1)
+                now = time.time()
+                steps_done = min(args.log_step, step) or 1
+                wps_meter.update(
+                    global_bs * steps_done / max(now - t_step, 1e-9)
                 )
+                t_step = now
 
+                results = _postprocess_batch(args, spec, outputs, fs)
+                batch_metrics = _make_metrics(args, tasks, fs)
+                _update_task_metrics(
+                    metrics_merged,
+                    batch_metrics,
+                    results,
+                    batch.metrics_targets,
+                    args.batch_size,
+                )
+                if writer is not None:
+                    writer.add_scalar("train-loss/step", loss_f, gstep)
+                    for task, m in batch_metrics.items():
+                        writer.add_scalars(
+                            f"train.{task}.metrics/step",
+                            m.get_all_metrics(),
+                            gstep,
+                        )
+                if is_main_process():
+                    logger.info(
+                        f"{args.model_name}_train {progress.get_str(step)}"
+                    )
+
+        epoch_losses = [float(l) for l in jax.device_get(deferred_losses)]
+        train_losses.extend(epoch_losses)
+        # Exact epoch mean from every step's loss (the meter only samples
+        # every log_step steps, for the progress line).
+        epoch_train_loss = float(np.mean(epoch_losses)) if epoch_losses else 0.0
         for m in metrics_merged.values():
             m.synchronize_between_processes()
 
@@ -361,7 +412,7 @@ def train_worker(args: Any) -> str:
         )
         val_losses.append(val_loss)
         if writer is not None:
-            writer.add_scalar("train-loss/epoch", loss_meter.avg, epoch)
+            writer.add_scalar("train-loss/epoch", epoch_train_loss, epoch)
             writer.add_scalar("val-loss/epoch", val_loss, epoch)
             for task, m in val_metrics.items():
                 writer.add_scalars(
@@ -371,8 +422,10 @@ def train_worker(args: Any) -> str:
         if val_loss < best_loss:
             best_loss = val_loss
             patience_counter = 0
-            # Checkpoint path is deterministic across hosts (epoch-numbered),
-            # replacing the reference's rank0 broadcast (train.py:481-482).
+            # Checkpoint path is deterministic across hosts: epoch-numbered
+            # under the log_dir that cli.main_worker broadcast from process 0
+            # (replacing the reference's rank0 ckpt-path broadcast,
+            # train.py:481-482).
             best_ckpt_path = save_checkpoint(ckpt_dir, state, epoch, val_loss)
         else:
             patience_counter += 1
@@ -387,7 +440,7 @@ def train_worker(args: Any) -> str:
         epoch_times.append(dt)
         eta = float(np.mean(epoch_times)) * (epochs - epoch - 1)
         logger.info(
-            f"Epoch {epoch}: train-loss {loss_meter.avg:.4e} "
+            f"Epoch {epoch}: train-loss {epoch_train_loss:.4e} "
             f"val-loss {val_loss:.4e} best {best_loss:.4e} "
             f"time {strftimedelta(dt)} ETA {strftimedelta(eta)}"
         )
@@ -397,6 +450,8 @@ def train_worker(args: Any) -> str:
         np.save(os.path.join(logger.logdir(), "val_losses.npy"), val_losses)
     if writer is not None:
         writer.close()
+    train_loader.close()
+    val_loader.close()
     return best_ckpt_path
 
 
@@ -404,7 +459,8 @@ def test_worker(args: Any) -> float:
     """Test run on the held-out split (ref test.py:10-88). Returns loss."""
     spec = taskspec.get_task_spec(args.model_name)
     loss_fn = spec.loss()
-    mesh = mesh_lib.make_mesh()
+    mesh = mesh_lib.make_mesh(seq=int(getattr(args, "seq_shards", 1) or 1))
+    mesh_lib.set_active_mesh(mesh)
 
     test_loader = _build_loader(args, spec, "test")
 
@@ -431,7 +487,12 @@ def test_worker(args: Any) -> float:
     )
     logger.info(f"Loaded checkpoint: {args.checkpoint}")
 
-    eval_step = jit_eval_step(make_eval_step(spec, loss_fn), mesh)
+    eval_step = jit_eval_step(
+        make_eval_step(
+            spec, loss_fn, compute_dtype=getattr(args, "dtype", "fp32")
+        ),
+        mesh,
+    )
     loss, _ = validate(
         args,
         state,
@@ -442,4 +503,5 @@ def test_worker(args: Any) -> float:
         testing=True,
         save_results=args.save_test_results,
     )
+    test_loader.close()
     return loss

@@ -24,8 +24,13 @@ class ScalarWriter:
             from torch.utils.tensorboard import SummaryWriter
 
             self._tb = SummaryWriter(logdir)
+            mode = "tensorboard event files"
         except Exception:
             self._jsonl = open(os.path.join(logdir, "scalars.jsonl"), "a")
+            mode = "JSONL fallback (tensorboard unavailable)"
+        from seist_tpu.utils.logger import logger
+
+        logger.info(f"ScalarWriter: {mode} -> {logdir}")
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
         if self._tb is not None:

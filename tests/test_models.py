@@ -61,7 +61,14 @@ def test_seist_param_parity(name, ref_total):
 L_SMALL = 512
 
 
-@pytest.mark.parametrize("size", ["s", "m", "l"])
+@pytest.mark.parametrize(
+    "size",
+    [
+        "s",
+        pytest.param("m", marks=pytest.mark.slow),
+        pytest.param("l", marks=pytest.mark.slow),
+    ],
+)
 def test_seist_dpk_output_shape(size):
     model = api.create_model(f"seist_{size}_dpk", in_samples=L_SMALL)
     x = jnp.zeros((2, L_SMALL, 3))

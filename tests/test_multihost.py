@@ -15,6 +15,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # two 540s-timeout process rendezvous
+
 HERE = os.path.dirname(__file__)
 WORKER = os.path.join(HERE, "_multihost_worker.py")
 

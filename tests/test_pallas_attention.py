@@ -67,3 +67,110 @@ def test_cpu_fallback_is_einsum(rng):
     got = np.asarray(fused_pooled_attention(q, k, v))
     want = np.asarray(_einsum_attention(q, k, v, 1.0 / np.sqrt(q.shape[-1])))
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+# -- in-kernel dropout -------------------------------------------------------
+
+
+import jax.numpy as jnp
+
+
+def _seed(v=1234):
+    return jnp.asarray([v], jnp.int32)
+
+
+def test_dropout_zero_rate_is_noop(rng):
+    q, k, v = _qkv(rng)
+    base = np.asarray(fused_pooled_attention(q, k, v, interpret=True))
+    got = np.asarray(
+        fused_pooled_attention(
+            q, k, v, dropout_rate=0.0, dropout_seed=_seed(), interpret=True
+        )
+    )
+    np.testing.assert_array_equal(got, base)
+
+
+def test_dropout_mask_statistics(rng):
+    # With h=1 and v = identity (M == E), the output IS the dropped
+    # probability matrix — check drop fraction and survivor scaling.
+    n, l, m, rate = 2, 128, 16, 0.25
+    q = rng.normal(size=(n, l, 1, m)).astype(np.float32)
+    k = rng.normal(size=(n, m, 1, m)).astype(np.float32)
+    v = np.eye(m, dtype=np.float32)[None, :, None, :].repeat(n, axis=0)
+    p = np.asarray(fused_pooled_attention(q, k, v, interpret=True))
+    pd = np.asarray(
+        fused_pooled_attention(
+            q, k, v, dropout_rate=rate, dropout_seed=_seed(), interpret=True
+        )
+    )
+    dropped = pd == 0.0
+    frac = dropped.mean()
+    assert abs(frac - rate) < 0.02, frac
+    surv = ~dropped
+    np.testing.assert_allclose(
+        pd[surv], p[surv] / (1.0 - rate), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_dropout_deterministic_per_seed(rng):
+    q, k, v = _qkv(rng)
+    a = np.asarray(
+        fused_pooled_attention(
+            q, k, v, dropout_rate=0.2, dropout_seed=_seed(7), interpret=True
+        )
+    )
+    b = np.asarray(
+        fused_pooled_attention(
+            q, k, v, dropout_rate=0.2, dropout_seed=_seed(7), interpret=True
+        )
+    )
+    c = np.asarray(
+        fused_pooled_attention(
+            q, k, v, dropout_rate=0.2, dropout_seed=_seed(8), interpret=True
+        )
+    )
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_dropout_kernel_matches_einsum_fallback(rng):
+    # Kernel (interpret) and XLA fallback share the counter-based PRNG, so
+    # outputs agree including which entries were dropped.
+    q, k, v = _qkv(rng, l=32, m=8)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    want = np.asarray(
+        _einsum_attention(q, k, v, scale, dropout_rate=0.3, dropout_seed=_seed())
+    )
+    got = np.asarray(
+        fused_pooled_attention(
+            q, k, v, scale, dropout_rate=0.3, dropout_seed=_seed(),
+            interpret=True,
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_dropout_custom_vjp_matches_einsum_grads(rng):
+    q, k, v = _qkv(rng, n=1, l=32, m=8)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    def loss_fused(q, k, v):
+        o = fused_pooled_attention(
+            q, k, v, scale, dropout_rate=0.3, dropout_seed=_seed(),
+            interpret=True,
+        )
+        return (o ** 2).sum()
+
+    def loss_einsum(q, k, v):
+        o = _einsum_attention(
+            q, k, v, scale, dropout_rate=0.3, dropout_seed=_seed()
+        )
+        return (o ** 2).sum()
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    ge = jax.grad(loss_einsum, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, ge, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+            err_msg=f"d{name}",
+        )

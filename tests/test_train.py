@@ -125,6 +125,121 @@ def test_train_step_with_transforms(rng):
     assert np.isfinite(float(loss))
 
 
+# ------------------------------------------------------- scoped L1 (eqt hooks)
+def test_eqt_l1_mask_scopes_to_ref_hooked_convs():
+    """l1_param_mask selects exactly the encoder ConvBlock / decoder
+    Upsampling convs the reference hooks (ref eqtransformer.py:43-51,
+    388-396) — not LSTM/attention/ff/resconv params."""
+    from seist_tpu.models.eqtransformer import l1_param_mask
+
+    model = api.create_model("eqtransformer", in_samples=L)
+    shapes = api.param_shapes(model, in_samples=L)["params"]
+    kmask = l1_param_mask(shapes, "kernel")
+    flat = jax.tree_util.tree_leaves_with_path(kmask)
+    selected = {
+        "/".join(str(getattr(k, "key", k)) for k in p)
+        for p, v in flat
+        if v
+    }
+    assert any(s.startswith("encoder/conv0/") for s in selected)
+    assert any(s.startswith("decoder0/up0/") for s in selected)
+    assert all("bilstm" not in s and "transformer" not in s for s in selected)
+    assert all("resconv" not in s and "conv_out" not in s for s in selected)
+    assert all(s.endswith("/kernel") for s in selected)
+    # 7 encoder convs + 3 decoders x 7 ups = 28 hooked kernels.
+    assert len(selected) == 28, sorted(selected)
+
+
+def test_build_optimizer_applies_scoped_l1():
+    from seist_tpu.models.eqtransformer import l1_param_mask
+
+    model = api.create_model("eqtransformer", in_samples=L)
+    variables = api.init_variables(model, in_samples=L, batch_size=1)
+    params = variables["params"]
+    alpha = 0.125
+    tx0 = build_optimizer("sgd", 1.0, momentum=0.0)
+    tx1 = build_optimizer(
+        "sgd", 1.0, momentum=0.0,
+        l1_kernel_alpha=alpha, l1_mask_fn=l1_param_mask,
+    )
+    grads = jax.tree.map(jnp.zeros_like, params)
+    u0, _ = tx0.update(grads, tx0.init(params), params)
+    u1, _ = tx1.update(grads, tx1.init(params), params)
+    kmask = l1_param_mask(params, "kernel")
+    diffs = jax.tree.map(
+        lambda a, b, m, p: np.allclose(
+            np.asarray(b - a), (alpha if m else 0.0) * -np.sign(np.asarray(p))
+        ),
+        u0, u1, kmask, params,
+    )
+    assert all(jax.tree_util.tree_leaves(diffs))
+
+
+# -------------------------------------------------------------- mixed precision
+@pytest.mark.parametrize(
+    "model_name",
+    [
+        "phasenet",
+        pytest.param("seist_s_dpk", marks=pytest.mark.slow),  # 2 heavy compiles
+    ],
+)
+def test_bf16_train_step_tracks_fp32(rng, model_name):
+    """bf16 compute dtype: loss close to fp32, params/stats stay fp32, and
+    several steps still reduce the loss (VERDICT r1 #4)."""
+    x, y = _fake_dpk_batch(rng)
+    key = jax.random.PRNGKey(0)
+
+    state32, spec, loss_fn = _setup(model_name)
+    state16, _, _ = _setup(model_name)
+    step32 = jit_step(make_train_step(spec, loss_fn), donate_state=False)
+    step16 = jit_step(
+        make_train_step(spec, loss_fn, compute_dtype="bf16"),
+        donate_state=False,
+    )
+
+    s32, l32, o32 = step32(state32, x, y, key)
+    s16, l16, o16 = step16(state16, x, y, key)
+    # Outputs come back fp32 regardless of compute dtype.
+    assert o16.dtype == jnp.float32
+    # Same init => loss matches to bf16 tolerance.
+    np.testing.assert_allclose(float(l16), float(l32), rtol=0.05, atol=5e-3)
+    # Master params / optimizer / BN stats remain fp32.
+    for leaf in jax.tree_util.tree_leaves(s16.params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(s16.batch_stats):
+        assert leaf.dtype == jnp.float32
+
+    loss0 = float(l16)
+    for _ in range(10):
+        s16, l16, _ = step16(s16, x, y, key)
+    assert float(l16) < loss0
+
+
+def test_bf16_eval_step_close_to_fp32(rng):
+    state, spec, loss_fn = _setup("seist_s_dpk")
+    x, y = _fake_dpk_batch(rng)
+    mask = np.ones(x.shape[0], dtype=np.float32)
+    e32 = jax.jit(make_eval_step(spec, loss_fn))
+    e16 = jax.jit(make_eval_step(spec, loss_fn, compute_dtype="bf16"))
+    l32, o32 = e32(state, x, y, mask)
+    l16, o16 = e16(state, x, y, mask)
+    assert o16.dtype == jnp.float32
+    np.testing.assert_allclose(float(l16), float(l32), rtol=0.05, atol=5e-3)
+    # dpk outputs are probabilities; bf16 forward should stay within a few
+    # probability points of fp32.
+    assert float(jnp.abs(o16 - o32).max()) < 0.05
+
+
+def test_resolve_dtype():
+    from seist_tpu.train.precision import resolve_dtype
+
+    assert resolve_dtype(None) is None
+    assert resolve_dtype("fp32") is None
+    assert resolve_dtype("bf16") == jnp.bfloat16
+    with pytest.raises(ValueError):
+        resolve_dtype("fp16")
+
+
 # ------------------------------------------------------------------ parallelism
 def test_dp_sharded_step_matches_single_device(rng):
     assert jax.device_count() >= 8, "conftest must provide 8 virtual devices"

@@ -9,6 +9,8 @@ import jax
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # full train->ckpt->test runs
+
 import seist_tpu
 from seist_tpu import taskspec
 from seist_tpu.utils.logger import logger

@@ -1,0 +1,98 @@
+"""Input-pipeline-only benchmark: waveforms/sec through the full host path.
+
+Measures the loader end to end — dataset read, the nine augmentations,
+window cut, normalize, soft-label generation, batch assembly — with no
+device in the loop (SURVEY.md hard-part #1: at reference training shape,
+batch 500 x 8192, the host must outrun the TPU step or the chip starves).
+
+Prints ONE JSON line:
+  {"metric": "input_pipeline_throughput", "value", "unit", "vs_baseline"}
+``vs_baseline`` is loader wf/s divided by the most recent *device* step
+rate (from BENCH env DEVICE_WFS or the default below) — the ratio that
+matters; >= 2.0 means the pipeline can feed the chip with headroom.
+
+Env knobs: BENCH_BATCH (500), BENCH_SAMPLES (8192), BENCH_BATCHES (8),
+BENCH_WORKERS (os.cpu_count), DEVICE_WFS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def run() -> None:
+    import numpy as np  # noqa: F401 (keeps import cost out of the timing)
+
+    import seist_tpu
+    from seist_tpu import taskspec
+    from seist_tpu.data import pipeline
+
+    seist_tpu.load_all()
+
+    batch = int(os.environ.get("BENCH_BATCH", 500))
+    in_samples = int(os.environ.get("BENCH_SAMPLES", 8192))
+    n_batches = int(os.environ.get("BENCH_BATCHES", 8))
+    workers = int(os.environ.get("BENCH_WORKERS", os.cpu_count() or 1))
+    device_wfs = float(os.environ.get("DEVICE_WFS", 4236.0))
+
+    spec = taskspec.get_task_spec("seist_l_dpk")
+    dataset = pipeline.from_task_spec(
+        spec,
+        "synthetic",
+        "train",
+        seed=0,
+        in_samples=in_samples,
+        augmentation=True,
+        dataset_kwargs={"num_events": batch * 4},
+    )
+    loader = pipeline.Loader(
+        dataset,
+        batch,
+        shuffle=True,
+        drop_last=True,
+        num_workers=workers,
+        seed=0,
+    )
+
+    # Warm one batch (imports, native-kernel dlopen, thread spin-up).
+    it = iter(loader)
+    next(it)
+
+    t0 = time.perf_counter()
+    done = 0
+    for _ in range(n_batches):
+        try:
+            next(it)
+        except StopIteration:
+            loader.set_epoch(loader.epoch + 1)
+            it = iter(loader)
+            next(it)
+        done += 1
+    dt = time.perf_counter() - t0
+    wfs = batch * done / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "input_pipeline_throughput",
+                "value": round(wfs, 2),
+                "unit": "waveforms/sec/host",
+                "vs_baseline": round(wfs / device_wfs, 3),
+                "device_wfs_ref": device_wfs,
+                "batch": batch,
+                "workers": workers,
+                "augmentation": True,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    run()
