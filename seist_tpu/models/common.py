@@ -209,25 +209,85 @@ def gelu(x: Array) -> Array:
     return jax.nn.gelu(x, approximate=False)
 
 
+class BatchNorm1dParity(nn.Module):
+    """BatchNorm over (N, L, C) with exact torch ``BatchNorm1d`` semantics.
+
+    Differences from ``flax.linen.BatchNorm`` that matter for parity
+    (verified by the train-mode gradient/BN test in
+    tests/test_golden_parity.py):
+
+    * the running variance is updated with the UNBIASED batch variance
+      (x N/(N-1)), while normalization uses the biased one — torch does
+      exactly this; flax uses the biased variance for both.
+    * statistics are always computed in fp32; under a bf16 precision
+      policy only the *output* is cast down (fp32 running stats would
+      otherwise promote every activation back to fp32 and undo mixed
+      precision network-wide).
+
+    Param/variable naming matches flax BatchNorm ('scale'/'bias',
+    batch_stats 'mean'/'var') so checkpoints and the torch->flax converter
+    are unaffected. Under global-view jit with a batch-sharded mesh the
+    reductions below span the GLOBAL batch — the reference's SyncBatchNorm
+    semantics (ref train.py:374) with zero extra code.
+    """
+
+    use_running_average: bool
+    momentum: float = 0.9  # flax convention: new = m*old + (1-m)*batch
+    epsilon: float = 1e-5
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        features = x.shape[-1]
+        scale = self.param(
+            "scale", nn.initializers.ones, (features,), jnp.float32
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (features,), jnp.float32
+        )
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(xf, axes)
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xf), axes) - jnp.square(mean), 0.0
+            )
+            if not self.is_initializing():
+                n = math.prod(x.shape[a] for a in axes)
+                unbiased = var * (n / max(n - 1, 1))
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * unbiased
+
+        inv = jax.lax.rsqrt(var + self.epsilon) * scale
+        y = (x.astype(jnp.float32) - mean) * inv + bias
+        return y.astype(self.dtype or x.dtype)
+
+
 def make_norm(
     norm: str, *, use_running_average: bool, name: Optional[str] = None
 ) -> nn.Module:
-    """Normalization factory. 'batch' matches torch BatchNorm1d defaults
-    (momentum 0.1 -> flax momentum 0.9, eps 1e-5). Under global-view jit with
-    a batch-sharded mesh the batch statistics are computed over the *global*
-    batch, which is exactly the reference's SyncBatchNorm semantics
-    (train.py:374) with zero extra code.
+    """Normalization factory. 'batch' matches torch BatchNorm1d exactly
+    (momentum 0.1 -> our momentum 0.9, eps 1e-5, unbiased running-var
+    update — see :class:`BatchNorm1dParity`). Under global-view jit with
+    a batch-sharded mesh the batch statistics are computed over the
+    *global* batch, which is exactly the reference's SyncBatchNorm
+    semantics (train.py:374) with zero extra code.
     """
-    # Under a bf16 precision policy the norm's *output* dtype is pinned to
-    # bf16: its fp32 running stats would otherwise promote every activation
-    # back to fp32 and silently undo mixed precision for the whole network.
-    # Statistics are still computed in >=fp32 internally (flax guarantees
-    # this for half-precision inputs) and running stats stay fp32.
     from seist_tpu.train.precision import policy_dtype
 
     dtype = policy_dtype()
     if norm == "batch":
-        return nn.BatchNorm(
+        return BatchNorm1dParity(
             use_running_average=use_running_average,
             momentum=0.9,
             epsilon=1e-5,

@@ -414,6 +414,12 @@ def train_worker(args: Any) -> str:
         if writer is not None:
             writer.add_scalar("train-loss/epoch", epoch_train_loss, epoch)
             writer.add_scalar("val-loss/epoch", val_loss, epoch)
+            # Train metrics accumulated at --log-step cadence + psum'd
+            # across hosts above (ref train.py:420-442 logs both phases).
+            for task, m in metrics_merged.items():
+                writer.add_scalars(
+                    f"train.{task}.metrics/epoch", m.get_all_metrics(), epoch
+                )
             for task, m in val_metrics.items():
                 writer.add_scalars(
                     f"val.{task}.metrics/epoch", m.get_all_metrics(), epoch

@@ -41,6 +41,13 @@ def main() -> None:
                         help="output orbax checkpoint directory")
     args = parser.parse_args()
 
+    # Pure host-side conversion (shape-only trace + numpy + orbax): force
+    # the CPU backend — importing jax with the TPU tunnel down would
+    # otherwise hang minutes in backend init for no benefit.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
     import torch
 
     import seist_tpu

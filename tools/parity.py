@@ -49,7 +49,12 @@ def torch_key_to_flax(key: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
     if parent.isdigit():
         norm_parent = len(parts) > 2 and parts[-3] == "norms"
     else:
-        norm_parent = parent == "out_norm" or re.fullmatch(r"norm\d*", parent)
+        # "norm"/"norm{k}"/"out_norm" (seist) or "bn{k}"/"bn_in" (phasenet).
+        norm_parent = (
+            parent == "out_norm"
+            or bool(re.fullmatch(r"norm\d*", parent))
+            or bool(re.fullmatch(r"bn\w*", parent))
+        )
     is_norm_leaf = leaf in _BN_LEAVES and bool(norm_parent)
     if leaf == "num_batches_tracked":
         return None
@@ -87,6 +92,15 @@ def torch_key_to_flax(key: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
         ):
             out.append(f"{p[:-1]}{parts[i + 1]}")
             i += 2
+        elif (
+            p in ("down_convs", "up_convs")
+            and i + 1 < len(parts)
+            and parts[i + 1].isdigit()
+        ):
+            # phasenet U-Net lists: down_convs.{i} -> down{i}, up_convs.{j}
+            # -> up{j} (ref phasenet.py:152-267).
+            out.append(f"{p.split('_')[0]}{parts[i + 1]}")
+            i += 2
         else:
             out.append(p)
             i += 1
@@ -106,6 +120,11 @@ def _fit_leaf(value: np.ndarray, target_shape: Tuple[int, ...], key: str) -> np.
     v = np.asarray(value)
     if v.ndim <= 1:
         t = v
+    elif ".convt." in f".{key}." and v.ndim == 3:
+        # torch ConvTranspose1d (in,out,k) -> flax ConvTranspose kernel
+        # (k,in,out) with the spatial axis FLIPPED (verified empirically:
+        # flax's conv_transpose does not flip, torch's semantics do).
+        t = v.transpose(2, 0, 1)[::-1]
     elif len(target_shape) == 3 and v.ndim == 3:
         t = v.transpose(2, 1, 0)  # (out,in,k) -> (k,in,out)
     elif len(target_shape) == 2:
