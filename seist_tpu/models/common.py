@@ -136,10 +136,18 @@ def interpolate_linear(x: Array, out_size: int) -> Array:
     src = (dst + 0.5) * L_in/L_out - 0.5, clamped; linear blend of the two
     nearest source samples (ref usage: seist.py:566, ditingmotion nearest uses
     interpolate_nearest below).
+
+    Integer upscale factors (the dpk head's whole ladder) take a pure
+    arithmetic path — per output phase j the source pair is a fixed
+    (shift, weight), so the result is r weighted blends of two shifted
+    copies, interleaved by reshape. No gather: TPU lowers this to plain
+    vector ops instead of a gather HLO.
     """
     L_in = x.shape[-2]
     if L_in == out_size:
         return x
+    if out_size % L_in == 0:
+        return _interpolate_linear_intscale(x, out_size // L_in)
     scale = L_in / out_size
     dst = jnp.arange(out_size, dtype=jnp.float32)
     src = (dst + 0.5) * scale - 0.5
@@ -148,6 +156,29 @@ def interpolate_linear(x: Array, out_size: int) -> Array:
     hi = jnp.minimum(lo + 1, L_in - 1)
     w = (src - lo.astype(jnp.float32))[None, :, None].astype(x.dtype)
     return x[:, lo, :] * (1.0 - w) + x[:, hi, :] * w
+
+
+def _interpolate_linear_intscale(x: Array, r: int) -> Array:
+    """Gather-free linear upsampling by integer factor ``r``.
+
+    For output index d = i*r + j: src = i + (j + 0.5 - r/2)/r, so phase j
+    blends x[i] with its left (o_j < 0) or right (o_j > 0) neighbor with a
+    static weight; edge clamping reproduces the gather path's jnp.clip.
+    """
+    x_prev = jnp.concatenate([x[:, :1], x[:, :-1]], axis=1)
+    x_next = jnp.concatenate([x[:, 1:], x[:, -1:]], axis=1)
+    phases = []
+    for j in range(r):
+        o = (j + 0.5 - r / 2.0) / r
+        if o < 0:
+            phases.append(x * (1.0 + o) + x_prev * (-o))
+        elif o > 0:
+            phases.append(x * (1.0 - o) + x_next * o)
+        else:
+            phases.append(x)
+    out = jnp.stack(phases, axis=2)  # (N, L, r, C)
+    n, l, _, c = out.shape
+    return out.reshape(n, l * r, c)
 
 
 def interpolate_nearest(x: Array, out_size: int) -> Array:

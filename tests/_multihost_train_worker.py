@@ -32,7 +32,14 @@ import seist_tpu  # noqa: E402
 from seist_tpu.utils.logger import logger  # noqa: E402
 
 seist_tpu.load_all()
-logger.set_logdir(os.path.join(logdir, f"proc{proc_id}"))
+# ONE shared logdir for all processes — the production path guarantees
+# this (cli.main_worker broadcasts the resolved dir from process 0), and
+# the collective orbax save REQUIRES it: each process writes its shard
+# under the primary's checkpoint directory. Divergent dirs deadlock the
+# save (process 1 waits for array_metadatas under its own path forever).
+logger.set_logdir(logdir)
+if proc_id != 0:
+    logger.enable_console(False)
 
 sys.path.insert(0, os.path.dirname(__file__))
 from test_worker_e2e import make_args  # noqa: E402
@@ -43,7 +50,11 @@ args = make_args(
     epochs=1,
     batch_size=4,  # per-host; global 8 over the 8-device mesh
     workers=2,
-    dataset_kwargs={"num_events": 30, "trace_samples": 4096},
+    # Shorter windows than the single-process e2e defaults: two of these
+    # processes share the host's ONE cpu core, so the jit compile (the
+    # dominant cost) must stay small or the test rig's timeout trips.
+    in_samples=512,
+    dataset_kwargs={"num_events": 30, "trace_samples": 2048},
 )
 ckpt = train_worker(args)
 assert ckpt and os.path.exists(ckpt), ckpt

@@ -1,0 +1,77 @@
+"""Geometry-parity helpers in models/common.py: the gather-free integer
+upsampling must match both the generic gather path and torch
+F.interpolate exactly (the dpk head's pick alignment depends on it —
+SURVEY.md hard-part #3)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from seist_tpu.models.common import (
+    _interpolate_linear_intscale,
+    interpolate_linear,
+)
+
+
+def _gather_reference(x, out_size):
+    """The generic (gather) formula, inlined so the fast path can't shadow it."""
+    L_in = x.shape[-2]
+    scale = L_in / out_size
+    dst = np.arange(out_size, dtype=np.float32)
+    src = np.clip((dst + 0.5) * scale - 0.5, 0.0, L_in - 1)
+    lo = np.floor(src).astype(np.int32)
+    hi = np.minimum(lo + 1, L_in - 1)
+    w = (src - lo)[None, :, None].astype(np.float32)
+    return x[:, lo, :] * (1.0 - w) + x[:, hi, :] * w
+
+
+@pytest.mark.parametrize("r", [2, 4, 8, 64])
+def test_intscale_matches_gather_dyadic_exact(rng, r):
+    # Power-of-two factors (the only ones the dpk ladder uses): the static
+    # phase weights are exact binary fractions -> bit-identical results.
+    x = rng.standard_normal((2, 16, 3)).astype(np.float32)
+    want = _gather_reference(x, 16 * r)
+    got = np.asarray(_interpolate_linear_intscale(jnp.asarray(x), r))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("r", [3, 5, 6])
+def test_intscale_matches_gather_odd(rng, r):
+    # Non-dyadic factors: the gather path rounds its weights through
+    # fp32 `(d+0.5)*scale`, ours are exact doubles -> ~1e-6 fp noise.
+    x = rng.standard_normal((2, 16, 3)).astype(np.float32)
+    want = _gather_reference(x, 16 * r)
+    got = np.asarray(_interpolate_linear_intscale(jnp.asarray(x), r))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=5e-6)
+
+
+@pytest.mark.parametrize("out", [24, 40, 100])
+def test_non_integer_ratio_uses_gather(rng, out):
+    x = rng.standard_normal((2, 16, 3)).astype(np.float32)
+    want = _gather_reference(x, out)
+    got = np.asarray(interpolate_linear(jnp.asarray(x), out))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("out", [32, 48, 100, 1024])
+def test_matches_torch_interpolate(rng, out):
+    torch = pytest.importorskip("torch")
+    x = rng.standard_normal((2, 16, 3)).astype(np.float32)
+    want = (
+        torch.nn.functional.interpolate(
+            torch.from_numpy(x.transpose(0, 2, 1)),
+            size=out,
+            mode="linear",
+            align_corners=False,
+        )
+        .numpy()
+        .transpose(0, 2, 1)
+    )
+    got = np.asarray(interpolate_linear(jnp.asarray(x), out))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=5e-6)
+
+
+def test_identity_when_same_size(rng):
+    x = jnp.asarray(rng.standard_normal((1, 8, 2)).astype(np.float32))
+    assert interpolate_linear(x, 8) is x

@@ -81,7 +81,8 @@ def test_two_host_training(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=540)
+            # Generous: both workers compile on the same single CPU core.
+            out, _ = p.communicate(timeout=900)
             outs.append(out.decode())
     except subprocess.TimeoutExpired:
         for p in procs:

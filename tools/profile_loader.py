@@ -1,0 +1,62 @@
+"""cProfile the input pipeline to find where the per-sample time goes.
+
+    python tools/profile_loader.py [n_batches] [batch_size]
+
+Prints the top cumulative-time functions for a full-augmentation
+synthetic-dataset run (same path as tools/bench_loader.py measures).
+"""
+
+from __future__ import annotations
+
+import cProfile
+import os
+import pstats
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> None:
+    n_batches = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+
+    import seist_tpu
+    from seist_tpu import taskspec
+    from seist_tpu.data import pipeline
+
+    seist_tpu.load_all()
+    spec = taskspec.get_task_spec("seist_l_dpk")
+    dataset = pipeline.from_task_spec(
+        spec,
+        "synthetic",
+        "train",
+        seed=0,
+        in_samples=8192,
+        augmentation=True,
+        dataset_kwargs={"num_events": batch * 4},
+    )
+    # workers=1 so the profile sees the work inline, not in pool threads.
+    loader = pipeline.Loader(
+        dataset, batch, shuffle=True, drop_last=True, num_workers=1, seed=0
+    )
+    it = iter(loader)
+    next(it)  # warm
+
+    prof = cProfile.Profile()
+    prof.enable()
+    for _ in range(n_batches):
+        try:
+            next(it)
+        except StopIteration:
+            loader.set_epoch(loader.epoch + 1)
+            it = iter(loader)
+            next(it)
+    prof.disable()
+    stats = pstats.Stats(prof)
+    stats.sort_stats("cumulative").print_stats(25)
+
+
+if __name__ == "__main__":
+    main()

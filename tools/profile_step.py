@@ -1,0 +1,96 @@
+"""Capture a jax.profiler trace of the training step on the live TPU.
+
+Usage (writes a TensorBoard-loadable trace directory):
+
+    python tools/profile_step.py --model-name seist_l_dpk --batch 256 \
+        --steps 10 --out /tmp/seist_trace
+
+Then inspect with TensorBoard's profile plugin, or grep the
+``*.trace.json.gz`` event names for the top self-time ops. Complements
+bench.py (which reports wall-clock wf/s + MFU but not per-op breakdown).
+
+Env: same knobs as bench.py (BENCH_DTYPE etc. are read from flags here).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="TPU train-step profiler")
+    p.add_argument("--model-name", default="seist_l_dpk")
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--in-samples", type=int, default=8192)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--dtype", default="fp32", choices=["fp32", "bf16"])
+    p.add_argument("--out", default="/tmp/seist_trace")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import seist_tpu
+    from seist_tpu import taskspec
+    from seist_tpu.models import api
+    from seist_tpu.train import (
+        build_cyclic_schedule,
+        build_optimizer,
+        create_train_state,
+        make_train_step,
+    )
+
+    seist_tpu.load_all()
+    print(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
+
+    model = api.create_model(args.model_name, in_samples=args.in_samples)
+    variables = api.init_variables(
+        model, in_samples=args.in_samples, batch_size=args.batch
+    )
+    state = create_train_state(
+        model,
+        variables,
+        build_optimizer(
+            "adam", build_cyclic_schedule(8e-5, 1e-3, total_steps=10_000)
+        ),
+    )
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((args.batch, args.in_samples, 3)), jnp.float32
+    )
+    y = np.zeros((args.batch, args.in_samples, 3), np.float32)
+    y[:, args.in_samples // 4, 1] = 1.0
+    y[:, args.in_samples // 2, 2] = 1.0
+    y[..., 0] = 1.0 - y[..., 1] - y[..., 2]
+    y = jnp.asarray(y)
+
+    spec = taskspec.get_task_spec(args.model_name)
+    loss_fn = taskspec.make_loss(args.model_name)
+    step_fn = make_train_step(spec, loss_fn, compute_dtype=args.dtype)
+    key = jax.random.PRNGKey(0)
+
+    t0 = time.time()
+    step = jax.jit(step_fn).lower(state, x, y, key).compile()
+    print(f"compiled in {time.time() - t0:.1f}s")
+    for _ in range(3):
+        state, loss, _ = step(state, x, y, key)
+    jax.block_until_ready(state.params)
+
+    with jax.profiler.trace(args.out):
+        for _ in range(args.steps):
+            state, loss, _ = step(state, x, y, key)
+        jax.block_until_ready(state.params)
+    print(f"trace written to {args.out}")
+
+
+if __name__ == "__main__":
+    main()
