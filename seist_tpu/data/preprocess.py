@@ -129,6 +129,8 @@ class DataPreprocessor:
         self.soft_label_shape = soft_label_shape
         self.soft_label_width = soft_label_width
         self.dtype = dtype
+        # (width, shape) -> window array; hot-path memo for _soft_window.
+        self._window_cache: dict = {}
 
     # ------------------------------------------------------------------ noise
     def _clear_event_except(self, event: Event, *keep: str) -> None:
@@ -452,7 +454,21 @@ class DataPreprocessor:
 
     # ------------------------------------------------------------- soft labels
     def _soft_window(self, soft_label_width: int, soft_label_shape: str) -> np.ndarray:
-        """The (width+1)-sample label window (ref: preprocess.py:571-601)."""
+        """The (width+1)-sample label window (ref: preprocess.py:571-601).
+
+        Cached per (width, shape): the window is identical for every call
+        in a run and sits on the per-sample hot path."""
+        key = (soft_label_width, soft_label_shape)
+        window = self._window_cache.get(key)
+        if window is None:
+            window = self._window_cache[key] = self._make_soft_window(
+                soft_label_width, soft_label_shape
+            )
+        return window
+
+    def _make_soft_window(
+        self, soft_label_width: int, soft_label_shape: str
+    ) -> np.ndarray:
         left = int(soft_label_width / 2)
         right = soft_label_width - left
         if soft_label_shape == "gaussian":
@@ -520,20 +536,22 @@ class DataPreprocessor:
         def _clip(x: int) -> int:
             return min(max(x, 0), length)
 
-        # Padded lists are used by 'non' and 'det' only; 'ppk'/'spk' use the
-        # raw event lists (ref: preprocess.py:621-631).
-        ppks, spks = pad_phases(
-            ppks=event["ppks"],
-            spks=event["spks"],
-            padding_idx=width,
-            num_samples=length,
-        )
+        def _padded_phases():
+            # Padded lists are used by 'non' and 'det' only; 'ppk'/'spk'
+            # use the raw event lists (ref: preprocess.py:621-631).
+            return pad_phases(
+                ppks=event["ppks"],
+                spks=event["spks"],
+                padding_idx=width,
+                num_samples=length,
+            )
 
         if name in ("ppk", "spk"):
             key = {"ppk": "ppks", "spk": "spks"}[name]
             label = self._soft_label(event[key], length, width, shape)
 
         elif name == "non":
+            ppks, spks = _padded_phases()
             label = (
                 np.ones(length)
                 - self._soft_label(ppks, length, width, shape)
@@ -542,6 +560,7 @@ class DataPreprocessor:
             label[label < 0] = 0
 
         elif name == "det":
+            ppks, spks = _padded_phases()
             label = np.zeros(length)
             assert len(ppks) == len(spks)
             for ppk, spk in zip(ppks, spks):
