@@ -23,7 +23,9 @@ numbers and no GPU is available here — see BASELINE.md for an analytical
 A100 anchor; the measured value lives in tools/reference_baseline.json).
 
 Env knobs: BENCH_MODEL, BENCH_BATCH, BENCH_SAMPLES, BENCH_STEPS,
-BENCH_DTYPE (fp32|bf16), BENCH_MODE (train|loader).
+BENCH_DTYPE (fp32|bf16), BENCH_MODE (train|loader), BENCH_STEPS_PER_CALL
+(k>1 scans k optimizer updates inside one jitted call — dispatch
+amortization; see train/step.py make_multi_train_step), BENCH_DONATE.
 """
 
 from __future__ import annotations
@@ -164,10 +166,49 @@ def _vs_baseline(wfs: float) -> float:
     return 0.0
 
 
+def _synthetic_batch(spec, batch: int, in_samples: int, k: int = 1):
+    """(inputs, loss_targets) via the real input pipeline on the synthetic
+    dataset, so every registered model config benches with its true label
+    shapes (dpk soft curves, pmp one-hot, emg/baz/dis values...).
+
+    ``k > 1`` returns ``k`` distinct batches stacked on a leading axis (for
+    the multi-step scan path).
+    """
+    import jax
+    import numpy as np
+    from seist_tpu.data.pipeline import Loader, from_task_spec
+
+    ds = from_task_spec(
+        spec,
+        "synthetic",
+        "train",
+        seed=0,
+        in_samples=in_samples,
+        augmentation=False,
+        data_split=False,
+        dataset_kwargs={
+            "num_events": batch * k,
+            "trace_samples": max(12_000, in_samples + in_samples // 2),
+        },
+    )
+    loader = Loader(ds, batch_size=batch, shuffle=False, num_workers=1)
+    try:
+        batches = []
+        for b in loader:
+            batches.append((b.inputs, b.loss_targets))
+            if len(batches) == k:
+                break
+    finally:
+        loader.close()
+    if k == 1:
+        stacked = batches[0]
+    else:
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+    return jax.tree.map(jax.device_put, stacked)
+
+
 def bench_train(device_kind: str) -> None:
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     import seist_tpu
     from seist_tpu import taskspec
@@ -176,6 +217,7 @@ def bench_train(device_kind: str) -> None:
         build_cyclic_schedule,
         build_optimizer,
         create_train_state,
+        make_multi_train_step,
         make_train_step,
     )
 
@@ -183,33 +225,42 @@ def bench_train(device_kind: str) -> None:
 
     model_name = os.environ.get("BENCH_MODEL", "seist_l_dpk")
     in_samples = int(os.environ.get("BENCH_SAMPLES", 8192))
-    batch = int(os.environ.get("BENCH_BATCH", 256))
+    # Default 512: closest power of 2 to the reference's headline batch 500
+    # (ref main.py:119-149) and measurably better wf/s than 256 on v5e.
+    batch = int(os.environ.get("BENCH_BATCH", 512))
     dtype = os.environ.get("BENCH_DTYPE", "fp32")
+    # Micro-steps scanned inside one jitted call (amortizes per-dispatch
+    # cost; see train/step.py make_multi_train_step).
+    spc = int(os.environ.get("BENCH_STEPS_PER_CALL", 1))
     warmup_steps = 5
     bench_steps = int(os.environ.get("BENCH_STEPS", 30))
     metric = f"{model_name}_train_throughput"
     unit = "waveforms/sec/chip"
 
-    model = api.create_model(model_name, in_samples=in_samples)
+    spec = taskspec.get_task_spec(model_name)
+    loss_fn = taskspec.make_loss(model_name)
+    in_channels = taskspec.get_num_inchannels(model_name)
+
+    model = api.create_model(
+        model_name, in_channels=in_channels, in_samples=in_samples
+    )
     variables = api.init_variables(
-        model, in_samples=in_samples, batch_size=batch
+        model,
+        in_samples=in_samples,
+        in_channels=in_channels,
+        batch_size=batch,
     )
     sched = build_cyclic_schedule(8e-5, 1e-3, total_steps=10_000)
     state = create_train_state(model, variables, build_optimizer("adam", sched))
 
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(
-        rng.standard_normal((batch, in_samples, 3)), dtype=jnp.float32
+    x, y = _synthetic_batch(spec, batch, in_samples, k=spc)
+    step_fn = (
+        make_multi_train_step(
+            spec, loss_fn, compute_dtype=dtype, steps_per_call=spc
+        )
+        if spc > 1
+        else make_train_step(spec, loss_fn, compute_dtype=dtype)
     )
-    y = np.zeros((batch, in_samples, 3), np.float32)
-    y[:, in_samples // 4, 1] = 1.0
-    y[:, in_samples // 2, 2] = 1.0
-    y[..., 0] = 1.0 - y[..., 1] - y[..., 2]
-    y = jnp.asarray(y)
-
-    spec = taskspec.get_task_spec(model_name)
-    loss_fn = taskspec.make_loss(model_name)
-    step_fn = make_train_step(spec, loss_fn, compute_dtype=dtype)
     key = jax.random.PRNGKey(0)
 
     # AOT-compile ONCE; the same executable serves cost analysis (FLOPs for
@@ -246,8 +297,13 @@ def bench_train(device_kind: str) -> None:
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
 
-    wfs = batch * bench_steps / dt
-    step_ms = dt / bench_steps * 1e3
+    # With steps_per_call > 1, each call is `spc` optimizer updates on
+    # `spc` distinct micro-batches; normalize everything to ONE update.
+    # XLA cost_analysis counts a scan body ONCE regardless of trip count
+    # (verified: the k=8 program reports the same total flops as k=1), so
+    # the per-waveform divisor is `batch`, not `batch * spc`.
+    wfs = batch * spc * bench_steps / dt
+    step_ms = dt / (bench_steps * spc) * 1e3
     flops_per_wf = flops_per_step / batch if flops_per_step else 0.0
     mfu = (
         wfs * flops_per_wf / _peak_flops(device_kind)
@@ -268,6 +324,7 @@ def bench_train(device_kind: str) -> None:
         "device": device_kind,
         "batch": batch,
         "in_samples": in_samples,
+        "steps_per_call": spc,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     try:  # cache for _fail's marked replay when the tunnel is down
@@ -310,8 +367,9 @@ def main() -> None:
     # attribute another dtype/batch/length's number to this one.
     config = {
         "dtype": os.environ.get("BENCH_DTYPE", "fp32"),
-        "batch": int(os.environ.get("BENCH_BATCH", 256)),
+        "batch": int(os.environ.get("BENCH_BATCH", 512)),
         "in_samples": int(os.environ.get("BENCH_SAMPLES", 8192)),
+        "steps_per_call": int(os.environ.get("BENCH_STEPS_PER_CALL", 1)),
     }
     kind = probe_backend()
     if kind is None:

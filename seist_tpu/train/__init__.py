@@ -17,5 +17,6 @@ from seist_tpu.train.step import (  # noqa: F401
     jit_eval_step,
     jit_step,
     make_eval_step,
+    make_multi_train_step,
     make_train_step,
 )

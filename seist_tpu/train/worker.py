@@ -14,6 +14,7 @@ jitted step over a device mesh:
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -499,7 +500,7 @@ def test_worker(args: Any) -> float:
         ),
         mesh,
     )
-    loss, _ = validate(
+    loss, metrics_merged = validate(
         args,
         state,
         eval_step,
@@ -509,5 +510,26 @@ def test_worker(args: Any) -> float:
         testing=True,
         save_results=args.save_test_results,
     )
+    if is_main_process():
+        # Structured metrics artifact beside the log/CSV (the reference only
+        # logs a formatted string, test.py:83-88); consumed by
+        # tools/parity_eval.py and anything scripting over test runs.
+        payload = {
+            "model": args.model_name,
+            "dataset": args.dataset_name,
+            "loss": float(loss),
+            "metrics": {
+                task: m.get_metrics(m.metric_names())
+                for task, m in metrics_merged.items()
+            },
+        }
+        out_json = get_safe_path(
+            os.path.join(
+                logger.logdir(), f"test_metrics_{args.dataset_name}.json"
+            )
+        )
+        with open(out_json, "w") as f:
+            json.dump(payload, f, indent=1)
+        logger.info(f"Test metrics saved: {out_json}")
     test_loader.close()
     return loss

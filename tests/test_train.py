@@ -20,6 +20,7 @@ from seist_tpu.train import (
     jit_step,
     load_checkpoint,
     make_eval_step,
+    make_multi_train_step,
     make_train_step,
     restore_into_state,
     save_checkpoint,
@@ -90,6 +91,47 @@ def test_train_step_reduces_loss(rng):
         state, loss, _ = step(state, x, y, key)
     assert float(loss) < float(loss0)
     assert int(state.step) == 11
+
+
+def test_multi_train_step_matches_sequential(rng):
+    """k scanned micro-steps == k sequential single steps (same per-step
+    RNG folding via state.step; see train/step.py make_multi_train_step).
+    SGD keeps the comparison linear in the gradients, so the only residue
+    is XLA fusion reassociation (Adam's m/sqrt(v) normalization would
+    amplify ULP noise to +/-lr on step 1)."""
+    k = 3
+    batches = [_fake_dpk_batch(rng) for _ in range(k)]
+    xs = jnp.stack([b[0] for b in batches])
+    ys = jnp.stack([b[1] for b in batches])
+    key = jax.random.PRNGKey(7)
+
+    def sgd_setup():
+        model = api.create_model("phasenet", in_samples=L)
+        variables = api.init_variables(model, in_samples=L, batch_size=4)
+        tx = build_optimizer("sgd", 1e-2)
+        state = create_train_state(model, variables, tx)
+        spec = taskspec.get_task_spec("phasenet")
+        return state, spec, taskspec.make_loss("phasenet")
+
+    state, spec, loss_fn = sgd_setup()
+    single = jax.jit(make_train_step(spec, loss_fn))
+    losses = []
+    for i in range(k):
+        state, loss, _ = single(state, xs[i], ys[i], key)
+        losses.append(float(loss))
+
+    state2, _, _ = sgd_setup()
+    multi = jax.jit(make_multi_train_step(spec, loss_fn, steps_per_call=k))
+    state2, mean_loss, _ = multi(state2, xs, ys, key)
+
+    assert int(state2.step) == k
+    np.testing.assert_allclose(float(mean_loss), np.mean(losses), rtol=1e-6)
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(state2.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
 
 
 def test_train_step_updates_batch_stats(rng):

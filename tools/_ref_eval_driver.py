@@ -1,0 +1,160 @@
+"""Run the torch reference's test-mode eval on a fixture dataset; print JSON.
+
+Executed as a subprocess by tools/parity_eval.py. Imports the reference
+from /root/reference (read-only, never modified) with minimal stubs for its
+three dependencies absent from this image:
+
+* ``timm`` — only ``timm.models.layers.DropPath`` is used (ref
+  models/seist.py:7); identity in eval mode, so a no-op module suffices.
+* ``GPUtil`` — only consulted for an RTX-40xx NCCL workaround (ref
+  utils/misc.py:154-164); never reached on CPU.
+* ``obspy.signal.trigger.trigger_onset`` — reimplemented here in numpy with
+  obspy's documented semantics (onset where charfct > thres1, extending to
+  the LAST index where charfct > thres2 of the contiguous above-thres2
+  region). The reference calls it with thres1 == thres2
+  (ref training/postprocess.py:130), where this reduces to maximal
+  above-threshold runs — the same semantics as our
+  seist_tpu/ops/postprocess.py:detect_events, so the det-task comparison
+  shares trigger semantics by construction.
+
+Output (stdout, last line): JSON {"metrics": {task: {metric: value}},
+"loss": float, "ev_ids": [...]}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import types
+
+import numpy as np
+
+
+def _install_stubs() -> None:
+    import torch.nn as nn
+
+    class DropPath(nn.Module):  # identity at eval; p=0 equivalent
+        def __init__(self, drop_prob=None):
+            super().__init__()
+            self.drop_prob = drop_prob
+
+        def forward(self, x):
+            return x
+
+    timm = types.ModuleType("timm")
+    models_m = types.ModuleType("timm.models")
+    layers_m = types.ModuleType("timm.models.layers")
+    layers_m.DropPath = DropPath
+    timm.models = models_m
+    models_m.layers = layers_m
+    sys.modules.setdefault("timm", timm)
+    sys.modules.setdefault("timm.models", models_m)
+    sys.modules.setdefault("timm.models.layers", layers_m)
+
+    gputil = types.ModuleType("GPUtil")
+    gputil.getGPUs = lambda: []
+    sys.modules.setdefault("GPUtil", gputil)
+
+    def trigger_onset(charfct, thres1, thres2, max_len=9e99,
+                      max_len_delete=False):
+        charfct = np.asarray(charfct)
+        above2 = charfct > thres2
+        if not above2.any():
+            return []
+        # Maximal contiguous above-thres2 regions.
+        idx = np.flatnonzero(above2)
+        region_start = idx[np.concatenate([[True], np.diff(idx) > 1])]
+        region_end = idx[np.concatenate([np.diff(idx) > 1, [True]])]
+        picks = []
+        for s, e in zip(region_start, region_end):
+            seg = np.flatnonzero(charfct[s : e + 1] > thres1)
+            if len(seg) == 0:
+                continue
+            on = int(s + seg[0])
+            if e - on > max_len and max_len_delete:
+                continue
+            picks.append([on, int(min(e, on + max_len))])
+        return np.array(picks, dtype=np.int64) if picks else []
+
+    obspy = types.ModuleType("obspy")
+    signal = types.ModuleType("obspy.signal")
+    trigger = types.ModuleType("obspy.signal.trigger")
+    trigger.trigger_onset = trigger_onset
+    obspy.signal = signal
+    signal.trigger = trigger
+    sys.modules.setdefault("obspy", obspy)
+    sys.modules.setdefault("obspy.signal", signal)
+    sys.modules.setdefault("obspy.signal.trigger", trigger)
+
+
+def main() -> None:
+    _install_stubs()
+    sys.path.insert(0, "/root/reference")
+
+    import torch
+
+    from main import get_args  # reference CLI defaults are the contract
+    from config import Config
+    from models import create_model, load_checkpoint
+    from training.preprocess import SeismicDataset
+    from training.validate import validate
+    from utils import logger, setup_seed
+
+    args = get_args()
+    device = torch.device("cpu")
+    logger.set_logdir(args.log_base)
+    logger.set_logger("global")
+    setup_seed(args.seed)
+
+    model_inputs, model_labels, model_tasks = Config.get_model_config_(
+        args.model_name, "inputs", "labels", "eval"
+    )
+    in_channels = Config.get_num_inchannels(model_name=args.model_name)
+    test_dataset = SeismicDataset(
+        args=args,
+        input_names=model_inputs,
+        label_names=model_labels,
+        task_names=model_tasks,
+        mode="test",
+    )
+    test_loader = torch.utils.data.DataLoader(
+        test_dataset,
+        batch_size=args.batch_size,
+        shuffle=False,
+        num_workers=args.workers,
+    )
+
+    checkpoint = load_checkpoint(args.checkpoint, device=device)
+    model = create_model(
+        model_name=args.model_name,
+        in_channels=in_channels,
+        in_samples=args.in_samples,
+    )
+    if checkpoint is not None and "model_dict" in checkpoint:
+        model.load_state_dict(checkpoint["model_dict"])
+    model = model.to(device)
+
+    loss_fn = Config.get_loss(model_name=args.model_name).to(device)
+
+    loss, metrics_merged = validate(
+        args, model_tasks, model, loss_fn, test_loader, 0, device,
+        testing=True,
+    )
+
+    out = {
+        "loss": float(loss),
+        "metrics": {
+            task: {
+                name: float(m.get_metric(name)) for name in m.metric_names()
+            }
+            for task, m in metrics_merged.items()
+        },
+        "ev_ids": [
+            int(v) for v in test_dataset._dataset._meta_data["ev_id"]
+        ],
+    }
+    print("\nPARITY_JSON " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

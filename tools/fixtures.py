@@ -1,0 +1,107 @@
+"""DiTing-light-format fixture dataset writer (shared by parity_eval).
+
+Writes a tiny on-disk dataset in the exact format the reference's
+``DiTing_light`` reader consumes (ref datasets/diting.py:217-311: single
+numeric CSV ``DiTing330km_light.csv`` + per-part HDF5 with ``earthquake/<key>``
+datasets of shape (L, 3), keys zero-padded by the reader, diting.py:136-137)
+— so BOTH the torch reference and this framework can be evaluated on
+byte-identical data.
+
+Traces are generated at exactly ``in_samples`` length: the reference's
+``_cut_window`` is a no-op when input length == window size (ref
+preprocess.py:207-219 — neither the crop nor the pad branch runs), which
+removes the only RNG-dependent step from the eval input path and makes the
+two frameworks' model inputs bit-comparable.
+
+Waveforms are noise + damped P/S wavelets (same recipe as
+seist_tpu/data/synthetic.py, independent of any reference code).
+"""
+
+from __future__ import annotations
+
+import os
+
+import h5py
+import numpy as np
+import pandas as pd
+
+_SNR_COLS = [
+    f"{c}_{ph}_{kind}_snr"
+    for c in "ZNE"
+    for ph in "PS"
+    for kind in ("amplitude", "power")
+]
+
+
+def _wavelet(rng: np.random.Generator, length: int, freq: float, fs: int):
+    t = np.arange(length) / fs
+    envelope = t * np.exp(-3.0 * t)
+    carrier = np.sin(2 * np.pi * freq * t + rng.uniform(0, 2 * np.pi))
+    return (envelope * carrier / (np.abs(envelope).max() + 1e-9)).astype(
+        np.float32
+    )
+
+
+def write_diting_light_fixture(
+    root: str,
+    *,
+    n_events: int = 240,
+    trace_samples: int = 8192,
+    fs: int = 50,
+    seed: int = 1234,
+    n_parts: int = 2,
+) -> str:
+    """Write the fixture dataset under ``root``; returns ``root``."""
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    rows = []
+    waves = {p: {} for p in range(n_parts)}
+    for i in range(n_events):
+        part = i % n_parts
+        # Short key on purpose: exercises the reader's zero-padding
+        # (ref diting.py:136-137).
+        key = f"{100 + i}.{part}"
+        ppk = int(rng.integers(trace_samples // 8, trace_samples // 2))
+        spk = int(ppk + rng.integers(trace_samples // 20, trace_samples // 4))
+        data = rng.normal(0, 1.0, size=(trace_samples, 3)).astype(np.float32)
+        amp = float(rng.uniform(5.0, 20.0))
+        wl = min(trace_samples - spk, trace_samples // 4)
+        for c in range(3):
+            data[ppk : ppk + wl, c] += amp * _wavelet(
+                rng, wl, float(rng.uniform(4, 8)), fs
+            )
+            data[spk : spk + wl, c] += 1.6 * amp * _wavelet(
+                rng, wl, float(rng.uniform(1.5, 4)), fs
+            )
+        padded = key.split(".")
+        padded = padded[0].rjust(6, "0") + "." + padded[1].ljust(4, "0")
+        waves[part][padded] = data
+        row = {
+            "key": key,
+            "part": part,
+            "ev_id": 1000 + i,
+            "mag_type": "ml",
+            "evmag": float(np.clip(rng.normal(3.5, 1.0), 0, 8)),
+            "st_mag": float(np.clip(rng.normal(3.5, 1.0), 0, 8)),
+            "p_pick": ppk,
+            "p_clarity": "i" if i % 2 else "e",
+            "p_motion": "u" if i % 3 else "d",
+            "s_pick": spk,
+            "net": "XX",
+            "sta_id": i,
+            "dis": float(rng.uniform(0, 330)),
+            "baz": float(rng.uniform(0, 360)),
+            "P_residual": 0.1,
+            "S_residual": 0.2,
+        }
+        for col in _SNR_COLS:
+            row[col] = 20.0
+        rows.append(row)
+    pd.DataFrame(rows).to_csv(os.path.join(root, "DiTing330km_light.csv"))
+    for part in range(n_parts):
+        with h5py.File(
+            os.path.join(root, f"DiTing330km_part_{part}.hdf5"), "w"
+        ) as f:
+            for key, data in waves[part].items():
+                f.create_dataset("earthquake/" + key, data=data)
+    return root
