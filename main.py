@@ -3,6 +3,17 @@
 Thin wrapper over seist_tpu.cli (the reference's root main.py equivalent).
 """
 
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    # Honor JAX_PLATFORMS even where a sitecustomize registers an
+    # accelerator plugin at interpreter start (the env var alone is ignored
+    # there, and a wedged remote backend then hangs init for minutes):
+    # jax.config wins over the registration if set before any device query.
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 from seist_tpu.cli import main
 
 if __name__ == "__main__":

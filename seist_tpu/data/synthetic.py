@@ -19,6 +19,20 @@ from seist_tpu.data.base import DatasetBase, Event
 from seist_tpu.registry import register_dataset
 
 
+def make_wavelet(
+    rng: np.random.Generator, length: int, freq: float, fs: int
+) -> np.ndarray:
+    """Damped sinusoid: t*exp(-3t) envelope, random-phase carrier. Shared
+    by this dataset and tools/fixtures.py (the parity fixture uses the same
+    recipe)."""
+    t = np.arange(length) / fs
+    envelope = t * np.exp(-3.0 * t)
+    carrier = np.sin(2 * np.pi * freq * t + rng.uniform(0, 2 * np.pi))
+    return (envelope * carrier / (np.abs(envelope).max() + 1e-9)).astype(
+        np.float32
+    )
+
+
 class Synthetic(DatasetBase):
     _name = "synthetic"
     _part_range = None
@@ -48,12 +62,7 @@ class Synthetic(DatasetBase):
         return self._shuffle_and_split(meta)
 
     def _make_wavelet(self, rng, length: int, freq: float) -> np.ndarray:
-        t = np.arange(length) / self._sampling_rate
-        envelope = t * np.exp(-3.0 * t)
-        carrier = np.sin(2 * np.pi * freq * t + rng.uniform(0, 2 * np.pi))
-        return (envelope * carrier / (np.abs(envelope).max() + 1e-9)).astype(
-            np.float32
-        )
+        return make_wavelet(rng, length, freq, self._sampling_rate)
 
     @staticmethod
     def _copy_event(event: Event) -> Event:

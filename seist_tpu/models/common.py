@@ -14,6 +14,7 @@ All arrays are ``(N, L, C)``. All modules take ``train: bool`` and use the
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
@@ -205,6 +206,140 @@ def make_divisible(v: int, divisor: int) -> int:
 
 
 # --------------------------------------------------------------------- modules
+class DepthwiseConv1D(nn.Module):
+    """Depthwise conv1d with a TPU-friendly shift-FMA lowering.
+
+    Param tree matches ``nn.Conv(features, (k,), feature_group_count=
+    features)`` exactly — ``kernel`` of shape (k, 1, C) — so checkpoints and
+    the torch converter are unaffected by the impl choice.
+
+    Why not XLA's grouped conv: with the SeisT stem's tiny channel counts
+    (8-24 vs the TPU's 128-wide lanes, seist.py presets) the grouped-conv
+    lowering runs at <1% MFU and dominates the whole model's step time
+    (BASELINE.md round-2 matrix: seist_s 121 ms/step vs phasenet 15 ms at
+    comparable FLOPs). ``impl='shift'`` computes
+    ``y[n,l,c] = sum_j x[n, l*s+j, c] * w[j,c]`` as k strided-slice
+    multiply-adds — pure VPU elementwise work XLA fuses into one kernel.
+    ``impl='grouped'`` keeps the lax.conv path (used off-TPU where grouped
+    convs lower fine and for A/B benchmarking via SEIST_DWCONV_IMPL).
+    """
+
+    features: int
+    kernel_size: int
+    stride: int = 1
+    kernel_init: Any = trunc_normal_init
+    impl: Optional[str] = None  # None -> env SEIST_DWCONV_IMPL or 'shift'
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        kernel = self.param(
+            "kernel", self.kernel_init, (self.kernel_size, 1, self.features)
+        )
+        impl = self.impl or os.environ.get("SEIST_DWCONV_IMPL", "shift")
+        if impl not in ("shift", "grouped"):
+            raise ValueError(f"unknown depthwise impl {impl!r}")
+        if impl == "grouped":
+            return jax.lax.conv_general_dilated(
+                x,
+                kernel.astype(x.dtype),
+                window_strides=(self.stride,),
+                padding="VALID",
+                dimension_numbers=("NWC", "WIO", "NWC"),
+                feature_group_count=self.features,
+            )
+        k, s = self.kernel_size, self.stride
+        w = kernel[:, 0, :].astype(x.dtype)  # (k, C)
+        out_len = (x.shape[-2] - k) // s + 1
+        span = (out_len - 1) * s + 1
+        acc = x[..., 0:span:s, :] * w[0]
+        for j in range(1, k):
+            acc = acc + x[..., j : j + span : s, :] * w[j]
+        return acc
+
+
+class GroupedConv1D(nn.Module):
+    """Grouped conv1d with selectable TPU lowerings.
+
+    Param tree matches ``nn.Conv(features, (k,), feature_group_count=G)``
+    — ``kernel`` of shape (k, Cin/G, Cout), output feature o served by
+    group ``o // (Cout/G)`` — so checkpoints/converters are unaffected.
+
+    Lowerings (pick via ``impl`` or env SEIST_GCONV_IMPL; see
+    DepthwiseConv1D for the small-channel TPU context):
+
+    * ``grouped`` — XLA's native grouped conv.
+    * ``einsum``  — k shifted batched matmuls
+      ``y[n,l,g,e] = sum_j sum_d x[n, l*s+j, g, d] * w[j,d,g,e]``.
+    * ``dense``   — expand to a block-diagonal DENSE kernel and run one
+      ordinary conv: G× more FLOPs, but dense conv1d is the one shape XLA
+      maps well onto the MXU at these sizes (phasenet's 4.1% vs SeisT's
+      0.8% MFU, BASELINE.md) and the FLOPs are ~2% of peak anyway.
+    """
+
+    features: int
+    group_count: int
+    kernel_size: int
+    stride: int = 1
+    kernel_init: Any = trunc_normal_init
+    impl: Optional[str] = None  # None -> env SEIST_GCONV_IMPL or 'dense'
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        cin = x.shape[-1]
+        g = self.group_count
+        if cin % g or self.features % g:
+            raise ValueError(
+                f"channels {cin}->{self.features} not divisible by {g} groups"
+            )
+        ci, co = cin // g, self.features // g
+        kernel = self.param(
+            "kernel", self.kernel_init, (self.kernel_size, ci, self.features)
+        )
+        impl = self.impl or os.environ.get("SEIST_GCONV_IMPL", "dense")
+        if impl not in ("grouped", "einsum", "dense"):
+            raise ValueError(f"unknown grouped impl {impl!r}")
+        k, s = self.kernel_size, self.stride
+        kern = kernel.astype(x.dtype)
+        if impl == "grouped":
+            return jax.lax.conv_general_dilated(
+                x, kern,
+                window_strides=(s,),
+                padding="VALID",
+                dimension_numbers=("NWC", "WIO", "NWC"),
+                feature_group_count=g,
+            )
+        if impl == "einsum":
+            n, L = x.shape[0], x.shape[1]
+            out_len = (L - k) // s + 1
+            span = (out_len - 1) * s + 1
+            xg = x.reshape(n, L, g, ci)
+            # o = grp*co + og  =>  (k, ci, g, co) with g the major O axis.
+            wk = kern.reshape(k, ci, g, co)
+            acc = jnp.einsum(
+                "nlgd,dge->nlge", xg[:, 0:span:s], wk[0]
+            )
+            for j in range(1, k):
+                acc = acc + jnp.einsum(
+                    "nlgd,dge->nlge", xg[:, j : j + span : s], wk[j]
+                )
+            return acc.reshape(n, out_len, self.features)
+        # dense: scatter the grouped kernel into a block-diagonal (k, Cin,
+        # Cout) kernel; the masked positions are structural zeros, so
+        # gradients to them vanish and the param stays exactly grouped.
+        wg = kern.reshape(k, ci, g, co)
+        dense = jnp.zeros((k, cin, self.features), x.dtype)
+        for grp in range(g):
+            dense = dense.at[
+                :, grp * ci : (grp + 1) * ci, grp * co : (grp + 1) * co
+            ].set(wg[:, :, grp])
+        return jax.lax.conv_general_dilated(
+            x, dense,
+            window_strides=(s,),
+            padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+
+
 class DropPath(nn.Module):
     """Per-sample stochastic depth (timm DropPath parity, scale_by_keep)."""
 

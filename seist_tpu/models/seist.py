@@ -98,13 +98,13 @@ class DSConvNormAct(nn.Module):
     def __call__(self, x: Array, train: bool) -> Array:
         x = nn.Dense(self.in_dim, use_bias=False, name="in_proj", **_dense_kw)(x)
         x = common.auto_pad_1d(x, self.kernel_size, self.stride)
-        x = nn.Conv(
+        # Shift-FMA depthwise lowering (same dconv/kernel param tree as the
+        # grouped nn.Conv it replaces) — see common.DepthwiseConv1D for why
+        # XLA's grouped conv is pathological at these channel counts.
+        x = common.DepthwiseConv1D(
             self.in_dim,
-            (self.kernel_size,),
-            strides=(self.stride,),
-            padding="VALID",
-            feature_group_count=self.in_dim,
-            use_bias=False,
+            self.kernel_size,
+            stride=self.stride,
             name="dconv",
             **_conv_kw,
         )(x)
@@ -160,12 +160,12 @@ class GroupConvBlock(nn.Module):
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
         x1 = common.auto_pad_1d(x, self.kernel_size, 1)
-        x1 = nn.Conv(
+        # Selectable grouped-conv lowering (same conv/kernel param tree as
+        # grouped nn.Conv) — see common.GroupedConv1D.
+        x1 = common.GroupedConv1D(
             self.io_dim,
-            (self.kernel_size,),
-            padding="VALID",
-            feature_group_count=self.groups,
-            use_bias=False,
+            self.groups,
+            self.kernel_size,
             name="conv",
             **_conv_kw,
         )(x1)

@@ -159,3 +159,30 @@ class TestLoader:
         assert len(out) == 2
         assert isinstance(out[0].inputs, jax.Array)
         assert out[0].inputs.sharding.spec[0] == "data"
+
+
+class TestBenchBatchBuilder:
+    """bench.py builds its batches through this pipeline for ANY registered
+    model; guard the k-stacking and per-task label shapes it relies on."""
+
+    @pytest.mark.parametrize(
+        "name,tgt_shape",
+        [("seist_s_dpk", (2, 256, 3)), ("seist_m_pmp", (2, 2)),
+         ("seist_l_emg", (2, 1))],
+    )
+    def test_shapes(self, name, tgt_shape):
+        import bench
+
+        spec = taskspec.get_task_spec(name)
+        x, y = bench._synthetic_batch(spec, batch=2, in_samples=256)
+        assert x.shape == (2, 256, 3)
+        assert y.shape == tgt_shape
+
+    def test_k_stacking_distinct(self):
+        import bench
+
+        spec = taskspec.get_task_spec("seist_s_dpk")
+        x, y = bench._synthetic_batch(spec, batch=2, in_samples=256, k=3)
+        assert x.shape == (3, 2, 256, 3) and y.shape == (3, 2, 256, 3)
+        # k micro-batches must be distinct events, not copies.
+        assert not np.allclose(np.asarray(x[0]), np.asarray(x[1]))

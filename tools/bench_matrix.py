@@ -32,7 +32,8 @@ CONFIGS = [
     ("magnet", 256),
     ("ditingmotion", 256),
     ("baz_network", 256),
-    ("distpt_network", 256),
+    # distpt_network: registered but no task spec, matching the reference's
+    # commented-out config (ref config.py:112-125) — nothing to train.
     ("seist_m_pmp", 256),
     ("seist_l_emg", 256),
     ("seist_l_baz", 256),
@@ -85,9 +86,13 @@ def main() -> None:
             except json.JSONDecodeError:
                 payload = {"error": f"unparseable: {line[:200]}"}
         # Keep-last-good: a failed re-run must not clobber a prior
-        # measurement (mirrors bench.py's own cache policy).
+        # measurement (mirrors bench.py's own cache policy) — but mark the
+        # kept entry stale so the table can't pass it off as fresh.
         if payload.get("value") or model not in results:
             results[model] = payload
+        else:
+            results[model]["stale"] = True
+            results[model]["stale_error"] = payload.get("error", "")
         with open(args.out, "w") as f:  # persist incrementally
             json.dump(results, f, indent=1)
         print(json.dumps(payload), flush=True)
@@ -100,7 +105,8 @@ def main() -> None:
             continue
         # A cached replay carries both a value and error/cached markers
         # (bench.py _fail) — print it, flagged, rather than dropping it.
-        note = "cached (stale)" if p.get("cached") else ""
+        # Same for entries kept by keep-last-good after a failed re-run.
+        note = "cached (stale)" if (p.get("cached") or p.get("stale")) else ""
         print(
             f"| {model} | {p.get('batch')} | {p.get('value'):,.0f} | "
             f"{p.get('step_time_ms')} | {p.get('mfu', 0) * 100:.1f}% | "

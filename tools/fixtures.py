@@ -25,21 +25,18 @@ import h5py
 import numpy as np
 import pandas as pd
 
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from seist_tpu.data.synthetic import make_wavelet as _wavelet  # noqa: E402
+
 _SNR_COLS = [
     f"{c}_{ph}_{kind}_snr"
     for c in "ZNE"
     for ph in "PS"
     for kind in ("amplitude", "power")
 ]
-
-
-def _wavelet(rng: np.random.Generator, length: int, freq: float, fs: int):
-    t = np.arange(length) / fs
-    envelope = t * np.exp(-3.0 * t)
-    carrier = np.sin(2 * np.pi * freq * t + rng.uniform(0, 2 * np.pi))
-    return (envelope * carrier / (np.abs(envelope).max() + 1e-9)).astype(
-        np.float32
-    )
 
 
 def write_diting_light_fixture(

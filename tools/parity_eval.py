@@ -160,8 +160,30 @@ def main() -> None:
         ours = json.load(f)
 
     # --- compare ---
-    if "ev_ids" in ref:
-        print(f"ref test split: {len(ref['ev_ids'])} events")
+    # Split identity check: metrics are only comparable if both frameworks
+    # put the SAME events in the test split (both use pandas
+    # sample(frac=1, random_state=seed) + contiguous ranges — ref
+    # diting.py:281-299).
+    import seist_tpu.data  # noqa: F401  (dataset registration; CPU-only path)
+    from seist_tpu.registry import DATASETS
+
+    ours_ds = DATASETS.create(
+        "diting_light",
+        seed=args.seed,
+        mode="test",
+        data_dir=fixture,
+        shuffle=True,
+        data_split=True,
+        train_size=args.train_size,
+        val_size=args.val_size,
+    )
+    our_ev_ids = [int(v) for v in ours_ds._meta_data["ev_id"]]
+    if our_ev_ids != ref["ev_ids"]:
+        raise RuntimeError(
+            f"test splits differ: ref {len(ref['ev_ids'])} events, "
+            f"ours {len(our_ev_ids)} — metric comparison would be invalid"
+        )
+    print(f"test split identical on both sides: {len(our_ev_ids)} events")
     rows, max_abs = [], 0.0
     for task, ref_m in sorted(ref["metrics"].items()):
         our_m = ours["metrics"].get(task, {})
