@@ -58,6 +58,14 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "matmuls/activations in bfloat16 on the MXU with "
                         "fp32 params/optimizer/BN-stats/softmax/loss "
                         "(default: fp32)")
+    parser.add_argument("--steps-per-call", default=1, type=int,
+                        dest="steps_per_call",
+                        help="scan this many optimizer updates inside one "
+                        "jitted call (distinct micro-batches, NOT gradient "
+                        "accumulation) — amortizes per-dispatch latency on "
+                        "remote/contended devices. Per-step train metrics "
+                        "are skipped (loss only); trailing batches that "
+                        "don't fill a call are dropped. Default 1")
 
     # Random seed
     parser.add_argument("--seed", default=0, type=int)

@@ -290,6 +290,36 @@ class Loader:
             yield Batch(inputs, loss_targets, metrics_targets, meta, mask)
 
 
+
+
+def _double_buffer(iterator, transform, prefetch: int):
+    """Producer-thread double buffering: apply ``transform`` (typically a
+    sharded device_put) to each item ahead of the consumer, propagating
+    producer exceptions. Shared by the prefetch_* variants."""
+    buf: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    sentinel = object()
+    err: List[BaseException] = []
+
+    def producer():
+        try:
+            for item in iterator:
+                buf.put(transform(item))
+        except BaseException as e:  # propagate loader errors to the consumer
+            err.append(e)
+        finally:
+            buf.put(sentinel)
+
+    thread = threading.Thread(target=producer, daemon=True)
+    thread.start()
+    while True:
+        item = buf.get()
+        if item is sentinel:
+            if err:
+                raise err[0]
+            return
+        yield item
+
+
 def prefetch_to_device(
     iterator: Iterator[Batch],
     mesh=None,
@@ -323,25 +353,47 @@ def prefetch_to_device(
             _put(batch.mask),
         )
 
-    buf: "queue.Queue" = queue.Queue(maxsize=prefetch)
-    sentinel = object()
-    err: List[BaseException] = []
+    yield from _double_buffer(iterator, put, prefetch)
 
-    def producer():
-        try:
-            for item in iterator:
-                buf.put(put(item))
-        except BaseException as e:  # propagate loader errors to the consumer
-            err.append(e)
-        finally:
-            buf.put(sentinel)
 
-    thread = threading.Thread(target=producer, daemon=True)
-    thread.start()
-    while True:
-        item = buf.get()
-        if item is sentinel:
-            if err:
-                raise err[0]
-            return
-        yield item
+def prefetch_packed_to_device(
+    iterator: Iterator[Batch],
+    mesh,
+    steps_per_call: int,
+    prefetch: int = 2,
+) -> Iterator[Tuple[Any, Any]]:
+    """Group ``steps_per_call`` train batches into one stacked
+    ``(inputs_k, targets_k)`` pair — leading axis = micro-step, second =
+    batch — double-buffered to device with the batch axis sharded on
+    ``data`` (``shard_stacked_batch``). Feeds ``make_multi_train_step``.
+
+    A trailing group smaller than ``steps_per_call`` is DROPPED (same
+    spirit as the train loader's drop-last; jit shapes must stay static).
+    Only inputs/loss_targets survive packing: the multi-step path returns
+    no per-micro-step outputs, so metrics targets/meta have no consumer.
+    """
+    import jax
+
+    from seist_tpu.parallel.mesh import shard_stacked_batch
+
+    def packed():
+        group: List[Batch] = []
+        for b in iterator:
+            group.append(b)
+            if len(group) == steps_per_call:
+                inputs = jax.tree.map(
+                    lambda *xs: np.stack(xs), *[g.inputs for g in group]
+                )
+                targets = jax.tree.map(
+                    lambda *xs: np.stack(xs), *[g.loss_targets for g in group]
+                )
+                yield inputs, targets
+                group = []
+
+    if mesh is None:
+        yield from packed()
+        return
+
+    yield from _double_buffer(
+        packed(), lambda item: shard_stacked_batch(mesh, item), prefetch
+    )

@@ -228,14 +228,17 @@ class DepthwiseConv1D(nn.Module):
     kernel_size: int
     stride: int = 1
     kernel_init: Any = trunc_normal_init
-    impl: Optional[str] = None  # None -> env SEIST_DWCONV_IMPL or 'shift'
+    # None -> env SEIST_DWCONV_IMPL, else 'shift' on TPU / 'grouped' off-TPU
+    impl: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
         kernel = self.param(
             "kernel", self.kernel_init, (self.kernel_size, 1, self.features)
         )
-        impl = self.impl or os.environ.get("SEIST_DWCONV_IMPL", "shift")
+        impl = self.impl or os.environ.get("SEIST_DWCONV_IMPL") or (
+            "shift" if jax.default_backend() == "tpu" else "grouped"
+        )
         if impl not in ("shift", "grouped"):
             raise ValueError(f"unknown depthwise impl {impl!r}")
         if impl == "grouped":
@@ -281,7 +284,8 @@ class GroupedConv1D(nn.Module):
     kernel_size: int
     stride: int = 1
     kernel_init: Any = trunc_normal_init
-    impl: Optional[str] = None  # None -> env SEIST_GCONV_IMPL or 'dense'
+    # None -> env SEIST_GCONV_IMPL, else 'dense' on TPU / 'grouped' off-TPU
+    impl: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
@@ -295,7 +299,9 @@ class GroupedConv1D(nn.Module):
         kernel = self.param(
             "kernel", self.kernel_init, (self.kernel_size, ci, self.features)
         )
-        impl = self.impl or os.environ.get("SEIST_GCONV_IMPL", "dense")
+        impl = self.impl or os.environ.get("SEIST_GCONV_IMPL") or (
+            "dense" if jax.default_backend() == "tpu" else "grouped"
+        )
         if impl not in ("grouped", "einsum", "dense"):
             raise ValueError(f"unknown grouped impl {impl!r}")
         k, s = self.kernel_size, self.stride

@@ -20,4 +20,5 @@ from seist_tpu.parallel.mesh import (  # noqa: F401
     replicate,
     replicated,
     shard_batch,
+    shard_stacked_batch,
 )

@@ -108,18 +108,28 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(mesh: Mesh, batch: Any) -> Any:
+def shard_batch(mesh: Mesh, batch: Any, spec: Optional[P] = None) -> Any:
     """Place a host batch pytree with the leading axis sharded on ``data``
     (the `DistributedSampler`-equivalent placement). Single-process: a plain
     sharded device_put of the full batch. Multi-host: each host passes its
-    *local* shard and the global array is assembled without gathering."""
-    sharding = batch_sharding(mesh)
+    *local* shard and the global array is assembled without gathering.
+    ``spec`` overrides the partitioning (default ``P('data', ...)``)."""
+    sharding = (
+        NamedSharding(mesh, spec) if spec is not None else batch_sharding(mesh)
+    )
     if jax.process_count() > 1:
         return jax.tree_util.tree_map(
             lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
             batch,
         )
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def shard_stacked_batch(mesh: Mesh, batch: Any) -> Any:
+    """:func:`shard_batch` for k-stacked micro-batches ``(k, B, ...)``:
+    axis 0 is the micro-step axis (replicated), axis 1 is the batch axis
+    (sharded on ``data``). Used by the --steps-per-call train path."""
+    return shard_batch(mesh, batch, spec=P(None, AXIS_DATA))
 
 
 def to_local(x: Any) -> np.ndarray:

@@ -15,6 +15,7 @@ from seist_tpu.train.state import TrainState, create_train_state  # noqa: F401
 from seist_tpu.train.step import (  # noqa: F401
     fold_rngs,
     jit_eval_step,
+    jit_multi_step,
     jit_step,
     make_eval_step,
     make_multi_train_step,

@@ -202,6 +202,26 @@ def jit_step(
     return jax.jit(step_fn, in_shardings=in_shardings, donate_argnums=donate)
 
 
+def jit_multi_step(
+    step_fn: Callable, mesh: Optional[Mesh] = None, donate_state: bool = True
+) -> Callable:
+    """Jit a :func:`make_multi_train_step` function under a mesh.
+
+    The stacked batches carry the micro-step axis FIRST and the batch axis
+    SECOND, so the data sharding is ``P(None, 'data')`` — :func:`jit_step`
+    would wrongly shard the micro-step axis (see make_multi_train_step's
+    sharding caveat).
+    """
+    donate = (0,) if donate_state else ()
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=donate)
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(None, "data"))
+    return jax.jit(
+        step_fn, in_shardings=(repl, data, data, repl), donate_argnums=donate
+    )
+
+
 def jit_eval_step(step_fn: Callable, mesh: Optional[Mesh] = None) -> Callable:
     """Jit an eval step ``(state, inputs, targets, mask) -> (loss, outputs)``.
 

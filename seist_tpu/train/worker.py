@@ -32,9 +32,11 @@ from seist_tpu.train import (
     build_optimizer,
     create_train_state,
     jit_eval_step,
+    jit_multi_step,
     jit_step,
     load_checkpoint,
     make_eval_step,
+    make_multi_train_step,
     make_train_step,
     restore_into_state,
     save_checkpoint,
@@ -315,9 +317,34 @@ def train_worker(args: Any) -> str:
         )
 
     dtype = getattr(args, "dtype", "fp32")
-    train_step = jit_step(
-        make_train_step(spec, loss_fn, compute_dtype=dtype), mesh
-    )
+    spc = max(1, int(getattr(args, "steps_per_call", 1) or 1))
+    if spc > 1:
+        # k updates scanned inside one jitted program (dispatch
+        # amortization; step.py make_multi_train_step). Per-step output
+        # metrics are skipped on this path — the scan returns no
+        # per-micro-step outputs.
+        if steps_per_epoch // spc == 0:
+            raise ValueError(
+                f"--steps-per-call {spc} exceeds steps_per_epoch "
+                f"{steps_per_epoch}: every epoch would train ZERO steps "
+                f"(trailing part-groups are dropped)"
+            )
+        if steps_per_epoch % spc:
+            logger.warning(
+                f"steps_per_call={spc} drops {steps_per_epoch % spc} "
+                f"trailing batch(es) per epoch ({steps_per_epoch} steps)"
+            )
+        train_step = jit_multi_step(
+            make_multi_train_step(
+                spec, loss_fn, compute_dtype=dtype, steps_per_call=spc
+            ),
+            mesh,
+        )
+        logger.info(f"steps_per_call={spc}: scanned multi-step training")
+    else:
+        train_step = jit_step(
+            make_train_step(spec, loss_fn, compute_dtype=dtype), mesh
+        )
     eval_step = jit_eval_step(
         make_eval_step(spec, loss_fn, compute_dtype=dtype), mesh
     )
@@ -357,47 +384,80 @@ def train_worker(args: Any) -> str:
         # only diagnostics — TB scalars and the progress line). Per-step
         # losses are kept as device scalars and fetched once per epoch.
         deferred_losses: List[Any] = []
-        for step, batch in enumerate(
-            pipeline.prefetch_to_device(iter(train_loader), mesh)
-        ):
-            state, loss, outputs = train_step(
-                state, batch.inputs, batch.loss_targets, epoch_rng
-            )
-            deferred_losses.append(loss)
-            gstep = epoch * steps_per_epoch + step
-            global_bs = args.batch_size * jax.process_count()
+        global_bs = args.batch_size * jax.process_count()
 
-            if step % args.log_step == 0:
-                loss_f = float(loss)
-                loss_meter.update(loss_f, 1)
-                now = time.time()
-                steps_done = min(args.log_step, step) or 1
-                wps_meter.update(
-                    global_bs * steps_done / max(now - t_step, 1e-9)
+        if spc > 1:
+            # Packed multi-step path: one jitted call = spc updates; the
+            # per-call loss is already the mean over its micro-steps.
+            for call, (xk, yk) in enumerate(
+                pipeline.prefetch_packed_to_device(
+                    iter(train_loader), mesh, spc
                 )
-                t_step = now
-
-                results = _postprocess_batch(args, spec, outputs, fs)
-                batch_metrics = _make_metrics(args, tasks, fs)
-                _update_task_metrics(
-                    metrics_merged,
-                    batch_metrics,
-                    results,
-                    batch.metrics_targets,
-                    args.batch_size,
-                )
-                if writer is not None:
-                    writer.add_scalar("train-loss/step", loss_f, gstep)
-                    for task, m in batch_metrics.items():
-                        writer.add_scalars(
-                            f"train.{task}.metrics/step",
-                            m.get_all_metrics(),
-                            gstep,
-                        )
-                if is_main_process():
-                    logger.info(
-                        f"{args.model_name}_train {progress.get_str(step)}"
+            ):
+                state, loss, _ = train_step(state, xk, yk, epoch_rng)
+                deferred_losses.append(loss)
+                if call % args.log_step == 0:
+                    loss_f = float(loss)
+                    loss_meter.update(loss_f, 1)
+                    now = time.time()
+                    calls_done = min(args.log_step, call) or 1
+                    wps_meter.update(
+                        global_bs * spc * calls_done / max(now - t_step, 1e-9)
                     )
+                    t_step = now
+                    if writer is not None:
+                        writer.add_scalar(
+                            "train-loss/step",
+                            loss_f,
+                            epoch * steps_per_epoch + call * spc,
+                        )
+                    if is_main_process():
+                        logger.info(
+                            f"{args.model_name}_train "
+                            f"{progress.get_str(call * spc)}"
+                        )
+
+        else:
+            for step, batch in enumerate(
+                pipeline.prefetch_to_device(iter(train_loader), mesh)
+            ):
+                state, loss, outputs = train_step(
+                    state, batch.inputs, batch.loss_targets, epoch_rng
+                )
+                deferred_losses.append(loss)
+                gstep = epoch * steps_per_epoch + step
+
+                if step % args.log_step == 0:
+                    loss_f = float(loss)
+                    loss_meter.update(loss_f, 1)
+                    now = time.time()
+                    steps_done = min(args.log_step, step) or 1
+                    wps_meter.update(
+                        global_bs * steps_done / max(now - t_step, 1e-9)
+                    )
+                    t_step = now
+
+                    results = _postprocess_batch(args, spec, outputs, fs)
+                    batch_metrics = _make_metrics(args, tasks, fs)
+                    _update_task_metrics(
+                        metrics_merged,
+                        batch_metrics,
+                        results,
+                        batch.metrics_targets,
+                        args.batch_size,
+                    )
+                    if writer is not None:
+                        writer.add_scalar("train-loss/step", loss_f, gstep)
+                        for task, m in batch_metrics.items():
+                            writer.add_scalars(
+                                f"train.{task}.metrics/step",
+                                m.get_all_metrics(),
+                                gstep,
+                            )
+                    if is_main_process():
+                        logger.info(
+                            f"{args.model_name}_train {progress.get_str(step)}"
+                        )
 
         epoch_losses = [float(l) for l in jax.device_get(deferred_losses)]
         train_losses.extend(epoch_losses)

@@ -17,6 +17,7 @@ from seist_tpu.train import (
     build_optimizer,
     create_train_state,
     cyclic_lr,
+    jit_multi_step,
     jit_step,
     load_checkpoint,
     make_eval_step,
@@ -303,6 +304,40 @@ def test_dp_sharded_step_matches_single_device(rng):
     xb, yb = shard_batch(mesh, (x, y))
     sharded = jit_step(make_train_step(spec, loss_fn), mesh=mesh, donate_state=False)
     s2, loss2, _ = sharded(state_r, xb, yb, key)
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_multi_step_sharded_matches_single_device(rng):
+    """jit_multi_step shards the BATCH axis (axis 1), not the micro-step
+    axis: a dp-sharded 2-step call must equal the single-device one."""
+    assert jax.device_count() >= 8
+    model = api.create_model("phasenet", in_samples=L)
+    variables = api.init_variables(model, in_samples=L, batch_size=8)
+    state = create_train_state(model, variables, build_optimizer("sgd", 1e-2))
+    spec = taskspec.get_task_spec("phasenet")
+    loss_fn = taskspec.make_loss("phasenet")
+    batches = [_fake_dpk_batch(rng, batch=8) for _ in range(2)]
+    xs = jnp.stack([b[0] for b in batches])
+    ys = jnp.stack([b[1] for b in batches])
+    key = jax.random.PRNGKey(0)
+    multi = make_multi_train_step(spec, loss_fn, steps_per_call=2)
+
+    s1, loss1, _ = jit_multi_step(multi, donate_state=False)(state, xs, ys, key)
+
+    mesh = make_mesh(data=8)
+    state_r = replicate(mesh, state)
+    from seist_tpu.parallel import shard_stacked_batch
+
+    xb, yb = shard_stacked_batch(mesh, (xs, ys))
+    assert xb.sharding.spec == (None, "data")
+    s2, loss2, _ = jit_multi_step(multi, mesh=mesh, donate_state=False)(
+        state_r, xb, yb, key
+    )
 
     np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
     for a, b in zip(

@@ -25,12 +25,6 @@ import h5py
 import numpy as np
 import pandas as pd
 
-import sys
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from seist_tpu.data.synthetic import make_wavelet as _wavelet  # noqa: E402
-
 _SNR_COLS = [
     f"{c}_{ph}_{kind}_snr"
     for c in "ZNE"
@@ -49,6 +43,15 @@ def write_diting_light_fixture(
     n_parts: int = 2,
 ) -> str:
     """Write the fixture dataset under ``root``; returns ``root``."""
+    # Lazy: pulls the shared wavelet recipe from the framework without
+    # making this numpy/h5py/pandas-only writer depend on jax at import.
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from seist_tpu.data.synthetic import make_wavelet as _wavelet
+
     os.makedirs(root, exist_ok=True)
     rng = np.random.default_rng(seed)
     rows = []
