@@ -604,6 +604,12 @@ class DataPreprocessor:
         """Build one io-item; groups stack channels-last to ``(L, C)``
         (the reference stacks channels-first, preprocess.py:714-717)."""
         if isinstance(name, (tuple, list)):
+            # Fast path for the dominant case (waveform group == dataset
+            # channel order, e.g. ("z","n","e")): a transpose VIEW of the
+            # already-processed (C, L) array — the copy happens once at
+            # batch assembly (_stack) instead of per sample here.
+            if tuple(name) == tuple(self.data_channels):
+                return event["data"].T.astype(self.dtype, copy=False)
             children = [self.get_io_item(sub, event) for sub in name]
             return np.stack(children, axis=-1)
 

@@ -186,3 +186,30 @@ class TestBenchBatchBuilder:
         assert x.shape == (3, 2, 256, 3) and y.shape == (3, 2, 256, 3)
         # k micro-batches must be distinct events, not copies.
         assert not np.allclose(np.asarray(x[0]), np.asarray(x[1]))
+
+
+class TestPackedPrefetch:
+    def test_groups_and_drops_tail(self):
+        sds = make_sds(n=24)  # train split: int(0.8*24) = 19 samples
+        loader = pipeline.Loader(sds, batch_size=4, drop_last=True)
+        assert len(loader) == 4  # 19 // 4
+        packed = list(
+            pipeline.prefetch_packed_to_device(iter(loader), None, 3)
+        )
+        # 4 batches // 3 per call = 1 full group; trailing 1 batch dropped.
+        assert len(packed) == 1
+        xk, yk = packed[0]
+        assert xk.shape[0] == 3 and xk.shape[1] == 4
+
+    def test_sharded_placement(self):
+        import jax
+        from seist_tpu.parallel.mesh import make_mesh
+
+        sds = make_sds(n=24)  # train split 19 -> 2 full batches of 8
+        loader = pipeline.Loader(sds, batch_size=8, drop_last=True)
+        mesh = make_mesh(data=8)
+        xk, yk = next(
+            pipeline.prefetch_packed_to_device(iter(loader), mesh, 2)
+        )
+        assert isinstance(xk, jax.Array)
+        assert xk.sharding.spec[:2] == (None, "data")

@@ -1,0 +1,91 @@
+"""tools/supervise.py: relaunch-on-failure with checkpoint resume.
+
+The reference has no automatic failure recovery (SURVEY.md §5 — resume is
+a manual relaunch with --checkpoint, ref train.py:255-264); these tests
+pin the wrapper's contract using a stub trainer that crashes until it is
+handed a checkpoint.
+"""
+
+import os
+import sys
+import textwrap
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+from supervise import find_newest_checkpoint, main, with_checkpoint  # noqa: E402
+
+
+def _make_ckpt(base, run, name, t):
+    d = os.path.join(base, run, "checkpoints", name)
+    os.makedirs(d)
+    os.utime(d, (t, t))
+    return d
+
+
+class TestHelpers:
+    def test_find_newest(self, tmp_path):
+        base = str(tmp_path)
+        _make_ckpt(base, "run_a", "model-3", 100)
+        newest = _make_ckpt(base, "run_b", "model-1", 200)
+        assert find_newest_checkpoint(base) == newest
+
+    def test_find_none(self, tmp_path):
+        assert find_newest_checkpoint(str(tmp_path)) is None
+
+    def test_skips_orbax_inprogress_tmp_dirs(self, tmp_path):
+        """A crash mid-save leaves model-N.orbax-checkpoint-tmp-<ts> with
+        the newest mtime; resume must pick the last COMMITTED one."""
+        base = str(tmp_path)
+        committed = _make_ckpt(base, "run", "model-6", 100)
+        _make_ckpt(base, "run", "model-7.orbax-checkpoint-tmp-123", 200)
+        assert find_newest_checkpoint(base) == committed
+
+    def test_with_checkpoint_appends_and_replaces(self):
+        cmd = ["python", "main.py", "--mode", "train"]
+        out = with_checkpoint(cmd, "/c1")
+        assert out[-2:] == ["--checkpoint", "/c1"]
+        assert with_checkpoint(out, "/c2")[-2:] == ["--checkpoint", "/c2"]
+
+    def test_equals_form(self, tmp_path):
+        from supervise import _arg_value
+
+        cmd = ["python", "main.py", "--log-base=logs/r1", "--checkpoint=/old"]
+        assert _arg_value(cmd, "--log-base") == "logs/r1"
+        assert with_checkpoint(cmd, "/new")[-1] == "--checkpoint=/new"
+
+
+class TestEndToEnd:
+    def _stub(self, tmp_path):
+        """Trainer that crashes unless given --checkpoint; writes a ckpt dir
+        on its first (failing) run, like a real run that died mid-epoch."""
+        log_base = tmp_path / "logs"
+        script = tmp_path / "trainer.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys
+            log_base = {str(log_base)!r}
+            if "--checkpoint" in sys.argv:
+                sys.exit(0)
+            os.makedirs(os.path.join(log_base, "run", "checkpoints", "model-0"),
+                        exist_ok=True)
+            sys.exit(1)
+        """))
+        return script, log_base
+
+    def test_resumes_from_checkpoint_and_succeeds(self, tmp_path):
+        script, log_base = self._stub(tmp_path)
+        rc = main([
+            "--retries", "2", "--backoff", "0", "--",
+            sys.executable, str(script), "--log-base", str(log_base),
+        ])
+        assert rc == 0
+
+    def test_gives_up_after_retries(self, tmp_path):
+        script = tmp_path / "always_fail.py"
+        script.write_text("import sys; sys.exit(7)\n")
+        rc = main([
+            "--retries", "1", "--backoff", "0", "--",
+            sys.executable, str(script),
+        ])
+        assert rc == 7

@@ -1,0 +1,118 @@
+"""Supervised training: relaunch on failure, resuming from the newest
+checkpoint.
+
+The reference has no failure-recovery mechanism at all — a crashed run is
+relaunched by hand with `--checkpoint` (SURVEY.md §5; ref train.py:255-264
+is the resume path, nothing invokes it automatically). This wrapper closes
+that gap for long unattended runs:
+
+    python tools/supervise.py --retries 3 --backoff 30 -- \
+        python main.py --mode train --model-name seist_l_dpk \
+        --dataset-name diting --data /path --log-base logs/run1
+
+On a nonzero exit it scans the run's `--log-base` tree for the newest
+`checkpoints/model-*` directory (orbax layout, train/checkpoint.py) and
+relaunches the SAME command with `--checkpoint <newest>` (replacing any
+prior value), up to `--retries` times with `--backoff` seconds between
+attempts. A run that produced no checkpoint yet is relaunched from
+scratch. Exit code is the final attempt's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def find_newest_checkpoint(log_base: str) -> Optional[str]:
+    """Newest `*/checkpoints/model-*` dir under ``log_base`` by mtime."""
+    newest, newest_t = None, -1.0
+    for dirpath, dirnames, _ in os.walk(log_base):
+        if os.path.basename(dirpath) != "checkpoints":
+            continue
+        for d in dirnames:
+            # Skip orbax in-progress dirs (e.g. model-7.orbax-checkpoint-
+            # tmp-<ts>): a crash mid-save leaves one with the newest mtime,
+            # and resuming from it would fail on every retry.
+            if not d.startswith("model-") or "tmp" in d:
+                continue
+            p = os.path.join(dirpath, d)
+            t = os.path.getmtime(p)
+            if t > newest_t:
+                newest, newest_t = p, t
+    return newest
+
+
+def _arg_value(cmd: List[str], flag: str) -> Optional[str]:
+    """Value of ``flag`` in ``cmd`` — both ``--flag v`` and ``--flag=v``."""
+    for i, tok in enumerate(cmd):
+        if tok == flag:
+            return cmd[i + 1] if i + 1 < len(cmd) else None
+        if tok.startswith(flag + "="):
+            return tok[len(flag) + 1:]
+    return None
+
+
+def with_checkpoint(cmd: List[str], ckpt: str) -> List[str]:
+    """Return ``cmd`` with ``--checkpoint ckpt`` set (replacing any prior,
+    in either ``--checkpoint v`` or ``--checkpoint=v`` form)."""
+    cmd = list(cmd)
+    for i, tok in enumerate(cmd):
+        if tok == "--checkpoint":
+            if i + 1 < len(cmd):
+                cmd[i + 1] = ckpt
+                return cmd
+            return cmd[:i] + ["--checkpoint", ckpt]
+        if tok.startswith("--checkpoint="):
+            cmd[i] = f"--checkpoint={ckpt}"
+            return cmd
+    return cmd + ["--checkpoint", ckpt]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="relaunch-on-failure wrapper with checkpoint resume",
+        usage="supervise.py [--retries N] [--backoff S] -- <command...>",
+    )
+    ap.add_argument("--retries", type=int, default=3,
+                    help="max relaunches after the first attempt (default 3)")
+    ap.add_argument("--backoff", type=float, default=30.0,
+                    help="seconds to wait before each relaunch (default 30)")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="the training command, after `--`")
+    args = ap.parse_args(argv)
+
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given (use: supervise.py [opts] -- python main.py ...)")
+
+    log_base = _arg_value(cmd, "--log-base") or "./logs"
+
+    rc = 0
+    for attempt in range(args.retries + 1):
+        if attempt:
+            ckpt = find_newest_checkpoint(log_base)
+            if ckpt:
+                cmd = with_checkpoint(cmd, ckpt)
+                print(f"[supervise] resuming from {ckpt}", file=sys.stderr)
+            else:
+                print("[supervise] no checkpoint yet; restarting fresh",
+                      file=sys.stderr)
+            time.sleep(args.backoff)
+        print(f"[supervise] attempt {attempt + 1}/{args.retries + 1}: "
+              f"{' '.join(cmd)}", file=sys.stderr, flush=True)
+        rc = subprocess.call(cmd)
+        if rc == 0:
+            return 0
+        print(f"[supervise] exited rc={rc}", file=sys.stderr, flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
