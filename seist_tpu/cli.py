@@ -58,6 +58,12 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "matmuls/activations in bfloat16 on the MXU with "
                         "fp32 params/optimizer/BN-stats/softmax/loss "
                         "(default: fp32)")
+    parser.add_argument("--profile-steps", default=0, type=int,
+                        dest="profile_steps",
+                        help="capture a jax.profiler trace of this many "
+                        "steady-state train steps (first epoch, after "
+                        "warmup) into <logdir>/profile; view with "
+                        "TensorBoard's profile plugin. Default 0 = off")
     parser.add_argument("--steps-per-call", default=1, type=int,
                         dest="steps_per_call",
                         help="scan this many optimizer updates inside one "

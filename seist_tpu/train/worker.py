@@ -41,6 +41,7 @@ from seist_tpu.train import (
     restore_into_state,
     save_checkpoint,
 )
+from seist_tpu.utils import profiling
 from seist_tpu.utils.logger import logger
 from seist_tpu.utils.meters import AverageMeter, ProgressMeter
 from seist_tpu.utils.misc import count_params, get_safe_path, strftimedelta
@@ -365,6 +366,31 @@ def train_worker(args: Any) -> str:
     val_losses: List[float] = []
     epoch_times: List[float] = []
 
+    # --profile-steps N: capture a jax.profiler trace of N steady-state
+    # OPTIMIZER steps (skipping compile/warmup) in the first trained epoch.
+    # Counted in optimizer steps regardless of --steps-per-call (each loop
+    # iteration advances `spc` of them).
+    profile_steps = int(getattr(args, "profile_steps", 0) or 0)
+    profile_from = 2 * spc  # skip the first two loop iterations
+    tracing = False
+
+    def _maybe_trace(opt_step: int, loss) -> None:
+        """``opt_step``: optimizer steps completed before this iteration."""
+        nonlocal tracing, profile_steps
+        if not (profile_steps and is_main_process()):
+            return
+        if not tracing and opt_step >= profile_from:
+            profiling.trace_start(os.path.join(logger.logdir(), "profile"))
+            tracing = True
+        elif tracing and opt_step >= profile_from + profile_steps:
+            jax.block_until_ready(loss)
+            profiling.trace_stop()
+            tracing = False
+            profile_steps = 0  # first epoch only
+            logger.info(
+                f"Profiler trace saved: {os.path.join(logger.logdir(), 'profile')}"
+            )
+
     for epoch in range(start_epoch, epochs):
         t0 = time.time()
         train_loader.set_epoch(epoch)
@@ -396,6 +422,7 @@ def train_worker(args: Any) -> str:
             ):
                 state, loss, _ = train_step(state, xk, yk, epoch_rng)
                 deferred_losses.append(loss)
+                _maybe_trace(call * spc, loss)
                 if call % args.log_step == 0:
                     loss_f = float(loss)
                     loss_meter.update(loss_f, 1)
@@ -425,6 +452,7 @@ def train_worker(args: Any) -> str:
                     state, batch.inputs, batch.loss_targets, epoch_rng
                 )
                 deferred_losses.append(loss)
+                _maybe_trace(step, loss)
                 gstep = epoch * steps_per_epoch + step
 
                 if step % args.log_step == 0:
@@ -458,6 +486,15 @@ def train_worker(args: Any) -> str:
                         logger.info(
                             f"{args.model_name}_train {progress.get_str(step)}"
                         )
+
+        if tracing:  # epoch shorter than the capture window
+            # Sync first: steps may still be executing asynchronously, and
+            # stopping early would truncate their device activity.
+            jax.block_until_ready(deferred_losses)
+            profiling.trace_stop()
+            tracing = False
+            profile_steps = 0
+            logger.info("Profiler trace saved (short epoch)")
 
         epoch_losses = [float(l) for l in jax.device_get(deferred_losses)]
         train_losses.extend(epoch_losses)

@@ -15,17 +15,30 @@ import time
 from typing import Dict, Iterator, List, Optional
 
 
+def trace_start(logdir: str) -> None:
+    """Begin a jax.profiler trace (pair with :func:`trace_stop`) — the
+    non-contextmanager form for capture windows that span loop iterations
+    (the worker's --profile-steps path)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def trace_stop() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
 @contextlib.contextmanager
 def trace(logdir: str) -> Iterator[None]:
     """``with trace(dir):`` profiles everything inside; view with
     TensorBoard's profile plugin or Perfetto."""
-    import jax
-
-    jax.profiler.start_trace(logdir)
+    trace_start(logdir)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        trace_stop()
 
 
 def device_memory_stats() -> List[Dict[str, float]]:

@@ -365,3 +365,39 @@ def test_gradient_and_bn_parity_train_mode(torch_models):
         _compare_grad_trees(our_grads, t_grads, expect_zero=bn_cancelled_bias)
         > 10
     )
+
+
+@pytest.mark.slow
+def test_pretrained_forward_parity_tpu_lowerings(torch_models, monkeypatch):
+    """Golden parity THROUGH the TPU-default conv lowerings (shift-FMA
+    depthwise + block-diagonal-dense grouped; models/common.py). Off-TPU
+    the defaults fall back to native grouped convs, so without forcing the
+    env this path would only ever be exercised on real hardware."""
+    import torch
+
+    from parity import convert_state_dict
+
+    monkeypatch.setenv("SEIST_DWCONV_IMPL", "shift")
+    monkeypatch.setenv("SEIST_GCONV_IMPL", "dense")
+
+    ckpt = "seist_s_dpk_diting"
+    model_name = "seist_s_dpk"
+    sd = torch.load(
+        os.path.join(PRETRAINED, f"{ckpt}.pth"),
+        map_location="cpu",
+        weights_only=True,
+    )
+    model = api.create_model(model_name, in_samples=8192)
+    shapes = api.param_shapes(model, in_samples=8192)
+    variables = convert_state_dict(sd, shapes)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 8192, 3)).astype(np.float32)
+    ours = np.asarray(model.apply(variables, x, train=False))
+
+    tm = torch_models(model_name, in_channels=3, in_samples=8192)
+    tm.load_state_dict(sd)
+    tm.eval()
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 2, 1))).numpy()
+    np.testing.assert_allclose(ours, ref.transpose(0, 2, 1), atol=1e-4, rtol=1e-3)
