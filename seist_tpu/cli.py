@@ -58,6 +58,13 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "matmuls/activations in bfloat16 on the MXU with "
                         "fp32 params/optimizer/BN-stats/softmax/loss "
                         "(default: fp32)")
+    parser.add_argument("--loader-processes", default=0, type=int,
+                        dest="loader_processes",
+                        help="assemble batches with this many worker "
+                        "PROCESSES instead of the --workers thread pool "
+                        "(sidesteps the GIL for Python-bound augmentation "
+                        "mixes; batches are bit-identical). Default 0 = "
+                        "threads")
     parser.add_argument("--profile-steps", default=0, type=int,
                         dest="profile_steps",
                         help="capture a jax.profiler trace of this many "

@@ -186,10 +186,18 @@ class Loader:
     """Host-side batch loader with per-host sharding and fixed shapes.
 
     Each epoch: seeded global permutation -> this host's interleaved slice ->
-    fixed-size batches assembled by a thread pool. Train drops the global
+    fixed-size batches assembled by a worker pool. Train drops the global
     tail (every host sees the same number of steps — the collective-sync
     equivalent of ``drop_last``); eval pads the final batch and sets
     ``Batch.mask`` zeros on padding rows.
+
+    Workers: ``num_workers`` threads by default (the hot per-sample ops —
+    h5py reads, numpy array math, native wavekit kernels — release the
+    GIL, so threads scale on multi-core hosts). ``worker_processes > 0``
+    switches to a process pool instead, sidestepping the GIL entirely for
+    Python-bound augmentation mixes at the cost of per-sample IPC; batches
+    are bit-identical either way (per-sample RNG is derived from
+    (seed, epoch, idx), never worker identity).
     """
 
     def __init__(
@@ -200,6 +208,7 @@ class Loader:
         shuffle: bool = False,
         drop_last: bool = False,
         num_workers: int = 8,
+        worker_processes: int = 0,
         seed: int = 0,
         num_shards: int = 1,
         shard_index: int = 0,
@@ -211,22 +220,27 @@ class Loader:
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.num_workers = max(1, num_workers)
+        self.worker_processes = max(0, worker_processes)
         self.seed = seed
         self.num_shards = num_shards
         self.shard_index = shard_index
         self.epoch = 0
         self._pool: Optional[ThreadPoolExecutor] = None
+        self._proc_pool = None
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = int(epoch)
         self.dataset.set_epoch(epoch)
 
     def close(self) -> None:
-        """Release the worker threads. Safe to call multiple times; the
+        """Release the worker pool(s). Safe to call multiple times; the
         loader remains usable (a new pool spins up on the next __iter__)."""
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
             self._pool = None
+        if self._proc_pool is not None:
+            self._proc_pool.shutdown(wait=False, cancel_futures=True)
+            self._proc_pool = None
 
     def __del__(self):  # best-effort: Loaders built in loops must not leak
         try:
@@ -260,9 +274,39 @@ class Loader:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
-    def __iter__(self) -> Iterator[Batch]:
-        indices = self._indices()
-        nb = len(self)
+    def _fetch(self, chunk: np.ndarray) -> List[Any]:
+        """Fetch one batch's samples via the configured worker pool."""
+        if self.worker_processes:
+            if self._proc_pool is None:
+                import multiprocessing
+                from concurrent.futures import ProcessPoolExecutor
+
+                # forkserver/spawn, never fork: the pool is created lazily
+                # from the prefetch producer THREAD of a JAX-initialized
+                # parent — forking there can inherit locks held mid-acquire
+                # by other threads (h5py/logging/libtpu) and hang the
+                # children. The dataset is pickled ONCE per worker via the
+                # initializer — never per sample.
+                try:
+                    ctx = multiprocessing.get_context("forkserver")
+                except ValueError:  # platform without forkserver
+                    ctx = multiprocessing.get_context("spawn")
+                self._proc_pool = ProcessPoolExecutor(
+                    max_workers=self.worker_processes,
+                    mp_context=ctx,
+                    initializer=_proc_worker_init,
+                    initargs=(self.dataset,),
+                )
+            epoch = self.epoch
+            return list(
+                self._proc_pool.map(
+                    _proc_worker_getitem,
+                    [(epoch, int(i)) for i in chunk],
+                    # Batch the IPC: one message per worker-chunk, not per
+                    # sample (ordering is preserved by map).
+                    chunksize=max(1, len(chunk) // self.worker_processes),
+                )
+            )
         # One persistent pool for the loader's lifetime (threads are reused
         # across epochs instead of re-spawned each __iter__).
         if self._pool is None:
@@ -270,13 +314,17 @@ class Loader:
                 max_workers=self.num_workers,
                 thread_name_prefix="seist-loader",
             )
-        pool = self._pool
+        return list(self._pool.map(self.dataset.__getitem__, chunk))
+
+    def __iter__(self) -> Iterator[Batch]:
+        indices = self._indices()
+        nb = len(self)
         for b in range(nb):
             chunk = indices[b * self.batch_size : (b + 1) * self.batch_size]
             pad = self.batch_size - len(chunk)
             if pad:
                 chunk = np.concatenate([chunk, np.repeat(chunk[-1], pad)])
-            samples = list(pool.map(self.dataset.__getitem__, chunk))
+            samples = self._fetch(chunk)
             inputs = _stack([s[0] for s in samples])
             loss_targets = _stack([s[1] for s in samples])
             metrics_targets = {
@@ -290,6 +338,23 @@ class Loader:
             yield Batch(inputs, loss_targets, metrics_targets, meta, mask)
 
 
+
+
+_PROC_DATASET: Optional[SeismicDataset] = None
+
+
+def _proc_worker_init(dataset: SeismicDataset) -> None:
+    global _PROC_DATASET
+    _PROC_DATASET = dataset
+
+
+def _proc_worker_getitem(epoch_idx):
+    """Process-pool sample fetch. Epoch rides along with every index: the
+    parent's ``set_epoch`` does not propagate to live workers, and the
+    per-sample RNG is seeded from (seed, epoch, idx)."""
+    epoch, idx = epoch_idx
+    _PROC_DATASET.set_epoch(epoch)
+    return _PROC_DATASET[idx]
 
 
 def _double_buffer(iterator, transform, prefetch: int):

@@ -92,6 +92,14 @@ def _build_loader(args: Any, spec: taskspec.TaskSpec, mode: str) -> pipeline.Loa
         shuffle=(mode == "train" and args.shuffle),
         drop_last=(mode == "train"),
         num_workers=args.workers,
+        # Process workers only where the throughput matters: a second
+        # resident pool (each child holding a full dataset copy) for the
+        # occasional eval pass is pure memory cost.
+        worker_processes=(
+            int(getattr(args, "loader_processes", 0) or 0)
+            if mode == "train"
+            else 0
+        ),
         seed=args.seed,
         num_shards=jax.process_count(),
         shard_index=jax.process_index(),

@@ -213,3 +213,26 @@ class TestPackedPrefetch:
         )
         assert isinstance(xk, jax.Array)
         assert xk.sharding.spec[:2] == (None, "data")
+
+
+class TestProcessWorkers:
+    def test_process_pool_batches_match_threads(self):
+        """worker_processes must produce bit-identical batches to the
+        thread pool (per-sample RNG is (seed, epoch, idx)-derived)."""
+        sds_a = make_sds(n=12, augmentation=True)
+        sds_b = make_sds(n=12, augmentation=True)
+        lt = pipeline.Loader(sds_a, batch_size=4, num_workers=2)
+        lp = pipeline.Loader(sds_b, batch_size=4, worker_processes=2)
+        try:
+            lt.set_epoch(1)
+            lp.set_epoch(1)
+            for bt, bp in zip(lt, lp):
+                np.testing.assert_array_equal(bt.inputs, bp.inputs)
+                np.testing.assert_array_equal(bt.loss_targets, bp.loss_targets)
+                for k in bt.metrics_targets:
+                    np.testing.assert_array_equal(
+                        bt.metrics_targets[k], bp.metrics_targets[k]
+                    )
+        finally:
+            lt.close()
+            lp.close()
