@@ -1,0 +1,181 @@
+"""Continuous-record annotation: sliding-window inference + overlap stitch.
+
+The reference can only score fixed 8192-sample windows one at a time
+(demo_predict.py:59-97 — one window, one forward, a plot). Real
+deployments pick phases over hours-long continuous records; this module
+provides that as a first-class, TPU-friendly path:
+
+    windows, offsets = sliding_windows(record, window, stride)   # host view
+    probs = <jitted model forward over window batches>           # device
+    curve = stitch_probs(probs, offsets, len(record))            # device
+    picks = pick_peaks(curve[None, :, 1], ...)                   # device
+
+* Windowing is offset-based; ``annotate`` slices windows per inference
+  batch, so peak host memory is O(batch), independent of record length.
+  The final window is right-aligned so the record tail is always covered.
+* Stitching averages overlapping windows' probabilities (scatter-add of
+  values and hit counts — XLA lowers this to fixed-shape ops, no host
+  loop), which suppresses edge artifacts of any single window.
+* ``annotate`` runs the whole thing: batches windows (padding the last
+  batch so ONE compiled forward serves any record length), jits the
+  forward, stitches, then reuses ops/postprocess.pick_peaks /
+  detect_events for fixed-shape picking on the stitched curve.
+
+CLI: ``tools/predict.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seist_tpu.ops.postprocess import detect_events, pick_peaks
+
+
+def window_offsets(record_len: int, window: int, stride: int) -> np.ndarray:
+    """Window start offsets: advance by ``stride``; the last window is
+    clamped to ``L - window`` (right-aligned) so the tail is always
+    covered. Requires ``L >= window``."""
+    if record_len < window:
+        raise ValueError(f"record length {record_len} < window {window}")
+    offsets = list(range(0, record_len - window + 1, stride))
+    if offsets[-1] != record_len - window:
+        offsets.append(record_len - window)
+    return np.asarray(offsets, dtype=np.int32)
+
+
+def sliding_windows(
+    record: np.ndarray, window: int, stride: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(L, C) record -> ((n, window, C) array, (n,) int offsets).
+
+    Materializes all windows (copies ~window/stride x the record);
+    :func:`annotate` instead slices per inference batch so peak host
+    memory stays O(batch), independent of record length.
+    """
+    offsets = window_offsets(record.shape[0], window, stride)
+    windows = np.stack([record[o : o + window] for o in offsets], axis=0)
+    return windows, offsets
+
+
+def stitch_probs(
+    probs: jnp.ndarray,
+    offsets: jnp.ndarray,
+    total_len: int,
+    combine: str = "mean",
+) -> jnp.ndarray:
+    """Combine overlapping window probabilities back onto the record.
+
+    ``probs`` (n, window, C), ``offsets`` (n,) -> (total_len, C).
+    ``combine='mean'`` averages the k covering windows (suppresses
+    single-window noise); ``'max'`` takes their maximum (a pick near one
+    window's edge is never attenuated by a neighbor that missed it — the
+    usual choice for deployment pickers).
+    """
+    n, window, C = probs.shape
+    pos = offsets[:, None] + jnp.arange(window)[None, :]  # (n, window)
+    flat_pos = pos.reshape(-1)
+    flat = probs.reshape(-1, C)
+    if combine == "max":
+        return jnp.zeros((total_len, C), probs.dtype).at[flat_pos].max(flat)
+    if combine != "mean":
+        raise ValueError(f"unknown combine {combine!r}")
+    acc = jnp.zeros((total_len, C), probs.dtype).at[flat_pos].add(flat)
+    hits = jnp.zeros((total_len,), probs.dtype).at[flat_pos].add(1.0)
+    return acc / jnp.maximum(hits, 1.0)[:, None]
+
+
+def annotate(
+    apply_fn: Callable[[np.ndarray], Any],
+    record: np.ndarray,
+    *,
+    window: int = 8192,
+    stride: Optional[int] = None,
+    batch_size: int = 32,
+    sampling_rate: int = 50,
+    ppk_threshold: float = 0.3,
+    spk_threshold: float = 0.3,
+    det_threshold: float = 0.5,
+    min_peak_dist: float = 1.0,
+    max_events: Optional[int] = None,
+    combine: str = "mean",
+) -> Dict[str, np.ndarray]:
+    """Pick P/S phases + detection intervals over a continuous record.
+
+    ``apply_fn``: jittable forward mapping (N, window, C) float32 ->
+    (N, window, 3) probabilities ordered (non, P, S) — a dpk-family model.
+    ``record``: (L, C) float32, already preprocessed/normalized per-window
+    by the caller or raw (windows are z-normalized here, matching the
+    reference's eval normalization, preprocess.py:224-242).
+
+    ``max_events`` caps picks over the WHOLE record (pick_peaks keeps the
+    topk tallest); default scales with record length (4 per window span)
+    so long records aren't silently truncated.
+
+    Under ``combine='max'`` the non channel is combined with MIN (its
+    event-evidence complement 1-non with max): elementwise max of 'non'
+    would let one event-missing window VETO its neighbor's detection —
+    the exact edge artifact 'max' exists to prevent.
+
+    Returns {"ppk": indices, "spk": indices, "det": (k, 2) intervals,
+    "prob": (L, 3) stitched curve} with absolute sample positions;
+    pick/interval arrays are unpadded. Peak host memory is O(batch_size),
+    not O(record).
+    """
+    record = np.asarray(record, np.float32)
+    stride = stride or window // 2
+    offsets = window_offsets(record.shape[0], window, stride)
+    if max_events is None:
+        max_events = max(32, 4 * len(offsets))
+
+    jit_apply = jax.jit(apply_fn)
+    n = len(offsets)
+    probs = []
+    for i in range(0, n, batch_size):
+        offs = offsets[i : i + batch_size]
+        chunk = np.stack([record[o : o + window] for o in offs], axis=0)
+        # Per-window z-normalization (ref preprocess.py:224-242, std mode).
+        mean = chunk.mean(axis=1, keepdims=True)
+        std = chunk.std(axis=1, keepdims=True)
+        std[std == 0] = 1.0
+        chunk = (chunk - mean) / std
+        pad = batch_size - chunk.shape[0]
+        if pad:  # keep ONE compiled shape
+            chunk = np.concatenate([chunk, chunk[-1:].repeat(pad, 0)], axis=0)
+        out = np.asarray(jit_apply(jnp.asarray(chunk)))
+        probs.append(out[: batch_size - pad if pad else batch_size])
+    probs_arr = jnp.asarray(np.concatenate(probs, axis=0))
+
+    if combine == "max":
+        # Event-evidence space for the non channel (see docstring).
+        ev = probs_arr.at[..., 0].set(1.0 - probs_arr[..., 0])
+        stitched = stitch_probs(
+            ev, jnp.asarray(offsets), record.shape[0], combine="max"
+        )
+        curve = stitched.at[..., 0].set(1.0 - stitched[..., 0])
+    else:
+        curve = stitch_probs(
+            probs_arr, jnp.asarray(offsets), record.shape[0], combine=combine
+        )
+
+    dist = int(min_peak_dist * sampling_rate)
+    ppk = np.asarray(
+        pick_peaks(curve[None, :, 1], ppk_threshold, dist, max_events)
+    )[0]
+    spk = np.asarray(
+        pick_peaks(curve[None, :, 2], spk_threshold, dist, max_events)
+    )[0]
+    det = np.asarray(
+        detect_events(1.0 - curve[None, :, 0], det_threshold, max_events)
+    )[0].reshape(-1, 2)
+    return {
+        "ppk": ppk[ppk >= 0],
+        "spk": spk[spk >= 0],
+        # >= keeps real single-sample events (on == off); the [1, 0]
+        # padding pair has off < on and is stripped.
+        "det": det[det[:, 1] >= det[:, 0]],
+        "prob": np.asarray(curve),
+    }

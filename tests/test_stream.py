@@ -1,0 +1,160 @@
+"""Continuous-record annotation (ops/stream.py): windowing geometry,
+overlap-average stitching, and end-to-end picking on a synthetic record.
+
+No reference counterpart — the reference scores single fixed windows only
+(ref demo_predict.py:59-97); contracts are pinned against hand math.
+"""
+
+import numpy as np
+import pytest
+
+from seist_tpu.ops.stream import annotate, sliding_windows, stitch_probs
+
+
+class TestWindows:
+    def test_covers_whole_record_right_aligned(self):
+        rec = np.arange(25, dtype=np.float32).reshape(25, 1)
+        w, offs = sliding_windows(rec, window=10, stride=8)
+        assert list(offs) == [0, 8, 15]  # last clamped to L - window
+        np.testing.assert_array_equal(w[2, :, 0], np.arange(15, 25))
+
+    def test_exact_fit_single_window(self):
+        rec = np.zeros((10, 3), np.float32)
+        w, offs = sliding_windows(rec, 10, 4)
+        assert w.shape == (1, 10, 3) and list(offs) == [0]
+
+    def test_too_short_raises(self):
+        with pytest.raises(ValueError):
+            sliding_windows(np.zeros((5, 3), np.float32), 10, 4)
+
+
+class TestStitch:
+    def test_overlap_mean(self):
+        # Two windows of length 4, stride 2, over length 6: positions 2-3
+        # are covered by both -> mean of the two values.
+        probs = np.zeros((2, 4, 1), np.float32)
+        probs[0] += 1.0
+        probs[1] += 3.0
+        out = np.asarray(stitch_probs(probs, np.array([0, 2]), 6))[:, 0]
+        np.testing.assert_allclose(out, [1, 1, 2, 2, 3, 3])
+
+    def test_full_cover_identity(self):
+        rng = np.random.default_rng(0)
+        probs = rng.uniform(size=(1, 8, 3)).astype(np.float32)
+        out = np.asarray(stitch_probs(probs, np.array([0]), 8))
+        np.testing.assert_allclose(out, probs[0], rtol=1e-6)
+
+
+class TestAnnotate:
+    def test_picks_synthetic_events(self):
+        """A fake 'model' that thresholds the raw amplitude must recover
+        the planted event positions through windowing + stitching."""
+        fs = 50
+        L = 4000
+        rec = np.zeros((L, 3), np.float32)
+        events = [800, 2500]
+        for e in events:
+            rec[e : e + 5] = 50.0  # spike the planted P onsets
+
+        def fake_apply(x):
+            import jax.numpy as jnp
+
+            # P prob = normalized |z|; S channel silent; non = 1 - P.
+            a = jnp.abs(x[..., 0])
+            p = a / (a.max(axis=1, keepdims=True) + 1e-9)
+            s = jnp.zeros_like(p)
+            return jnp.stack([1.0 - p, p, s], axis=-1)
+
+        picks = annotate(
+            fake_apply, rec, window=1024, stride=512, batch_size=4,
+            sampling_rate=fs, ppk_threshold=0.5, min_peak_dist=2.0,
+        )
+        assert picks["spk"].size == 0
+        assert len(picks["ppk"]) == len(events)
+        for e, got in zip(events, sorted(picks["ppk"])):
+            assert abs(int(got) - e) <= 5
+        assert picks["prob"].shape == (L, 3)
+
+    def test_batch_padding_consistency(self):
+        """Results must not depend on batch_size (last-batch padding)."""
+        rng = np.random.default_rng(1)
+        rec = rng.standard_normal((3000, 3)).astype(np.float32)
+        rec[1000:1005] *= 30
+
+        def fake_apply(x):
+            import jax.numpy as jnp
+
+            a = jnp.abs(x[..., 0])
+            p = a / (a.max(axis=1, keepdims=True) + 1e-9)
+            return jnp.stack([1.0 - p, p, jnp.zeros_like(p)], axis=-1)
+
+        a = annotate(fake_apply, rec, window=1024, stride=512, batch_size=2)
+        b = annotate(fake_apply, rec, window=1024, stride=512, batch_size=7)
+        np.testing.assert_allclose(a["prob"], b["prob"], atol=1e-6)
+        np.testing.assert_array_equal(a["ppk"], b["ppk"])
+
+
+class TestCombineMax:
+    def test_max_keeps_peak_missed_by_neighbor(self):
+        # Window 0 sees a strong peak at pos 3; window 1 (covering the same
+        # position) misses it entirely. mean halves it; max keeps it.
+        probs = np.zeros((2, 4, 1), np.float32)
+        probs[0, 3, 0] = 0.9
+        offs = np.array([0, 2])
+        mean = np.asarray(stitch_probs(probs, offs, 6, combine="mean"))
+        mx = np.asarray(stitch_probs(probs, offs, 6, combine="max"))
+        assert mean[3, 0] == pytest.approx(0.45)
+        assert mx[3, 0] == pytest.approx(0.9)
+
+    def test_unknown_combine_raises(self):
+        with pytest.raises(ValueError):
+            stitch_probs(np.zeros((1, 4, 1), np.float32), np.array([0]), 4,
+                         combine="median")
+
+
+class TestMaxNonChannelSemantics:
+    def test_event_missing_window_cannot_veto_detection(self):
+        """combine='max': a window that misses an event must not suppress
+        the neighbor's detection via the non channel."""
+        # Window A sees an event at overlap positions (non=0.1); window B
+        # misses it (non=0.95). The stitched det strength must stay high.
+        probs = np.full((2, 4, 3), 0.0, np.float32)
+        probs[..., 0] = 0.95  # mostly noise everywhere
+        probs[0, 2:, 0] = 0.1  # window A: event in its last 2 samples
+        probs[0, 2:, 1] = 0.9
+
+        def fake_apply(x):  # not used; we test via annotate's stitch branch
+            raise AssertionError
+
+        import jax.numpy as jnp
+        from seist_tpu.ops.stream import stitch_probs
+
+        ev = jnp.asarray(probs).at[..., 0].set(1.0 - probs[..., 0])
+        st = stitch_probs(ev, np.array([0, 2]), 6, combine="max")
+        # annotate computes det strength as 1 - curve_non == st[..., 0].
+        det_strength = np.asarray(st)[:, 0]
+        # Overlap positions 2-3: event evidence survives the max combine
+        # (a plain max over the raw non channel would give 0.95 -> 0.05).
+        assert det_strength[2] == pytest.approx(0.9)
+        assert det_strength[3] == pytest.approx(0.9)
+
+    def test_single_sample_detection_kept(self):
+        from seist_tpu.ops.stream import annotate
+
+        rec = np.zeros((64, 3), np.float32)
+
+        def fake_apply(x):
+            import jax.numpy as jnp
+
+            # exactly one sample of event evidence at position 10
+            p = jnp.zeros(x.shape[:2])
+            p = p.at[:, 10].set(0.9)
+            return jnp.stack([1.0 - p, p, jnp.zeros_like(p)], axis=-1)
+
+        picks = annotate(
+            fake_apply, rec, window=64, stride=64, batch_size=1,
+            det_threshold=0.5,
+        )
+        assert picks["det"].shape[0] == 1
+        on, off = picks["det"][0]
+        assert on == off == 10
