@@ -210,6 +210,13 @@ def _synthetic_batch(spec, batch: int, in_samples: int, k: int = 1):
 def bench_train(device_kind: str) -> None:
     import jax
 
+    from seist_tpu.utils.misc import enable_compile_cache
+
+    # The seist_l train step costs ~4 min to compile on this host; across
+    # bench/matrix/A-B invocations of identical programs that dominates
+    # wall time.
+    enable_compile_cache(verbose=True)
+
     import seist_tpu
     from seist_tpu import taskspec
     from seist_tpu.models import api

@@ -187,6 +187,9 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 def main_worker(args: argparse.Namespace) -> None:
     """Mode dispatch (ref main.py:182-210)."""
     from seist_tpu.train.worker import is_main_process, test_worker, train_worker
+    from seist_tpu.utils.misc import enable_compile_cache
+
+    enable_compile_cache()
 
     log_dir = (
         os.path.join(

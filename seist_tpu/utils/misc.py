@@ -84,3 +84,30 @@ def dump_namespace(args: Any) -> str:
         d = dict(args)
     lines = [f"  {k} = {v!r}" for k, v in sorted(d.items())]
     return "Arguments:\n" + "\n".join(lines)
+
+
+def enable_compile_cache(verbose: bool = False) -> None:
+    """Persistent XLA compilation cache (large models cost minutes per
+    compile on TPU; identical programs across runs hit the disk cache).
+
+    Dir from ``JAX_COMPILATION_CACHE_DIR`` (empty value = disabled),
+    default ``~/.cache/seist_tpu_xla``. Best-effort: failures never block
+    a run. Shared by the CLI (cli.main_worker) and bench.py.
+    """
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "seist_tpu_xla"),
+    )
+    if not cache_dir:
+        return  # explicit opt-out
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+    except Exception as e:  # noqa: BLE001 - cache is best-effort
+        if verbose:
+            import sys
+
+            print(f"compilation cache unavailable: {e!r}", file=sys.stderr)
