@@ -102,29 +102,39 @@ def annotate(
     min_peak_dist: float = 1.0,
     max_events: Optional[int] = None,
     combine: str = "mean",
+    channel0: str,
 ) -> Dict[str, np.ndarray]:
     """Pick P/S phases + detection intervals over a continuous record.
 
     ``apply_fn``: jittable forward mapping (N, window, C) float32 ->
-    (N, window, 3) probabilities ordered (non, P, S) — a dpk-family model.
-    ``record``: (L, C) float32, already preprocessed/normalized per-window
-    by the caller or raw (windows are z-normalized here, matching the
-    reference's eval normalization, preprocess.py:224-242).
+    (N, window, 3) probabilities — a dpk-family model. ``channel0``
+    (REQUIRED — a wrong guess silently inverts detections) names the
+    first output channel's meaning: ``'non'`` (noise prob — phasenet,
+    taskspec labels ("non","ppk","spk")) or ``'det'`` (event prob — the
+    seist dpk family and eqtransformer, labels ("det","ppk","spk")); get
+    it from ``taskspec.get_task_spec(model).labels[0][0]`` as
+    tools/predict.py does.
+    Detection strength is ``1 - curve0`` for 'non' and ``curve0`` for
+    'det'. ``record``: (L, C) float32, raw (windows are z-normalized
+    here, matching the reference's eval normalization,
+    preprocess.py:224-242).
 
     ``max_events`` caps picks over the WHOLE record (pick_peaks keeps the
     topk tallest); default scales with record length (4 per window span)
     so long records aren't silently truncated.
 
-    Under ``combine='max'`` the non channel is combined with MIN (its
-    event-evidence complement 1-non with max): elementwise max of 'non'
-    would let one event-missing window VETO its neighbor's detection —
-    the exact edge artifact 'max' exists to prevent.
+    Under ``combine='max'`` every channel is combined in EVENT-EVIDENCE
+    space (the 'non' channel via its complement): an elementwise max of
+    'non' itself would let one event-missing window VETO its neighbor's
+    detection — the exact edge artifact 'max' exists to prevent.
 
     Returns {"ppk": indices, "spk": indices, "det": (k, 2) intervals,
     "prob": (L, 3) stitched curve} with absolute sample positions;
     pick/interval arrays are unpadded. Peak host memory is O(batch_size),
     not O(record).
     """
+    if channel0 not in ("non", "det"):
+        raise ValueError(f"channel0 must be 'non' or 'det', got {channel0!r}")
     record = np.asarray(record, np.float32)
     stride = stride or window // 2
     offsets = window_offsets(record.shape[0], window, stride)
@@ -149,7 +159,8 @@ def annotate(
         probs.append(out[: batch_size - pad if pad else batch_size])
     probs_arr = jnp.asarray(np.concatenate(probs, axis=0))
 
-    if combine == "max":
+    invert0 = channel0 == "non"
+    if combine == "max" and invert0:
         # Event-evidence space for the non channel (see docstring).
         ev = probs_arr.at[..., 0].set(1.0 - probs_arr[..., 0])
         stitched = stitch_probs(
@@ -168,8 +179,9 @@ def annotate(
     spk = np.asarray(
         pick_peaks(curve[None, :, 2], spk_threshold, dist, max_events)
     )[0]
+    det_strength = (1.0 - curve[:, 0]) if invert0 else curve[:, 0]
     det = np.asarray(
-        detect_events(1.0 - curve[None, :, 0], det_threshold, max_events)
+        detect_events(det_strength[None, :], det_threshold, max_events)
     )[0].reshape(-1, 2)
     return {
         "ppk": ppk[ppk >= 0],

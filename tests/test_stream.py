@@ -68,6 +68,7 @@ class TestAnnotate:
         picks = annotate(
             fake_apply, rec, window=1024, stride=512, batch_size=4,
             sampling_rate=fs, ppk_threshold=0.5, min_peak_dist=2.0,
+            channel0="non",
         )
         assert picks["spk"].size == 0
         assert len(picks["ppk"]) == len(events)
@@ -88,8 +89,8 @@ class TestAnnotate:
             p = a / (a.max(axis=1, keepdims=True) + 1e-9)
             return jnp.stack([1.0 - p, p, jnp.zeros_like(p)], axis=-1)
 
-        a = annotate(fake_apply, rec, window=1024, stride=512, batch_size=2)
-        b = annotate(fake_apply, rec, window=1024, stride=512, batch_size=7)
+        a = annotate(fake_apply, rec, window=1024, stride=512, batch_size=2, channel0="non")
+        b = annotate(fake_apply, rec, window=1024, stride=512, batch_size=7, channel0="non")
         np.testing.assert_allclose(a["prob"], b["prob"], atol=1e-6)
         np.testing.assert_array_equal(a["ppk"], b["ppk"])
 
@@ -153,8 +154,41 @@ class TestMaxNonChannelSemantics:
 
         picks = annotate(
             fake_apply, rec, window=64, stride=64, batch_size=1,
-            det_threshold=0.5,
+            det_threshold=0.5, channel0="non",
         )
         assert picks["det"].shape[0] == 1
         on, off = picks["det"][0]
         assert on == off == 10
+
+
+class TestDetChannelSemantics:
+    def test_det_channel0(self):
+        """seist-dpk/eqtransformer convention: channel 0 IS event prob
+        (taskspec labels ("det","ppk","spk")) — detection intervals must
+        come from curve0 directly, not its complement."""
+        from seist_tpu.ops.stream import annotate
+
+        rec = np.zeros((64, 3), np.float32)
+
+        def det_model(x):
+            import jax.numpy as jnp
+
+            d = jnp.zeros(x.shape[:2])
+            d = d.at[:, 20:30].set(0.9)  # event in progress
+            return jnp.stack([d, jnp.zeros_like(d), jnp.zeros_like(d)], axis=-1)
+
+        picks = annotate(
+            det_model, rec, window=64, stride=64, batch_size=1,
+            det_threshold=0.5, channel0="det",
+        )
+        assert picks["det"].shape[0] == 1
+        on, off = picks["det"][0]
+        assert (on, off) == (20, 29)
+        # The same model read with channel0='non' would invert: everything
+        # EXCEPT 20-30 looks like an event.
+        wrong = annotate(
+            det_model, rec, window=64, stride=64, batch_size=1,
+            det_threshold=0.5, channel0="non",
+        )
+        assert wrong["det"].shape[0] >= 1
+        assert tuple(wrong["det"][0]) != (20, 29)

@@ -69,6 +69,20 @@ def main() -> None:
     if record.shape[0] < record.shape[1]:  # (C, L) -> (L, C)
         record = record.T
 
+    spec = taskspec.get_task_spec(args.model_name)
+    first_group = spec.labels[0]
+    if not (
+        isinstance(first_group, (tuple, list))
+        and tuple(first_group)[0] in ("non", "det")
+        and len(first_group) == 3
+    ):
+        raise SystemExit(
+            f"{args.model_name} is not a dpk-family model "
+            f"(labels {spec.labels}); continuous picking needs "
+            f"(non|det, ppk, spk) outputs"
+        )
+    channel0 = first_group[0]
+
     in_channels = taskspec.get_num_inchannels(args.model_name)
     model = api.create_model(
         args.model_name, in_channels=in_channels, in_samples=args.window
@@ -94,6 +108,7 @@ def main() -> None:
         min_peak_dist=args.min_peak_dist,
         combine=args.combine,
         max_events=args.max_events or None,
+        channel0=channel0,
     )
 
     fs = float(args.sampling_rate)
