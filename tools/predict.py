@@ -62,13 +62,7 @@ def main() -> None:
 
     seist_tpu.load_all()
 
-    npz = np.load(args.input)
-    record = np.asarray(npz["data"], np.float32)
-    if record.ndim != 2:
-        raise ValueError(f"'data' must be 2-D, got {record.shape}")
-    if record.shape[0] < record.shape[1]:  # (C, L) -> (L, C)
-        record = record.T
-
+    # Fail fast on model family before touching the input file.
     spec = taskspec.get_task_spec(args.model_name)
     first_group = spec.labels[0]
     if not (
@@ -82,6 +76,13 @@ def main() -> None:
             f"(non|det, ppk, spk) outputs"
         )
     channel0 = first_group[0]
+
+    npz = np.load(args.input)
+    record = np.asarray(npz["data"], np.float32)
+    if record.ndim != 2:
+        raise ValueError(f"'data' must be 2-D, got {record.shape}")
+    if record.shape[0] < record.shape[1]:  # (C, L) -> (L, C)
+        record = record.T
 
     in_channels = taskspec.get_num_inchannels(args.model_name)
     model = api.create_model(

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Wait for the TPU tunnel, then run the conv-lowering A/B + missing matrix
+# configs. Results -> /root/repo/tools/ab_results.log (JSON lines).
+cd /root/repo
+probe() {
+  timeout 70 python -c "
+import jax, jax.numpy as jnp
+r = jax.jit(lambda a, b: a @ b)(jnp.ones((128,128)), jnp.ones((128,128)))
+r.block_until_ready(); print('UP')" 2>/dev/null | grep -q UP
+}
+echo "watcher start $(date)" >> /root/repo/tools/ab_results.log
+until probe; do sleep 300; done
+echo "tunnel UP $(date)" >> /root/repo/tools/ab_results.log
+
+run() {  # run <label> <env...>
+  label="$1"; shift
+  echo "=== $label $(date)" >> /root/repo/tools/ab_results.log
+  env "$@" BENCH_STEPS=10 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=120 \
+    python bench.py 2>/dev/null >> /root/repo/tools/ab_results.log
+}
+
+run "seist_s NEW (shift+dense)" BENCH_MODEL=seist_s_dpk BENCH_BATCH=256
+run "seist_s OLD (grouped)" BENCH_MODEL=seist_s_dpk BENCH_BATCH=256 \
+  SEIST_DWCONV_IMPL=grouped SEIST_GCONV_IMPL=grouped
+run "seist_l NEW (shift+dense)" BENCH_MODEL=seist_l_dpk BENCH_BATCH=256
+run "seist_l OLD (grouped)" BENCH_MODEL=seist_l_dpk BENCH_BATCH=256 \
+  SEIST_DWCONV_IMPL=grouped SEIST_GCONV_IMPL=grouped
+run "seist_s einsum-gconv" BENCH_MODEL=seist_s_dpk BENCH_BATCH=256 \
+  SEIST_GCONV_IMPL=einsum
+echo "AB DONE $(date)" >> /root/repo/tools/ab_results.log
+
+python tools/bench_matrix.py --steps 15 \
+  --only seist_l_emg,seist_l_baz,seist_l_dis >> /root/repo/tools/ab_results.log 2>&1
+echo "ALL DONE $(date)" >> /root/repo/tools/ab_results.log
