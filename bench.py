@@ -155,13 +155,29 @@ def _peak_flops(device_kind: str) -> float:
     return _PEAK_BF16["v5e"]  # conservative default
 
 
-def _vs_baseline(wfs: float) -> float:
+def _vs_baseline(
+    wfs: float,
+    model_name: Optional[str] = None,
+    in_samples: Optional[int] = None,
+) -> float:
+    """Ratio vs the torch reference's CPU-measured number for the SAME
+    model when available (tools/bench_reference.py --models ... writes
+    per_model entries), else the legacy flagship number. wf/s scales
+    inversely with sequence length, so a baseline recorded at a different
+    in_samples is NOT comparable -> 0.0 (batch may differ: throughput is
+    already per-waveform)."""
     path = os.path.join(_REPO, "tools", "reference_baseline.json")
     if os.path.exists(path):
         with open(path) as f:
             ref = json.load(f)
-        ref_wfs = ref.get("waveforms_per_sec", 0.0)
-        if ref_wfs:
+        entry = ref.get("per_model", {}).get(model_name) if model_name else None
+        if entry is None:
+            entry = ref  # legacy flat layout
+        ref_wfs = entry.get("waveforms_per_sec", 0.0)
+        ref_len = entry.get("in_samples")
+        if ref_wfs and (
+            in_samples is None or ref_len is None or ref_len == in_samples
+        ):
             return round(wfs / ref_wfs, 3)
     return 0.0
 
@@ -322,7 +338,7 @@ def bench_train(device_kind: str) -> None:
         "metric": metric,
         "value": round(wfs, 2),
         "unit": unit,
-        "vs_baseline": _vs_baseline(wfs),
+        "vs_baseline": _vs_baseline(wfs, model_name, in_samples),
         "step_time_ms": round(step_ms, 2),
         "mfu": round(mfu, 4),
         "mfu_note": "vs bf16 dense peak",
