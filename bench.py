@@ -64,6 +64,34 @@ _CACHE_WRITE = os.path.join(_REPO, "logs", "last_bench.json")
 _CACHE_READ = (_CACHE_WRITE, os.path.join(_REPO, "tools", "last_bench.json"))
 
 
+def env_config() -> dict:
+    """The benchmark configuration from the BENCH_* env knobs — the ONE
+    place defaults live, shared by bench_train() and the cache-key config
+    so a cached replay can never be attributed to a different
+    dtype/batch/length than what actually ran.
+
+    Batch default 512: closest power of 2 to the reference's headline
+    batch 500 (ref main.py:119-149). Dtype default bf16 since round 2's
+    dense conv lowerings: with the grouped convs lowered as
+    block-diagonal-dense/shift-FMA matmul work, bf16 compute (fp32
+    params/BN-stats/loss — train/precision.py) measured +46% over fp32 in
+    a same-session A/B (seist_l_dpk b256: 2,678 vs 1,834 wf/s,
+    BASELINE.md). The torch reference trains fp32 with at most a TF32
+    matmul hint (ref main.py:224-226); bf16-compute training is this
+    framework's mixed-precision lever (tolerance-tested in
+    tests/test_train.py::test_bf16_train_step_tracks_fp32).
+    """
+    return {
+        "model": os.environ.get("BENCH_MODEL", "seist_l_dpk"),
+        "dtype": os.environ.get("BENCH_DTYPE", "bf16"),
+        "batch": int(os.environ.get("BENCH_BATCH", 512)),
+        "in_samples": int(os.environ.get("BENCH_SAMPLES", 8192)),
+        # Micro-steps scanned inside one jitted call (amortizes
+        # per-dispatch cost; see train/step.py make_multi_train_step).
+        "steps_per_call": int(os.environ.get("BENCH_STEPS_PER_CALL", 1)),
+    }
+
+
 def _fail(
     metric: str, unit: str, error: str, config: Optional[dict] = None
 ) -> None:
@@ -246,15 +274,12 @@ def bench_train(device_kind: str) -> None:
 
     seist_tpu.load_all()
 
-    model_name = os.environ.get("BENCH_MODEL", "seist_l_dpk")
-    in_samples = int(os.environ.get("BENCH_SAMPLES", 8192))
-    # Default 512: closest power of 2 to the reference's headline batch 500
-    # (ref main.py:119-149) and measurably better wf/s than 256 on v5e.
-    batch = int(os.environ.get("BENCH_BATCH", 512))
-    dtype = os.environ.get("BENCH_DTYPE", "fp32")
-    # Micro-steps scanned inside one jitted call (amortizes per-dispatch
-    # cost; see train/step.py make_multi_train_step).
-    spc = int(os.environ.get("BENCH_STEPS_PER_CALL", 1))
+    cfg = env_config()
+    model_name = cfg["model"]
+    in_samples = cfg["in_samples"]
+    batch = cfg["batch"]
+    dtype = cfg["dtype"]
+    spc = cfg["steps_per_call"]
     warmup_steps = 5
     bench_steps = int(os.environ.get("BENCH_STEPS", 30))
     metric = f"{model_name}_train_throughput"
@@ -368,7 +393,7 @@ def bench_loader() -> None:
 
 def main() -> None:
     mode = os.environ.get("BENCH_MODE", "train")
-    model_name = os.environ.get("BENCH_MODEL", "seist_l_dpk")
+    model_name = env_config()["model"]
     metric = f"{model_name}_train_throughput"
     unit = "waveforms/sec/chip"
 
@@ -388,12 +413,7 @@ def main() -> None:
 
     # A cached replay must match this run's exact configuration — never
     # attribute another dtype/batch/length's number to this one.
-    config = {
-        "dtype": os.environ.get("BENCH_DTYPE", "fp32"),
-        "batch": int(os.environ.get("BENCH_BATCH", 512)),
-        "in_samples": int(os.environ.get("BENCH_SAMPLES", 8192)),
-        "steps_per_call": int(os.environ.get("BENCH_STEPS_PER_CALL", 1)),
-    }
+    config = {k: v for k, v in env_config().items() if k != "model"}
     kind = probe_backend()
     if kind is None:
         n = os.environ.get("BENCH_PROBE_ATTEMPTS", "3")

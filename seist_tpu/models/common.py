@@ -250,14 +250,26 @@ class DepthwiseConv1D(nn.Module):
                 dimension_numbers=("NWC", "WIO", "NWC"),
                 feature_group_count=self.features,
             )
-        k, s = self.kernel_size, self.stride
-        w = kernel[:, 0, :].astype(x.dtype)  # (k, C)
-        out_len = (x.shape[-2] - k) // s + 1
-        span = (out_len - 1) * s + 1
-        acc = x[..., 0:span:s, :] * w[0]
-        for j in range(1, k):
-            acc = acc + x[..., j : j + span : s, :] * w[j]
-        return acc
+        return depthwise_shift_fma(
+            x, kernel[:, 0, :].astype(x.dtype), self.stride
+        )
+
+
+def depthwise_shift_fma(x: Array, w: Array, stride: int) -> Array:
+    """VALID depthwise conv as k strided-slice multiply-adds.
+
+    ``x`` is (N, L, C), ``w`` is (k, C); returns (N, L_out, C). Pure VPU
+    elementwise work that XLA fuses into one kernel — the lowering behind
+    :class:`DepthwiseConv1D` (impl='shift'), shared with the merged stem
+    path in models/seist.py which runs it on a zero-padded multi-kernel
+    bank."""
+    k, s = int(w.shape[0]), stride
+    out_len = (x.shape[-2] - k) // s + 1
+    span = (out_len - 1) * s + 1
+    acc = x[..., 0:span:s, :] * w[0]
+    for j in range(1, k):
+        acc = acc + x[..., j : j + span : s, :] * w[j]
+    return acc
 
 
 class GroupedConv1D(nn.Module):
@@ -381,6 +393,13 @@ def gelu(x: Array) -> Array:
     return jax.nn.gelu(x, approximate=False)
 
 
+# torch BatchNorm1d defaults (torch momentum 0.1 == flax-convention 0.9).
+# Single source of truth for BOTH BatchNorm1dParity and merged lowerings
+# that re-derive its math (models/seist.py StemBlock._merged_paths).
+BN_MOMENTUM = 0.9
+BN_EPSILON = 1e-5
+
+
 class BatchNorm1dParity(nn.Module):
     """BatchNorm over (N, L, C) with exact torch ``BatchNorm1d`` semantics.
 
@@ -404,8 +423,8 @@ class BatchNorm1dParity(nn.Module):
     """
 
     use_running_average: bool
-    momentum: float = 0.9  # flax convention: new = m*old + (1-m)*batch
-    epsilon: float = 1e-5
+    momentum: float = BN_MOMENTUM  # flax convention: new = m*old + (1-m)*batch
+    epsilon: float = BN_EPSILON
     dtype: Optional[Any] = None
 
     @nn.compact
@@ -461,8 +480,8 @@ def make_norm(
     if norm == "batch":
         return BatchNorm1dParity(
             use_running_average=use_running_average,
-            momentum=0.9,
-            epsilon=1e-5,
+            momentum=BN_MOMENTUM,
+            epsilon=BN_EPSILON,
             dtype=dtype,
             name=name,
         )

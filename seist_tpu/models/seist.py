@@ -19,6 +19,7 @@ IEEE TGRS 2024), re-designed channels-last for XLA/TPU:
 from __future__ import annotations
 
 import math
+import os
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
@@ -113,8 +114,88 @@ class DSConvNormAct(nn.Module):
         return self.act(x)
 
 
+class _Kernel(nn.Module):
+    """Declares one ``kernel`` param leaf (same name/shape/init as the
+    nn.Dense / DepthwiseConv1D it twins) and returns it raw, so a parent
+    module can compute a merged lowering over several paths' weights while
+    the checkpoint tree stays identical to the per-path modules."""
+
+    shape: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self) -> Array:
+        return self.param("kernel", trunc_normal_init, self.shape)
+
+
+class _BNLeaves(nn.Module):
+    """Param/variable twin of :class:`common.BatchNorm1dParity` (same leaf
+    names, shapes, inits). Returns (scale, bias, mean_ref, var_ref)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        scale = self.param(
+            "scale", nn.initializers.ones, (self.features,), jnp.float32
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), jnp.float32
+        )
+        mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((self.features,), jnp.float32)
+        )
+        var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((self.features,), jnp.float32)
+        )
+        return scale, bias, mean, var
+
+
+class _DSConvPathLeaves(nn.Module):
+    """Param-tree twin of one :class:`DSConvNormAct` path: declares the
+    exact same leaves (conv{i}/in_proj/kernel, dconv/kernel, pconv/kernel,
+    norm/{scale,bias,mean,var}) without computing anything, for the merged
+    StemBlock lowering below."""
+
+    prev_dim: int
+    in_dim: int
+    out_dim: int
+    kernel_size: int
+
+    @nn.compact
+    def __call__(self):
+        w_in = _Kernel((self.prev_dim, self.in_dim), name="in_proj")()
+        w_d = _Kernel((self.kernel_size, 1, self.in_dim), name="dconv")()
+        w_p = _Kernel((self.in_dim, self.out_dim), name="pconv")()
+        bn = _BNLeaves(self.out_dim, name="norm")()
+        return w_in, w_d, w_p, bn
+
+
 class StemBlock(nn.Module):
-    """3 parallel DSConv paths with kernels k, k+4, k+8 (ref: seist.py:158-195)."""
+    """3 parallel DSConv paths with kernels k, k+4, k+8 (ref: seist.py:158-195).
+
+    Two checkpoint-identical lowerings (``impl`` / env SEIST_STEM_IMPL):
+
+    * ``'paths'`` (default) — the literal architecture: 3 independent
+      DSConvNormAct calls.
+    * ``'merged'`` — horizontal fusion of the 3 paths: one in-projection
+      matmul on the concatenated kernels (the input is read once instead
+      of 3x), one shift-FMA depthwise pass over a zero-padded multi-kernel
+      bank, one block-diagonal pointwise matmul (3C lanes instead of C),
+      and one merged BatchNorm whose per-channel stats are exactly the
+      per-path norms'.
+
+    ``'merged'`` is a measured NEGATIVE result on TPU v5e and therefore
+    not the default: interleaved A/B on seist_l_dpk fp32 b256 gave
+    1,613 wf/s merged vs 1,834/1,838 paths (-12%; BASELINE.md round 2).
+    The fwd pass does get fewer passes, but XLA lowers the backward of
+    the merged strided-slice FMA (stride-2 stems) to generic scatter-adds
+    with s32 index vectors and flips the activation layout to {0,2,1},
+    inserting full-tensor copies — costing more than the saved reads.
+    Kept env-selectable for future XLA versions / other topologies.
+
+    Both lowerings produce the same param/batch_stats tree and the same
+    values up to fp reassociation (tested in tests/test_models.py).
+    """
 
     in_dim: int
     out_dim: int
@@ -123,25 +204,97 @@ class StemBlock(nn.Module):
     norm: str = "batch"
     act: Callable = common.gelu
     npath: int = 3
+    # None -> env SEIST_STEM_IMPL, else 'paths' (see docstring: 'merged'
+    # measured slower on v5e)
+    impl: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
-        outs = [
-            DSConvNormAct(
-                self.in_dim,
-                self.out_dim,
-                self.kernel_size + 4 * dk,
-                self.stride,
-                self.norm,
-                self.act,
-                name=f"conv{dk}",
-            )(x, train)
-            for dk in range(self.npath)
-        ]
-        x = jnp.concatenate(outs, axis=-1)
+        impl = self.impl or os.environ.get("SEIST_STEM_IMPL") or "paths"
+        if impl not in ("merged", "paths"):
+            raise ValueError(f"unknown stem impl {impl!r}")
+        if impl == "merged" and self.norm != "batch":
+            raise ValueError(
+                "SEIST_STEM_IMPL=merged only supports norm='batch' "
+                f"(got {self.norm!r}); use the 'paths' impl"
+            )
+        if impl == "merged":
+            x = self._merged_paths(x, train)
+        else:
+            outs = [
+                DSConvNormAct(
+                    self.in_dim,
+                    self.out_dim,
+                    self.kernel_size + 4 * dk,
+                    self.stride,
+                    self.norm,
+                    self.act,
+                    name=f"conv{dk}",
+                )(x, train)
+                for dk in range(self.npath)
+            ]
+            x = jnp.concatenate(outs, axis=-1)
         x = nn.Dense(self.out_dim, use_bias=False, name="out_proj", **_dense_kw)(x)
         x = common.make_norm(self.norm, use_running_average=not train, name="norm")(x)
         return x
+
+    def _merged_paths(self, x: Array, train: bool) -> Array:
+        """All 3 DSConvNormAct paths in 3 device passes instead of ~9."""
+        from seist_tpu.train.precision import policy_dtype
+
+        P, C, O = self.npath, self.in_dim, self.out_dim
+        ks = [self.kernel_size + 4 * dk for dk in range(P)]
+        K = ks[-1]
+        leaves = [
+            _DSConvPathLeaves(x.shape[-1], C, O, k, name=f"conv{i}")()
+            for i, k in enumerate(ks)
+        ]
+        # one in-projection matmul — x is streamed once for all paths
+        w_in = jnp.concatenate([l[0] for l in leaves], axis=1)  # (Cin, P*C)
+        h = x @ w_in
+        # one depthwise pass over a zero-padded multi-kernel bank: path i's
+        # k_i-tap kernel sits at tap offset (K - k_i)//2, which under the
+        # K-kernel 'same' padding reproduces the path's own asymmetric
+        # padding exactly (left-pad difference LP_K - lp_i == (K - k_i)//2
+        # because kernel sizes differ by the even 4*dk; ref geometry:
+        # seist.py:12-48).
+        bank = jnp.zeros((K, P * C), dtype=h.dtype)
+        for i, (k_i, l) in enumerate(zip(ks, leaves)):
+            off = (K - k_i) // 2
+            bank = bank.at[off : off + k_i, i * C : (i + 1) * C].set(
+                l[1][:, 0, :].astype(h.dtype)
+            )
+        h = common.auto_pad_1d(h, K, self.stride)
+        h = common.depthwise_shift_fma(h, bank, self.stride)
+        # one block-diagonal pointwise matmul (P*C -> P*O)
+        w_p = jax.scipy.linalg.block_diag(*[l[2] for l in leaves])
+        h = h @ w_p
+        # merged BatchNorm1dParity (common.py): per-channel batch stats are
+        # identical to the per-path norms'; running stats are written back
+        # into each path's own batch_stats leaves.
+        scale = jnp.concatenate([l[3][0] for l in leaves])
+        bias = jnp.concatenate([l[3][1] for l in leaves])
+        if not train:
+            mean = jnp.concatenate([l[3][2].value for l in leaves])
+            var = jnp.concatenate([l[3][3].value for l in leaves])
+        else:
+            hf = h.astype(jnp.float32)
+            mean = jnp.mean(hf, (0, 1))
+            var = jnp.maximum(
+                jnp.mean(jnp.square(hf), (0, 1)) - jnp.square(mean), 0.0
+            )
+            if not self.is_initializing():
+                n = h.shape[0] * h.shape[1]
+                unbiased = var * (n / max(n - 1, 1))
+                m = common.BN_MOMENTUM
+                for i, l in enumerate(leaves):
+                    sl = slice(i * O, (i + 1) * O)
+                    l[3][2].value = m * l[3][2].value + (1 - m) * mean[sl]
+                    l[3][3].value = m * l[3][3].value + (1 - m) * unbiased[sl]
+        inv = jax.lax.rsqrt(var + common.BN_EPSILON) * scale
+        h = (h.astype(jnp.float32) - mean) * inv + bias
+        h = h.astype(policy_dtype() or x.dtype)
+        return self.act(h)
 
 
 class GroupConvBlock(nn.Module):

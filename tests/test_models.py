@@ -217,3 +217,74 @@ def test_eqt_banded_mask_matches_torch():
         j = np.arange(L)[None, :]
         ours = (j - i <= w // 2 - 1) & (j - i >= (-w) // 2)
         np.testing.assert_array_equal(ours, ref, err_msg=f"width {w}")
+
+
+class TestMergedStem:
+    """StemBlock's merged lowering must be checkpoint-identical and
+    numerically equivalent to the literal 3-path architecture
+    (seist_tpu/models/seist.py StemBlock docstring)."""
+
+    def _make(self, impl, stride):
+        from seist_tpu.models.seist import StemBlock
+
+        return StemBlock(
+            in_dim=16, out_dim=16, kernel_size=11, stride=stride, impl=impl
+        )
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    def test_param_tree_and_values_identical(self, stride):
+        x = jnp.zeros((2, 64, 3))
+        key = jax.random.PRNGKey(0)
+        vp = self._make("paths", stride).init(key, x, True)
+        vm = self._make("merged", stride).init(key, x, True)
+        fp = jax.tree_util.tree_flatten_with_path(vp)[0]
+        fm = jax.tree_util.tree_flatten_with_path(vm)[0]
+        assert [p for p, _ in fp] == [p for p, _ in fm]
+        for (p, a), (_, b) in zip(fp, fm):
+            np.testing.assert_array_equal(a, b, err_msg=str(p))
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    @pytest.mark.parametrize("train", [False, True])
+    def test_outputs_and_stats_match(self, stride, train):
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 63, 3))
+        variables = self._make("paths", stride).init(jax.random.PRNGKey(0), x, True)
+        outs = {}
+        stats = {}
+        for impl in ("paths", "merged"):
+            m = self._make(impl, stride)
+            if train:
+                y, mut = m.apply(variables, x, True, mutable=["batch_stats"])
+                stats[impl] = mut["batch_stats"]
+            else:
+                y = m.apply(variables, x, False)
+            outs[impl] = y
+        np.testing.assert_allclose(
+            outs["paths"], outs["merged"], rtol=2e-5, atol=2e-5
+        )
+        if train:
+            fa = jax.tree_util.tree_flatten_with_path(stats["paths"])[0]
+            fb = jax.tree_util.tree_flatten_with_path(stats["merged"])[0]
+            assert [p for p, _ in fa] == [p for p, _ in fb]
+            for (p, a), (_, b) in zip(fa, fb):
+                np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5, err_msg=str(p))
+
+    def test_full_model_forward_matches(self):
+        import os
+
+        from seist_tpu.models import api
+
+        x = jax.random.normal(jax.random.PRNGKey(2), (1, 512, 3))
+        model = api.create_model("seist_s_dpk", in_samples=512)
+        variables = model.init(jax.random.PRNGKey(0), x, False)
+        prev = os.environ.get("SEIST_STEM_IMPL")
+        try:
+            os.environ["SEIST_STEM_IMPL"] = "paths"
+            y_paths = model.apply(variables, x, False)
+            os.environ["SEIST_STEM_IMPL"] = "merged"
+            y_merged = model.apply(variables, x, False)
+        finally:
+            if prev is None:
+                os.environ.pop("SEIST_STEM_IMPL", None)
+            else:
+                os.environ["SEIST_STEM_IMPL"] = prev
+        np.testing.assert_allclose(y_paths, y_merged, rtol=1e-5, atol=1e-5)

@@ -64,6 +64,10 @@ def main() -> None:
             BENCH_STEPS=str(args.steps),
             BENCH_PROBE_ATTEMPTS="2",
         )
+        # Pin the dtype unless the caller chose one: the matrix's rows are
+        # only comparable to each other at a fixed dtype, and bench.py's
+        # own default may evolve (fp32 -> bf16 in round 2).
+        env.setdefault("BENCH_DTYPE", "fp32")
         print(f"=== {model} (batch {batch}) ===", file=sys.stderr, flush=True)
         try:
             r = subprocess.run(
