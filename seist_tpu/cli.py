@@ -79,6 +79,18 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "remote/contended devices. Per-step train metrics "
                         "are skipped (loss only); trailing batches that "
                         "don't fill a call are dropped. Default 1")
+    parser.add_argument("--grad-accum-steps", default=1, type=int,
+                        dest="grad_accum_steps",
+                        help="accumulate gradients over this many "
+                        "micro-batches into ONE optimizer update (scanned "
+                        "in a single jitted program; peak memory is one "
+                        "micro-batch) — train the reference's batch-500 "
+                        "effective batch on a memory-tight chip by e.g. "
+                        "--batch-size 100 --grad-accum-steps 5. Per-step "
+                        "train metrics are skipped (loss only), and "
+                        "trailing batches that don't fill an update are "
+                        "dropped, as with --steps-per-call. Mutually "
+                        "exclusive with --steps-per-call. Default 1")
 
     # Random seed
     parser.add_argument("--seed", default=0, type=int)

@@ -18,6 +18,7 @@ from seist_tpu.train.step import (  # noqa: F401
     jit_multi_step,
     jit_step,
     make_eval_step,
+    make_accum_train_step,
     make_multi_train_step,
     make_train_step,
 )

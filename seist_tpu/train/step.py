@@ -39,6 +39,35 @@ def _apply_transforms(spec: TaskSpec, outputs, targets):
     return outputs, targets
 
 
+def _forward_loss(spec: TaskSpec, loss_fn: Callable, cdtype, apply_fn) -> Callable:
+    """Shared train-mode forward+loss body for the single-step and
+    gradient-accumulation paths: cast params/inputs to the compute dtype,
+    apply with mutable BN stats, cast outputs back to fp32, apply the task
+    transforms. Returns ``compute(params, stats, inputs, targets, key) ->
+    (loss, (outputs, new_stats))`` — differentiable in ``params`` (arg 0).
+    """
+
+    def compute(params, stats, inputs, targets, key):
+        variables = {"params": cast_floating(params, cdtype)}
+        has_stats = stats is not None
+        if has_stats:
+            variables["batch_stats"] = stats
+        with precision_policy(cdtype):
+            out = apply_fn(
+                variables,
+                cast_floating(inputs, cdtype),
+                train=True,
+                mutable=["batch_stats"] if has_stats else [],
+                rngs={"dropout": key},
+            )
+        outputs, mutated = out if has_stats else (out[0], {})
+        outputs = cast_to_float32(outputs)
+        o, t = _apply_transforms(spec, outputs, targets)
+        return loss_fn(o, t), (outputs, mutated.get("batch_stats"))
+
+    return compute
+
+
 def make_train_step(
     spec: TaskSpec, loss_fn: Callable, compute_dtype: Optional[str] = None
 ) -> Callable:
@@ -56,30 +85,10 @@ def make_train_step(
 
     def train_step(state: TrainState, inputs, targets, rng):
         step_rng = jax.random.fold_in(rng, state.step)
-        inputs_c = cast_floating(inputs, cdtype)
-
-        def compute_loss(params):
-            variables = {"params": cast_floating(params, cdtype)}
-            has_stats = state.batch_stats is not None
-            if has_stats:
-                variables["batch_stats"] = state.batch_stats
-            with precision_policy(cdtype):
-                out = state.apply_fn(
-                    variables,
-                    inputs_c,
-                    train=True,
-                    mutable=["batch_stats"] if has_stats else [],
-                    rngs={"dropout": step_rng},
-                )
-            outputs, mutated = out if has_stats else (out[0], {})
-            outputs = cast_to_float32(outputs)
-            o, t = _apply_transforms(spec, outputs, targets)
-            loss = loss_fn(o, t)
-            return loss, (outputs, mutated.get("batch_stats"))
-
+        fwd = _forward_loss(spec, loss_fn, cdtype, state.apply_fn)
         (loss, (outputs, new_stats)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(state.params)
+            fwd, has_aux=True
+        )(state.params, state.batch_stats, inputs, targets, step_rng)
         state = state.apply_gradients(grads=grads)
         if new_stats is not None:
             state = state.replace(batch_stats=cast_to_float32(new_stats))
@@ -136,6 +145,75 @@ def make_multi_train_step(
         return state, losses.mean(), None
 
     return multi_step
+
+
+def make_accum_train_step(
+    spec: TaskSpec,
+    loss_fn: Callable,
+    compute_dtype: Optional[str] = None,
+    accum_steps: int = 1,
+) -> Callable:
+    """Build ONE optimizer update from ``accum_steps`` micro-batch
+    gradients, scanned inside a single jitted program.
+
+    ``accum_step(state, inputs_k, targets_k, rng) -> (state, mean_loss, None)``
+    where every leaf of ``inputs_k``/``targets_k`` has a leading
+    ``accum_steps`` axis (same stacked layout as
+    :func:`make_multi_train_step` — jit under a mesh with
+    :func:`jit_multi_step`). The scan carries a running gradient sum, so
+    peak memory is ONE micro-batch's activations plus one gradient pytree:
+    this is how the reference's batch-500 training config
+    (ref main.py:119-149) fits a memory-tight chip without changing the
+    effective batch. The reference itself has no gradient accumulation
+    (SURVEY.md §2.4: absent).
+
+    Semantics vs one big-batch step:
+
+    * gradients — mean over micro-batches == big-batch gradient for
+      mean-reduced losses and equal micro sizes (exact for BN-free
+      models; with BatchNorm the batch statistics couple samples, so the
+      gradient matches SMALL-batch BN semantics, like torch DDP
+      accumulation loops).
+    * BatchNorm running stats — chained through the micro-steps, exactly
+      as if the micro-batches had been separate forward passes.
+    * dropout/droppath — each micro-batch folds its index into the step
+      key, so noise differs per micro-batch.
+    * ``state.step`` advances by ONE per call (one update), so LR
+      schedules see update counts, not micro-step counts.
+    """
+    if accum_steps <= 1:
+        return make_train_step(spec, loss_fn, compute_dtype)
+    cdtype = resolve_dtype(compute_dtype)
+
+    def accum_step(state: TrainState, inputs_k, targets_k, rng):
+        step_rng = jax.random.fold_in(rng, state.step)
+        has_stats = state.batch_stats is not None
+        grad_fn = jax.value_and_grad(
+            _forward_loss(spec, loss_fn, cdtype, state.apply_fn), has_aux=True
+        )
+
+        def body(carry, batch):
+            grads_sum, stats, loss_sum, i = carry
+            x, y = batch
+            key = jax.random.fold_in(step_rng, i)
+            (loss, (_, new_stats)), grads = grad_fn(state.params, stats, x, y, key)
+            grads_sum = jax.tree.map(jnp.add, grads_sum, grads)
+            if has_stats:
+                stats = cast_to_float32(new_stats)
+            return (grads_sum, stats, loss_sum + loss, i + 1), None
+
+        zeros = jax.tree.map(jnp.zeros_like, state.params)
+        carry0 = (zeros, state.batch_stats, jnp.zeros(()), jnp.zeros((), jnp.int32))
+        (grads_sum, stats, loss_sum, _), _ = jax.lax.scan(
+            body, carry0, (inputs_k, targets_k)
+        )
+        grads = jax.tree.map(lambda g: g / accum_steps, grads_sum)
+        state = state.apply_gradients(grads=grads)
+        if has_stats:
+            state = state.replace(batch_stats=stats)
+        return state, loss_sum / accum_steps, None
+
+    return accum_step
 
 
 def make_eval_step(

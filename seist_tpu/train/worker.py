@@ -35,6 +35,7 @@ from seist_tpu.train import (
     jit_multi_step,
     jit_step,
     load_checkpoint,
+    make_accum_train_step,
     make_eval_step,
     make_multi_train_step,
     make_train_step,
@@ -269,6 +270,18 @@ def train_worker(args: Any) -> str:
         epochs = max(1, int(np.ceil(args.steps / steps_per_epoch)))
     total_steps = steps_per_epoch * epochs
 
+    # Gradient accumulation: k loader batches -> ONE optimizer update
+    # (step.py make_accum_train_step). state.step counts UPDATES and the
+    # LR schedule follows it, so the schedule length shrinks by k.
+    gas = max(1, int(getattr(args, "grad_accum_steps", 1) or 1))
+    if gas > 1:
+        if steps_per_epoch // gas == 0:
+            raise ValueError(
+                f"--grad-accum-steps {gas} exceeds steps_per_epoch "
+                f"{steps_per_epoch}: every epoch would apply ZERO updates"
+            )
+        total_steps = (steps_per_epoch // gas) * epochs
+
     # Model + optimizer + state.
     in_channels = taskspec.get_num_inchannels(args.model_name)
     model = api.create_model(
@@ -327,7 +340,32 @@ def train_worker(args: Any) -> str:
 
     dtype = getattr(args, "dtype", "fp32")
     spc = max(1, int(getattr(args, "steps_per_call", 1) or 1))
-    if spc > 1:
+    if spc > 1 and gas > 1:
+        raise ValueError(
+            "--steps-per-call and --grad-accum-steps are mutually "
+            "exclusive (both scan stacked micro-batches, with different "
+            "update semantics)"
+        )
+    if gas > 1:
+        # One update from gas micro-batch gradients, scanned in one jitted
+        # program; stacked-batch layout shares jit_multi_step's sharding.
+        if steps_per_epoch % gas:
+            logger.warning(
+                f"grad_accum_steps={gas} drops {steps_per_epoch % gas} "
+                f"trailing batch(es) per epoch ({steps_per_epoch} steps)"
+            )
+        train_step = jit_multi_step(
+            make_accum_train_step(
+                spec, loss_fn, compute_dtype=dtype, accum_steps=gas
+            ),
+            mesh,
+        )
+        logger.info(
+            f"grad_accum_steps={gas}: effective batch "
+            f"{args.batch_size * gas * jax.process_count()}, "
+            f"{steps_per_epoch // gas} updates/epoch"
+        )
+    elif spc > 1:
         # k updates scanned inside one jitted program (dispatch
         # amortization; step.py make_multi_train_step). Per-step output
         # metrics are skipped on this path — the scan returns no
@@ -379,7 +417,13 @@ def train_worker(args: Any) -> str:
     # Counted in optimizer steps regardless of --steps-per-call (each loop
     # iteration advances `spc` of them).
     profile_steps = int(getattr(args, "profile_steps", 0) or 0)
-    profile_from = 2 * spc  # skip the first two loop iterations
+    # Batches consumed per loop iteration on the packed path (steps-per-call
+    # runs kpack updates/call; grad accumulation runs ONE update over kpack
+    # micro-batches) — vs optimizer UPDATES per iteration, which is what
+    # _maybe_trace counts.
+    kpack = gas if gas > 1 else spc
+    updates_per_call = 1 if gas > 1 else spc
+    profile_from = 2 * updates_per_call  # skip the first two loop iterations
     tracing = False
 
     def _maybe_trace(opt_step: int, loss) -> None:
@@ -420,36 +464,39 @@ def train_worker(args: Any) -> str:
         deferred_losses: List[Any] = []
         global_bs = args.batch_size * jax.process_count()
 
-        if spc > 1:
-            # Packed multi-step path: one jitted call = spc updates; the
-            # per-call loss is already the mean over its micro-steps.
+        if kpack > 1:
+            # Packed path: one jitted call consumes kpack batches — either
+            # kpack sequential updates (--steps-per-call) or one
+            # accumulated update (--grad-accum-steps). The per-call loss is
+            # already the mean over its micro-batches.
             for call, (xk, yk) in enumerate(
                 pipeline.prefetch_packed_to_device(
-                    iter(train_loader), mesh, spc
+                    iter(train_loader), mesh, kpack
                 )
             ):
                 state, loss, _ = train_step(state, xk, yk, epoch_rng)
                 deferred_losses.append(loss)
-                _maybe_trace(call * spc, loss)
+                _maybe_trace(call * updates_per_call, loss)
                 if call % args.log_step == 0:
                     loss_f = float(loss)
                     loss_meter.update(loss_f, 1)
                     now = time.time()
                     calls_done = min(args.log_step, call) or 1
                     wps_meter.update(
-                        global_bs * spc * calls_done / max(now - t_step, 1e-9)
+                        global_bs * kpack * calls_done
+                        / max(now - t_step, 1e-9)
                     )
                     t_step = now
                     if writer is not None:
                         writer.add_scalar(
                             "train-loss/step",
                             loss_f,
-                            epoch * steps_per_epoch + call * spc,
+                            epoch * steps_per_epoch + call * kpack,
                         )
                     if is_main_process():
                         logger.info(
                             f"{args.model_name}_train "
-                            f"{progress.get_str(call * spc)}"
+                            f"{progress.get_str(call * kpack)}"
                         )
 
         else:

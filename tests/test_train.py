@@ -20,6 +20,7 @@ from seist_tpu.train import (
     jit_multi_step,
     jit_step,
     load_checkpoint,
+    make_accum_train_step,
     make_eval_step,
     make_multi_train_step,
     make_train_step,
@@ -395,3 +396,115 @@ def test_jit_eval_step_preserves_state(rng):
     # state must remain usable (no donation)
     tstep = jit_step(make_train_step(spec, loss_fn), donate_state=False)
     tstep(state, x, y, jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------- grad accumulation
+def test_accum_step_matches_big_batch(rng):
+    """k accumulated micro-batch gradients == ONE big-batch gradient, for a
+    BN-free model with a mean-reduced loss (make_accum_train_step's exact
+    regime — with BatchNorm the stats couple samples, so accumulation
+    matches small-batch BN semantics instead, covered by the smoke test
+    below)."""
+    from flax import linen as nn
+
+    k, b = 4, 2
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            h = nn.gelu(nn.Dense(8)(x))
+            return jax.nn.softmax(nn.Dense(3)(h), axis=-1)
+
+    model = Tiny()
+    variables = model.init(jax.random.PRNGKey(3), jnp.zeros((1, L, 3)))
+    spec = taskspec.get_task_spec("phasenet")  # CE on (N, L, 3) probs
+    loss_fn = taskspec.make_loss("phasenet")
+    xs, ys = [], []
+    for _ in range(k):
+        x, y = _fake_dpk_batch(rng, batch=b)
+        xs.append(x)
+        ys.append(y)
+    key = jax.random.PRNGKey(0)
+
+    def fresh_state():
+        return create_train_state(
+            model, {"params": variables["params"]}, build_optimizer("sgd", 1e-2)
+        )
+
+    big = jax.jit(make_train_step(spec, loss_fn))
+    s1, loss1, _ = big(
+        fresh_state(), jnp.concatenate(xs), jnp.concatenate(ys), key
+    )
+
+    accum = jax.jit(make_accum_train_step(spec, loss_fn, accum_steps=k))
+    s2, loss2, _ = accum(fresh_state(), jnp.stack(xs), jnp.stack(ys), key)
+
+    assert int(s2.step) == 1  # ONE optimizer update
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
+    for a, c in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(c), rtol=1e-5, atol=1e-7
+        )
+
+
+def test_accum_step_bn_smoke(rng):
+    """With a BatchNorm model: accumulation chains running stats through the
+    micro-steps (as k separate forwards) and applies one update."""
+    state, spec, loss_fn = _setup()
+    stats0 = jax.tree_util.tree_leaves(state.batch_stats)
+    batches = [_fake_dpk_batch(rng) for _ in range(2)]
+    xs = jnp.stack([b[0] for b in batches])
+    ys = jnp.stack([b[1] for b in batches])
+    accum = jax.jit(make_accum_train_step(spec, loss_fn, accum_steps=2))
+    state, loss, _ = accum(state, xs, ys, jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+    assert int(state.step) == 1
+    changed = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(stats0, jax.tree_util.tree_leaves(state.batch_stats))
+    )
+    assert changed
+
+
+def test_accum_one_is_plain_step():
+    spec = taskspec.get_task_spec("phasenet")
+    loss_fn = taskspec.make_loss("phasenet")
+    fn = make_accum_train_step(spec, loss_fn, accum_steps=1)
+    # accum_steps=1 falls back to the plain single-batch step signature.
+    assert fn.__name__ == "train_step"
+
+
+def test_accum_step_sharded_matches_single_device(rng):
+    """jit_multi_step's stacked-batch sharding (P(None, 'data')) applies to
+    the accumulation step too: a dp-sharded accumulated update must equal
+    the single-device one."""
+    assert jax.device_count() >= 8
+    model = api.create_model("phasenet", in_samples=L)
+    variables = api.init_variables(model, in_samples=L, batch_size=8)
+    state = create_train_state(model, variables, build_optimizer("sgd", 1e-2))
+    spec = taskspec.get_task_spec("phasenet")
+    loss_fn = taskspec.make_loss("phasenet")
+    batches = [_fake_dpk_batch(rng, batch=8) for _ in range(2)]
+    xs = jnp.stack([b[0] for b in batches])
+    ys = jnp.stack([b[1] for b in batches])
+    key = jax.random.PRNGKey(0)
+    accum = make_accum_train_step(spec, loss_fn, accum_steps=2)
+
+    s1, loss1, _ = jit_multi_step(accum, donate_state=False)(state, xs, ys, key)
+
+    mesh = make_mesh(data=8)
+    state_r = replicate(mesh, state)
+    from seist_tpu.parallel import shard_stacked_batch
+
+    xb, yb = shard_stacked_batch(mesh, (xs, ys))
+    s2, loss2, _ = jit_multi_step(accum, mesh=mesh, donate_state=False)(
+        state_r, xb, yb, key
+    )
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)

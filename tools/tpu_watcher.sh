@@ -1,6 +1,10 @@
 #!/bin/bash
-# Wait for the TPU tunnel, then run the conv-lowering A/B + missing matrix
-# configs. Results -> /root/repo/tools/ab_results.log (JSON lines).
+# Wait for the TPU tunnel, then capture whatever measurements are pending.
+# Round-2 (2026-07-30) pending list: the seist_l_dis bf16 matrix row
+# (tunnel wedged mid-sweep) and a fresh default-config bench.py line.
+# Results -> /root/repo/tools/ab_results.log (JSON lines) and the matrix
+# JSON files. Edit the "pending work" block as needs change; the probe /
+# wait loop is the reusable part.
 cd /root/repo
 probe() {
   timeout 70 python -c "
@@ -12,23 +16,11 @@ echo "watcher start $(date)" >> /root/repo/tools/ab_results.log
 until probe; do sleep 300; done
 echo "tunnel UP $(date)" >> /root/repo/tools/ab_results.log
 
-run() {  # run <label> <env...>
-  label="$1"; shift
-  echo "=== $label $(date)" >> /root/repo/tools/ab_results.log
-  env "$@" BENCH_STEPS=10 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=120 \
-    python bench.py 2>/dev/null >> /root/repo/tools/ab_results.log
-}
-
-run "seist_s NEW (shift+dense)" BENCH_MODEL=seist_s_dpk BENCH_BATCH=256
-run "seist_s OLD (grouped)" BENCH_MODEL=seist_s_dpk BENCH_BATCH=256 \
-  SEIST_DWCONV_IMPL=grouped SEIST_GCONV_IMPL=grouped
-run "seist_l NEW (shift+dense)" BENCH_MODEL=seist_l_dpk BENCH_BATCH=256
-run "seist_l OLD (grouped)" BENCH_MODEL=seist_l_dpk BENCH_BATCH=256 \
-  SEIST_DWCONV_IMPL=grouped SEIST_GCONV_IMPL=grouped
-run "seist_s einsum-gconv" BENCH_MODEL=seist_s_dpk BENCH_BATCH=256 \
-  SEIST_GCONV_IMPL=einsum
-echo "AB DONE $(date)" >> /root/repo/tools/ab_results.log
-
-python tools/bench_matrix.py --steps 15 \
-  --only seist_l_emg,seist_l_baz,seist_l_dis >> /root/repo/tools/ab_results.log 2>&1
+# ---- pending work ----
+BENCH_DTYPE=bf16 python tools/bench_matrix.py --steps 15 \
+  --only seist_l_dis --out tools/bench_matrix_bf16.json \
+  >> /root/repo/tools/ab_results.log 2>&1
+echo "=== default bench $(date)" >> /root/repo/tools/ab_results.log
+BENCH_STEPS=15 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=120 \
+  python bench.py 2>/dev/null >> /root/repo/tools/ab_results.log
 echo "ALL DONE $(date)" >> /root/repo/tools/ab_results.log
