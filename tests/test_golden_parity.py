@@ -117,7 +117,10 @@ def test_pretrained_forward_parity(ckpt, torch_models):
 # per-leaf gradients (VERDICT r1 #6).
 
 L_GRAD = 1024
-GRAD_MODELS = ["phasenet", "seist_s_dpk", "seist_m_dpk"]
+# eqtransformer exercises the scan-BiLSTM + additive-attention backward —
+# the converter splits torch's fused LSTM gates into OptimizedLSTMCell
+# leaves (tools/parity.py::_convert_lstm_group).
+GRAD_MODELS = ["phasenet", "seist_s_dpk", "seist_m_dpk", "eqtransformer"]
 
 
 def _dpk_batch(batch=2, length=L_GRAD):
@@ -151,7 +154,10 @@ def _flat_grads_from_torch(tm, shapes):
         key = tuple(str(k.key) for k in path)
         flat_target[key] = np.shape(leaf)
 
+    from parity import _convert_lstm_group, collect_lstm_leaf
+
     out = {}
+    lstm_groups = {}
     for tkey, p in tm.named_parameters():
         if p.grad is None:
             continue
@@ -160,9 +166,24 @@ def _flat_grads_from_torch(tm, shapes):
         coll, path = mapped
         if coll != "params":
             continue
+        if collect_lstm_leaf(path, p.grad.detach().cpu().numpy(), lstm_groups):
+            continue
         out[path] = _fit_leaf(
             p.grad.detach().cpu().numpy(), flat_target[path], tkey
         )
+    if lstm_groups:
+        ft = {("params", k): v for k, v in flat_target.items()}
+        for (prefix, direction), leaves in lstm_groups.items():
+            # The gate-split transform is linear so it maps grads too, with
+            # one twist: flax's single bias is torch's bias_ih + bias_hh, so
+            # dL/d(flax bias) == dL/d(bias_ih) == dL/d(bias_hh); the
+            # converter SUMS the two bias leaves, so zero one side.
+            leaves = dict(leaves)
+            leaves["bias_hh"] = np.zeros_like(leaves["bias_hh"])
+            for (_, pth), val in _convert_lstm_group(
+                prefix, direction, leaves, ft
+            ).items():
+                out[pth] = val
     return out
 
 
@@ -241,6 +262,9 @@ def _compare_grad_trees(
     silently exempt a corrupted small leaf):
 
     * ``k_proj/bias`` always: softmax is invariant to a uniform key shift.
+    * ``attn/ba`` always (eqtransformer): the additive-attention score bias
+      is a uniform shift under the softmax over L (ref
+      eqtransformer.py:135-198), so its gradient is identically 0.
     * ``expect_zero(key)`` per call: e.g. train-mode conv biases feeding
       straight into BatchNorm — the batch-mean subtraction cancels a
       uniform bias exactly, so its gradient is identically 0.
@@ -260,7 +284,7 @@ def _compare_grad_trees(
         a = np.asarray(g).ravel()
         b = t_grads[key].ravel()
         both_tiny = max(np.abs(a).max(), np.abs(b).max()) < 1e-6 * gscale
-        if key[-2:] == ("k_proj", "bias") or (
+        if key[-2:] == ("k_proj", "bias") or key[-2:] == ("attn", "ba") or (
             expect_zero is not None and expect_zero(key)
         ):
             assert both_tiny, f"{key}: expected ~0 grad"

@@ -54,6 +54,9 @@ def torch_key_to_flax(key: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
             parent == "out_norm"
             or bool(re.fullmatch(r"norm\d*", parent))
             or bool(re.fullmatch(r"bn\w*", parent))
+            # EQTransformer LayerNorms (ln0/ln1): weight -> scale, and LN has
+            # no running stats so only the params-collection entries fire.
+            or bool(re.fullmatch(r"ln\d*", parent))
         )
     is_norm_leaf = leaf in _BN_LEAVES and bool(norm_parent)
     if leaf == "num_batches_tracked":
@@ -93,6 +96,20 @@ def torch_key_to_flax(key: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
             out.append(f"{p[:-1]}{parts[i + 1]}")
             i += 2
         elif (
+            p in ("res_convs", "bilstms", "transformers", "decoders", "upsamplings")
+            and i + 1 < len(parts)
+            and parts[i + 1].isdigit()
+        ):
+            # EQTransformer lists (ref eqtransformer.py:269-614):
+            # res_convs.{k} -> resconv{k}, bilstms.{k} -> bilstm{k},
+            # transformers.{k} -> transformer{k}, decoders.{k} -> decoder{k},
+            # upsamplings.{j} -> up{j}.
+            name = {"res_convs": "resconv", "bilstms": "bilstm",
+                    "transformers": "transformer", "decoders": "decoder",
+                    "upsamplings": "up"}[p]
+            out.append(f"{name}{parts[i + 1]}")
+            i += 2
+        elif (
             p in ("down_convs", "up_convs")
             and i + 1 < len(parts)
             and parts[i + 1].isdigit()
@@ -118,7 +135,14 @@ def _fit_leaf(value: np.ndarray, target_shape: Tuple[int, ...], key: str) -> np.
     transpose; only 1-D vectors pass through.
     """
     v = np.asarray(value)
-    if v.ndim <= 1:
+    leaf_name = key.split(".")[-1]
+    if leaf_name in ("Wx", "Wt", "Wa"):
+        # EQTransformer additive-attention weights are raw nn.Parameters
+        # used as x @ W on BOTH sides (ref eqtransformer.py:135-198, ours
+        # models/eqtransformer.py AttentionLayer) — same orientation, no
+        # transpose.
+        t = v
+    elif v.ndim <= 1:
         t = v
     elif ".convt." in f".{key}." and v.ndim == 3:
         # torch ConvTranspose1d (in,out,k) -> flax ConvTranspose kernel
@@ -141,6 +165,90 @@ def _fit_leaf(value: np.ndarray, target_shape: Tuple[int, ...], key: str) -> np.
     return t
 
 
+_LSTM_LEAF_RE = re.compile(r"(weight|bias)_(ih|hh)_l0(_reverse)?")
+
+
+def collect_lstm_leaf(
+    path: Tuple[str, ...],
+    value: np.ndarray,
+    groups: Dict[Tuple[Tuple[str, ...], str], Dict[str, np.ndarray]],
+) -> bool:
+    """If ``path`` (a mapped flax path) ends in a torch fused-LSTM leaf,
+    stash it in ``groups`` keyed by (module prefix, direction) for
+    :func:`_convert_lstm_group` and return True; else return False. Shared
+    by convert_state_dict and the gradient-parity test so the grouping
+    rules live in one place."""
+    m = _LSTM_LEAF_RE.fullmatch(path[-1])
+    if not m:
+        return False
+    direction = "bwd" if m.group(3) else "fwd"
+    groups.setdefault((path[:-1], direction), {})[
+        f"{m.group(1)}_{m.group(2)}"
+    ] = np.asarray(value)
+    return True
+
+
+def _convert_lstm_group(
+    prefix: Tuple[str, ...],
+    direction: str,
+    leaves: Dict[str, np.ndarray],
+    flat_target: Dict[Tuple[str, Tuple[str, ...]], Tuple[int, ...]],
+) -> Dict[Tuple[str, Tuple[str, ...]], np.ndarray]:
+    """torch nn.LSTM -> flax OptimizedLSTMCell leaves.
+
+    torch fuses the four gates as (4H, *) rows in order [i, f, g, o]
+    and carries TWO bias vectors (bias_ih + bias_hh); flax's
+    OptimizedLSTMCell keeps per-gate Dense layers — input kernels
+    ``i{g}`` (no bias) and recurrent kernels ``h{g}`` (with bias), so the
+    flax bias is the SUM of torch's two (they are always added together in
+    the gate preactivation). BiLSTM directions map to the fwd/bwd
+    submodules (ours models/common.py::BiLSTM); the `_reverse` suffix is
+    torch's backward direction.
+    """
+    cell = "OptimizedLSTMCell_0"
+    cand_a = prefix + (direction, cell)
+    cand_b = prefix + (cell,)
+    if ("params", cand_a + ("ii", "kernel")) in flat_target:
+        base = cand_a
+    elif ("params", cand_b + ("ii", "kernel")) in flat_target:
+        if direction == "bwd":
+            raise KeyError(
+                f"reverse LSTM weights for {'/'.join(prefix)} but the flax "
+                "module is unidirectional"
+            )
+        base = cand_b
+    else:
+        raise KeyError(f"no flax LSTM cell found under {'/'.join(prefix)}")
+
+    required = {"weight_ih", "weight_hh", "bias_ih", "bias_hh"}
+    if set(leaves) != required:
+        raise KeyError(
+            f"incomplete torch LSTM group {'/'.join(prefix)} ({direction}): "
+            f"{sorted(leaves)}"
+        )
+
+    out: Dict[Tuple[str, Tuple[str, ...]], np.ndarray] = {}
+    gates = "ifgo"
+    w_ih = np.split(leaves["weight_ih"], 4, axis=0)
+    w_hh = np.split(leaves["weight_hh"], 4, axis=0)
+    b = np.split(leaves["bias_ih"] + leaves["bias_hh"], 4, axis=0)
+    for k, g in enumerate(gates):
+        for path, val in (
+            (base + (f"i{g}", "kernel"), w_ih[k].T),
+            (base + (f"h{g}", "kernel"), w_hh[k].T),
+            (base + (f"h{g}", "bias"), b[k]),
+        ):
+            tgt = flat_target.get(("params", path))
+            if tgt is None:
+                raise KeyError(f"unknown flax LSTM leaf {'/'.join(path)}")
+            if tuple(val.shape) != tuple(tgt):
+                raise ValueError(
+                    f"LSTM leaf {'/'.join(path)}: {val.shape} != {tgt}"
+                )
+            out[("params", path)] = val
+    return out
+
+
 def convert_state_dict(
     state_dict: Dict[str, Any], flax_variables: Dict[str, Any]
 ) -> Dict[str, Any]:
@@ -158,11 +266,17 @@ def convert_state_dict(
             flat_target[(coll, key)] = np.shape(leaf)
 
     converted: Dict[Tuple[str, Tuple[str, ...]], np.ndarray] = {}
+    lstm_groups: Dict[Tuple[Tuple[str, ...], str], Dict[str, np.ndarray]] = {}
     for tkey, tval in state_dict.items():
         mapped = torch_key_to_flax(tkey)
         if mapped is None:
             continue
         coll, path = mapped
+        # torch nn.LSTM fused leaves -> collected per (module, direction)
+        # and split into flax OptimizedLSTMCell gates below.
+        val = tval.detach().cpu().numpy() if hasattr(tval, "detach") else tval
+        if collect_lstm_leaf(path, val, lstm_groups):
+            continue
         if (coll, path) not in flat_target:
             raise KeyError(
                 f"torch key '{tkey}' mapped to unknown flax leaf {coll}/{'/'.join(path)}"
@@ -171,6 +285,11 @@ def convert_state_dict(
             tval.detach().cpu().numpy() if hasattr(tval, "detach") else tval,
             flat_target[(coll, path)],
             tkey,
+        )
+
+    for (prefix, direction), leaves in lstm_groups.items():
+        converted.update(
+            _convert_lstm_group(prefix, direction, leaves, flat_target)
         )
 
     missing = set(flat_target) - set(converted)
