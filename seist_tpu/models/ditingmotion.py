@@ -99,7 +99,12 @@ class SideLayer(nn.Module):
             # (ref: ditingmotion.py:157-161).
             target = self.linear_in_dim // self.conv_out_channels
             x = common.interpolate_nearest(x, target)
-        x1 = x.reshape(N, -1)
+        # Flatten CHANNEL-major to match torch's Flatten over (N, C, L)
+        # (ref: ditingmotion.py:141,163): lin0/fuse weights consume features
+        # in [c0 l0..l{L-1}, c1 l0..] order, so a channels-last reshape
+        # without the transpose would permute their input columns (caught
+        # by the gradient-parity test with converted weights).
+        x1 = jnp.swapaxes(x, 1, 2).reshape(N, -1)
         x2 = nn.relu(nn.Dense(self.linear_hidden_dim, name="lin0")(x1))
         x3 = nn.sigmoid(nn.Dense(self.linear_out_dim, name="lin1")(x2))
         return x1, x2, x3

@@ -120,7 +120,39 @@ L_GRAD = 1024
 # eqtransformer exercises the scan-BiLSTM + additive-attention backward —
 # the converter splits torch's fused LSTM gates into OptimizedLSTMCell
 # leaves (tools/parity.py::_convert_lstm_group).
-GRAD_MODELS = ["phasenet", "seist_s_dpk", "seist_m_dpk", "eqtransformer"]
+# magnet covers the fused-LSTM split at hidden 100 + MousaviLoss; ditingmotion
+# covers CombConv/side-fusion + dual Focal loss (and pinned the channel-major
+# flatten fix in models/ditingmotion.py::SideLayer). baz_network is excluded:
+# its eigen feature branch uses eigh on the symmetric covariance where the
+# reference uses no-grad general eig — eigenvalue ordering/eigenvector sign
+# conventions differ, so forward activations (and hence all grads) diverge by
+# design (BASELINE.md design notes; the branch is no-grad in BOTH frameworks).
+GRAD_MODELS = [
+    "phasenet",
+    "seist_s_dpk",
+    "seist_m_dpk",
+    "eqtransformer",
+    "magnet",
+    "ditingmotion",
+]
+
+
+def _grad_case(model_name):
+    """(x, in_channels, y) for one gradient-parity case; the torch-side
+    target is derived from ``y`` in the test (transpose for dense labels,
+    per-element tensors for tuple labels)."""
+    rng = np.random.default_rng(7)
+    if model_name == "magnet":
+        x = rng.standard_normal((2, L_GRAD, 3)).astype(np.float32)
+        y = rng.uniform(1.0, 6.0, (2, 1)).astype(np.float32)
+        return x, 3, y
+    if model_name == "ditingmotion":
+        x = rng.standard_normal((2, L_GRAD, 2)).astype(np.float32)
+        clr = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 2)]
+        pmp = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 2)]
+        return x, 2, (clr, pmp)
+    x, y = _dpk_batch()
+    return x, 3, y
 
 
 def _dpk_batch(batch=2, length=L_GRAD):
@@ -187,9 +219,9 @@ def _flat_grads_from_torch(tm, shapes):
     return out
 
 
-def _torch_state_dict(model_name, torch_models):
+def _torch_state_dict(model_name, torch_models, in_channels=3):
     """Shipped pretrained weights for seist models; the 18 published
-    checkpoints are all seist variants, so phasenet uses a seeded
+    checkpoints are all seist variants, so other models use a seeded
     random-init torch model's state-dict instead."""
     import torch
 
@@ -197,7 +229,7 @@ def _torch_state_dict(model_name, torch_models):
     if os.path.exists(path):
         return torch.load(path, map_location="cpu", weights_only=True)
     torch.manual_seed(0)
-    tm = torch_models(model_name, in_channels=3, in_samples=L_GRAD)
+    tm = torch_models(model_name, in_channels=in_channels, in_samples=L_GRAD)
     return tm.state_dict()
 
 
@@ -213,18 +245,21 @@ def test_gradient_parity_eval_mode(model_name, torch_models):
 
     from seist_tpu import taskspec
 
-    sd = _torch_state_dict(model_name, torch_models)
-    model = api.create_model(model_name, in_samples=L_GRAD)
-    shapes = api.param_shapes(model, in_samples=L_GRAD)
+    x, in_ch, y = _grad_case(model_name)
+    sd = _torch_state_dict(model_name, torch_models, in_channels=in_ch)
+    model = api.create_model(model_name, in_samples=L_GRAD, in_channels=in_ch)
+    shapes = api.param_shapes(model, in_samples=L_GRAD, in_channels=in_ch)
     variables = convert_state_dict(sd, shapes)
-    x, y = _dpk_batch()
 
     flax_loss = taskspec.make_loss(model_name)
     spec = taskspec.get_task_spec(model_name)
 
     def loss_fn(params):
+        var = {"params": params}
+        if "batch_stats" in variables:  # ditingmotion/magnet have no BN
+            var["batch_stats"] = variables["batch_stats"]
         out = model.apply(
-            {"params": params, "batch_stats": variables["batch_stats"]},
+            var,
             x,
             train=False,
         )
@@ -235,12 +270,16 @@ def test_gradient_parity_eval_mode(model_name, torch_models):
 
     our_loss, our_grads = jax.value_and_grad(loss_fn)(variables["params"])
 
-    tm = torch_models(model_name, in_channels=3, in_samples=L_GRAD)
+    tm = torch_models(model_name, in_channels=in_ch, in_samples=L_GRAD)
     tm.load_state_dict(sd)
     tm.eval()
     tl_fn = _torch_loss_for(model_name)
     tx = torch.from_numpy(x.transpose(0, 2, 1))
-    ty = torch.from_numpy(y.transpose(0, 2, 1))
+    if isinstance(y, tuple):
+        ty = [torch.from_numpy(t) for t in y]
+    else:
+        ty = torch.from_numpy(y)
+        ty = ty.permute(0, 2, 1) if ty.ndim == 3 else ty
     t_out = tm(tx)
     t_loss = tl_fn(t_out, ty)
     t_loss.backward()

@@ -95,6 +95,54 @@ def torch_key_to_flax(key: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
         ):
             out.append(f"{p[:-1]}{parts[i + 1]}")
             i += 2
+            # DiTingMotion's CombConvLayer wraps each conv in a Sequential
+            # (convs.{a}.0.weight, ref ditingmotion.py:38-80); swallow the
+            # position index. Only '.0' (the conv) carries params — any other
+            # slot stays unswallowed so it fails loudly as an unmapped key
+            # instead of silently overwriting conv{a}.
+            if i < len(parts) - 1 and parts[i] == "0":
+                i += 1
+        elif p == "conv_layers" and i + 1 < len(parts) and parts[i + 1].isdigit():
+            # Context-dependent: inside a DiTingMotion block (block{n} just
+            # emitted) conv_layers.{j} is the j-th CombConvLayer -> comb{j}
+            # (ref ditingmotion.py:83-117); at MagNet's top level it is the
+            # j-th ConvBlock -> conv{j} (ref magnet.py:36-61).
+            kind = "comb" if (out and out[-1].startswith("block")) else "conv"
+            out.append(f"{kind}{parts[i + 1]}")
+            i += 2
+        elif p == "blocks" and i + 1 < len(parts) and parts[i + 1].isdigit():
+            out.append(f"block{parts[i + 1]}")
+            i += 2
+        elif (
+            p in ("clarity_side_layers", "polarity_side_layers")
+            and i + 1 < len(parts)
+            and parts[i + 1].isdigit()
+        ):
+            out.append(f"{p[: -len('_layers')]}{parts[i + 1]}")
+            i += 2
+        elif (
+            p in ("fuse_clarity", "fuse_polarity")
+            and i + 1 < len(parts)
+            and parts[i + 1].isdigit()
+        ):
+            out.append(f"{p}{parts[i + 1]}")
+            i += 2
+        elif (
+            p == "layers"
+            and i + 2 < len(parts)
+            and parts[i + 1].isdigit()
+            and parts[i + 2] == "0"
+        ):
+            # BAZ-Network wave branch: layers.{k} is Sequential(conv, act) —
+            # only slot 0 (the conv) has params -> wave_conv{k}
+            # (ref baz_network.py:17-121).
+            out.append(f"wave_conv{parts[i + 1]}")
+            i += 3
+        elif p == "conv_blocks" and i + 1 < len(parts) and parts[i + 1].isdigit():
+            # distPT TCN residual blocks -> tcn/block{k}
+            # (ref distpt_network.py:37-135).
+            out.append(f"block{parts[i + 1]}")
+            i += 2
         elif (
             p in ("res_convs", "bilstms", "transformers", "decoders", "upsamplings")
             and i + 1 < len(parts)
@@ -208,6 +256,17 @@ def _convert_lstm_group(
     cell = "OptimizedLSTMCell_0"
     cand_a = prefix + (direction, cell)
     cand_b = prefix + (cell,)
+    if prefix and prefix[-1] == "lstm" and not any(
+        ("params", prefix + tail + ("ii", "kernel")) in flat_target
+        for tail in ((direction, cell), (cell,))
+    ):
+        # MagNet names its torch module `lstm` but it is bidirectional and
+        # ours is named `bilstm` (models/magnet.py); retarget the prefix.
+        alt = prefix[:-1] + ("bilstm",)
+        if ("params", alt + (direction, cell, "ii", "kernel")) in flat_target:
+            prefix = alt
+            cand_a = prefix + (direction, cell)
+            cand_b = prefix + (cell,)
     if ("params", cand_a + ("ii", "kernel")) in flat_target:
         base = cand_a
     elif ("params", cand_b + ("ii", "kernel")) in flat_target:
