@@ -464,3 +464,35 @@ def test_pretrained_forward_parity_tpu_lowerings(torch_models, monkeypatch):
     with torch.no_grad():
         ref = tm(torch.from_numpy(x.transpose(0, 2, 1))).numpy()
     np.testing.assert_allclose(ours, ref.transpose(0, 2, 1), atol=1e-4, rtol=1e-3)
+
+
+def test_distpt_random_init_forward_parity(torch_models):
+    """distpt_network has no task spec (the reference ships its config
+    commented out, ref config.py:112-125), so it gets forward parity with
+    a seeded random-init torch state-dict instead of a gradient test —
+    covering the causal-TCN trunk and both regression heads."""
+    import torch
+
+    from parity import convert_state_dict
+
+    sd = _torch_state_dict("distpt_network", torch_models)
+    model = api.create_model("distpt_network", in_samples=L_GRAD)
+    shapes = api.param_shapes(model, in_samples=L_GRAD)
+    variables = convert_state_dict(sd, shapes)
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, L_GRAD, 3)).astype(np.float32)
+    ours = _as_tuple(model.apply(variables, x, train=False))
+
+    tm = torch_models("distpt_network", in_channels=3, in_samples=L_GRAD)
+    tm.load_state_dict(sd)
+    tm.eval()
+    with torch.no_grad():
+        ref = _as_tuple(tm(torch.from_numpy(x.transpose(0, 2, 1))))
+
+    assert len(ours) == len(ref)
+    for o, r in zip(ours, ref):
+        # Both heads are (N, 2) regression outputs — no layout transpose.
+        np.testing.assert_allclose(
+            np.asarray(o), r.numpy(), atol=1e-5, rtol=1e-4
+        )
