@@ -36,7 +36,9 @@ def main() -> None:
     parser.add_argument("--model-name", required=True, type=str,
                         help="registered model name, e.g. seist_s_dpk")
     parser.add_argument("--in-samples", default=8192, type=int)
-    parser.add_argument("--in-channels", default=3, type=int)
+    parser.add_argument("--in-channels", default=None, type=int,
+                        help="default: the model's task-spec input count "
+                        "(3 for most, 2 for ditingmotion's [z, dz])")
     parser.add_argument("--out", required=True, type=str,
                         help="output orbax checkpoint directory")
     args = parser.parse_args()
@@ -65,6 +67,16 @@ def main() -> None:
         k.removeprefix("module.").removeprefix("_orig_mod."): v
         for k, v in sd.items()
     }
+
+    if args.in_channels is None:
+        from seist_tpu import taskspec
+
+        try:
+            args.in_channels = taskspec.get_num_inchannels(args.model_name)
+        except KeyError:
+            # distpt_network has no task spec (ref ships its config
+            # commented out); every spec-less model takes 3-channel input.
+            args.in_channels = 3
 
     model = api.create_model(
         args.model_name,

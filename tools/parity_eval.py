@@ -53,6 +53,32 @@ def _run(cmd, env=None, timeout=3600) -> str:
     return r.stdout
 
 
+def _make_random_init_pth(
+    model_name: str, in_samples: int, seed: int, out_path: str
+) -> None:
+    """Seeded random-init torch state-dict from the READ-ONLY reference
+    registry (shared timm stub from tools/bench_reference.py)."""
+    import torch
+
+    from bench_reference import _install_timm_stub
+
+    _install_timm_stub()
+    if "/root/reference" not in sys.path:
+        sys.path.insert(0, "/root/reference")
+    from models import create_model as torch_create  # reference registry
+
+    from seist_tpu import taskspec
+
+    torch.manual_seed(seed)
+    tm = torch_create(
+        model_name,
+        in_channels=taskspec.get_num_inchannels(model_name),
+        in_samples=in_samples,
+    )
+    torch.save(tm.state_dict(), out_path)
+    print(f"random-init state dict -> {out_path}", file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model-name", default="seist_s_dpk")
@@ -68,15 +94,40 @@ def main() -> None:
         "--workdir", default=os.path.join(_REPO, "logs", "parity_eval")
     )
     ap.add_argument("--keep-workdir", action="store_true")
+    ap.add_argument(
+        "--random-init-seed",
+        type=int,
+        default=None,
+        help="for models WITHOUT a published reference checkpoint (e.g. "
+        "eqtransformer — the 18 shipped .pth are all seist variants): "
+        "generate a seeded random-init torch state-dict and run both "
+        "pipelines with it. The metrics are then meaningless as accuracy "
+        "but must still MATCH — this compares the pipelines, not the "
+        "model quality.",
+    )
     args = ap.parse_args()
 
+    os.makedirs(args.workdir, exist_ok=True)
     pth = os.path.join(
         "/root/reference/pretrained", f"{args.model_name}_diting.pth"
     )
     if not os.path.exists(pth):
-        raise FileNotFoundError(pth)
+        if args.random_init_seed is None:
+            raise FileNotFoundError(
+                f"{pth} (pass --random-init-seed N to compare pipelines "
+                "with generated weights)"
+            )
+        # Cache key carries seed AND in_samples: a bare model-name key
+        # would silently reuse stale weights when either changes (and the
+        # imported-orbax cache below must track the same identity or the
+        # two sides could load different weights).
+        tag = f"{args.model_name}_s{args.random_init_seed}_l{args.in_samples}"
+        pth = os.path.join(args.workdir, f"random_{tag}.pth")
+        if not os.path.exists(pth):
+            _make_random_init_pth(
+                args.model_name, args.in_samples, args.random_init_seed, pth
+            )
 
-    os.makedirs(args.workdir, exist_ok=True)
     fixture = os.path.join(args.workdir, "diting_fixture")
     if not os.path.exists(os.path.join(fixture, "DiTing330km_light.csv")):
         print("writing fixture ...", file=sys.stderr, flush=True)
@@ -120,7 +171,11 @@ def main() -> None:
     )
 
     # --- our side: import weights, then the production test CLI ---
-    ckpt = os.path.join(args.workdir, "imported", args.model_name)
+    # Key the imported-orbax cache by the SOURCE .pth filename so the
+    # random-init tag (seed/in_samples) flows through.
+    ckpt = os.path.join(
+        args.workdir, "imported", os.path.splitext(os.path.basename(pth))[0]
+    )
     if not os.path.exists(ckpt):
         _run(
             [
