@@ -148,3 +148,18 @@ def test_eval_mask_excludes_padding(rng):
     loss_masked, _ = estep(state, x2, y, half_mask)
     loss_ref, _ = estep(state, x, y, half_mask)
     assert float(loss_masked) == pytest.approx(float(loss_ref), rel=1e-5)
+
+
+def test_train_with_grad_accum(tmp_path):
+    """--grad-accum-steps e2e: the worker routes k loader batches into one
+    scanned update (step.py make_accum_train_step) and still produces a
+    loadable checkpoint + test metrics."""
+    from seist_tpu.train.worker import test_worker, train_worker
+
+    logger.set_logdir(str(tmp_path))
+    args = make_args(grad_accum_steps=2, epochs=1)
+    ckpt = train_worker(args)
+    assert ckpt and os.path.exists(ckpt)
+    args.checkpoint = ckpt
+    loss = test_worker(args)
+    assert np.isfinite(loss)
