@@ -414,8 +414,8 @@ def train_worker(args: Any) -> str:
 
     # --profile-steps N: capture a jax.profiler trace of N steady-state
     # OPTIMIZER steps (skipping compile/warmup) in the first trained epoch.
-    # Counted in optimizer steps regardless of --steps-per-call (each loop
-    # iteration advances `spc` of them).
+    # Counted in optimizer steps regardless of the packed path (each loop
+    # iteration advances `updates_per_call` of them).
     profile_steps = int(getattr(args, "profile_steps", 0) or 0)
     # Batches consumed per loop iteration on the packed path (steps-per-call
     # runs kpack updates/call; grad accumulation runs ONE update over kpack

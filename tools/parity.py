@@ -37,6 +37,28 @@ _BN_MAP = {
 _BN_LEAVES = set(_BN_MAP) | {"num_batches_tracked"}
 
 
+# torch nn.ModuleList/Sequential list-module name -> flax per-index prefix
+# (list.{k}.* -> prefix{k}/*). One table instead of one elif per model family;
+# reference anchors: eqtransformer.py:269-614 (res_convs/bilstms/transformers/
+# decoders/upsamplings), ditingmotion.py:174-335 (blocks/side/fuse lists),
+# distpt_network.py:37-135 (conv_blocks), phasenet.py:152-267 (down/up_convs).
+_LIST_MODULES = {
+    "blocks": "block",
+    "conv_blocks": "block",  # distPT TCN residual blocks live under tcn/
+    "clarity_side_layers": "clarity_side",
+    "polarity_side_layers": "polarity_side",
+    "fuse_clarity": "fuse_clarity",
+    "fuse_polarity": "fuse_polarity",
+    "res_convs": "resconv",
+    "bilstms": "bilstm",
+    "transformers": "transformer",
+    "decoders": "decoder",
+    "upsamplings": "up",
+    "down_convs": "down",
+    "up_convs": "up",
+}
+
+
 def torch_key_to_flax(key: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
     """Map one torch state-dict key to (collection, flax path) or None to skip."""
     parts = key.split(".")
@@ -110,22 +132,12 @@ def torch_key_to_flax(key: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
             kind = "comb" if (out and out[-1].startswith("block")) else "conv"
             out.append(f"{kind}{parts[i + 1]}")
             i += 2
-        elif p == "blocks" and i + 1 < len(parts) and parts[i + 1].isdigit():
-            out.append(f"block{parts[i + 1]}")
-            i += 2
         elif (
-            p in ("clarity_side_layers", "polarity_side_layers")
+            p in _LIST_MODULES
             and i + 1 < len(parts)
             and parts[i + 1].isdigit()
         ):
-            out.append(f"{p[: -len('_layers')]}{parts[i + 1]}")
-            i += 2
-        elif (
-            p in ("fuse_clarity", "fuse_polarity")
-            and i + 1 < len(parts)
-            and parts[i + 1].isdigit()
-        ):
-            out.append(f"{p}{parts[i + 1]}")
+            out.append(f"{_LIST_MODULES[p]}{parts[i + 1]}")
             i += 2
         elif (
             p == "layers"
@@ -138,34 +150,6 @@ def torch_key_to_flax(key: str) -> Optional[Tuple[str, Tuple[str, ...]]]:
             # (ref baz_network.py:17-121).
             out.append(f"wave_conv{parts[i + 1]}")
             i += 3
-        elif p == "conv_blocks" and i + 1 < len(parts) and parts[i + 1].isdigit():
-            # distPT TCN residual blocks -> tcn/block{k}
-            # (ref distpt_network.py:37-135).
-            out.append(f"block{parts[i + 1]}")
-            i += 2
-        elif (
-            p in ("res_convs", "bilstms", "transformers", "decoders", "upsamplings")
-            and i + 1 < len(parts)
-            and parts[i + 1].isdigit()
-        ):
-            # EQTransformer lists (ref eqtransformer.py:269-614):
-            # res_convs.{k} -> resconv{k}, bilstms.{k} -> bilstm{k},
-            # transformers.{k} -> transformer{k}, decoders.{k} -> decoder{k},
-            # upsamplings.{j} -> up{j}.
-            name = {"res_convs": "resconv", "bilstms": "bilstm",
-                    "transformers": "transformer", "decoders": "decoder",
-                    "upsamplings": "up"}[p]
-            out.append(f"{name}{parts[i + 1]}")
-            i += 2
-        elif (
-            p in ("down_convs", "up_convs")
-            and i + 1 < len(parts)
-            and parts[i + 1].isdigit()
-        ):
-            # phasenet U-Net lists: down_convs.{i} -> down{i}, up_convs.{j}
-            # -> up{j} (ref phasenet.py:152-267).
-            out.append(f"{p.split('_')[0]}{parts[i + 1]}")
-            i += 2
         else:
             out.append(p)
             i += 1
