@@ -256,19 +256,50 @@ class DepthwiseConv1D(nn.Module):
 
 
 def depthwise_shift_fma(x: Array, w: Array, stride: int) -> Array:
-    """VALID depthwise conv as k strided-slice multiply-adds.
+    """VALID depthwise conv as k shifted multiply-adds.
 
     ``x`` is (N, L, C), ``w`` is (k, C); returns (N, L_out, C). Pure VPU
     elementwise work that XLA fuses into one kernel — the lowering behind
     :class:`DepthwiseConv1D` (impl='shift'), shared with the merged stem
     path in models/seist.py which runs it on a zero-padded multi-kernel
-    bank."""
+    bank.
+
+    For ``stride > 1`` the taps are NOT taken as strided slices
+    ``x[..., j:j+span:s, :]``: the transpose (gradient) of a strided slice
+    lowers on TPU to generic scatter-adds with s32 index vectors and flips
+    the activation layout to batch-minor with full-tensor copies — profiled
+    at ~6 ms/step in each of SeisT's two stride-2 stems (the same pathology
+    that sank the merged-stem lowering, BASELINE.md). Instead the length
+    axis is phase-split by a reshape ``(N, L/s, s, C)``; tap ``j`` is then a
+    *contiguous* slice of phase plane ``j % s`` shifted by ``j // s``, whose
+    gradient is a plain zero-pad that XLA fuses (pad_add_fusion) like the
+    stride-1 case."""
     k, s = int(w.shape[0]), stride
     out_len = (x.shape[-2] - k) // s + 1
-    span = (out_len - 1) * s + 1
-    acc = x[..., 0:span:s, :] * w[0]
-    for j in range(1, k):
-        acc = acc + x[..., j : j + span : s, :] * w[j]
+    if s == 1:
+        acc = x[..., 0:out_len, :] * w[0]
+        for j in range(1, k):
+            acc = acc + x[..., j : j + out_len, :] * w[j]
+        return acc
+    # Right-pad with zeros to a multiple of s covering every tap's window.
+    # The padding is never read: tap j uses phase rows j//s .. j//s+out_len-1
+    # and (out_len-1) + (k-1)//s < ceil(L/s) by construction.
+    lead = x.shape[:-2]
+    L, C = x.shape[-2], x.shape[-1]
+    n_rows = -(-L // s)
+    pads = [(0, 0)] * x.ndim
+    pads[-2] = (0, n_rows * s - L)
+    xp = jnp.pad(x, pads).reshape(*lead, n_rows, s, C)
+    acc = None
+    for phase in range(s):
+        plane = xp[..., :, phase, :]
+        taps = [j for j in range(k) if j % s == phase]
+        if not taps:
+            continue
+        part = plane[..., taps[0] // s : taps[0] // s + out_len, :] * w[taps[0]]
+        for j in taps[1:]:
+            part = part + plane[..., j // s : j // s + out_len, :] * w[j]
+        acc = part if acc is None else acc + part
     return acc
 
 

@@ -84,7 +84,19 @@ class TestConvLowerings:
     nn.Conv(feature_group_count=...) it replaces, on the same param tree
     (checkpoint compatibility is the contract — models/common.py)."""
 
-    @pytest.mark.parametrize("k,s,C,L", [(11, 2, 16, 64), (5, 1, 8, 33)])
+    @pytest.mark.parametrize(
+        "k,s,C,L",
+        [
+            (11, 2, 16, 64),
+            (5, 1, 8, 33),
+            # phase-split stride path (common.depthwise_shift_fma s>1):
+            # odd L, k<s taps empty phases, k%s==0, stride>2
+            (10, 2, 3, 57),
+            (3, 2, 5, 33),
+            (4, 4, 8, 41),
+            (7, 3, 4, 50),
+        ],
+    )
     @pytest.mark.parametrize("impl", ["shift", "grouped"])
     def test_depthwise_matches_nn_conv(self, rng, k, s, C, L, impl):
         from flax import linen as nn
@@ -102,6 +114,25 @@ class TestConvLowerings:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), atol=2e-6
         )
+
+    @pytest.mark.parametrize("k,s,C,L", [(10, 2, 3, 57), (7, 3, 4, 50)])
+    def test_depthwise_shift_gradients_match_grouped(self, rng, k, s, C, L):
+        """The phase-split stride path must be gradient-exact vs the
+        lax grouped-conv lowering (both d/dx and d/dw) — the backward is
+        exactly what the phase-split reshape exists to reroute."""
+        x = jnp.asarray(rng.standard_normal((2, L, C)), jnp.float32)
+        kern = jnp.asarray(rng.standard_normal((k, 1, C)), jnp.float32)
+
+        def loss(impl, x, kern):
+            y = common.DepthwiseConv1D(C, k, stride=s, impl=impl).apply(
+                {"params": {"kernel": kern}}, x
+            )
+            return jnp.sum(jnp.sin(y) * y)
+
+        gx_s, gw_s = jax.grad(lambda x, w: loss("shift", x, w), (0, 1))(x, kern)
+        gx_g, gw_g = jax.grad(lambda x, w: loss("grouped", x, w), (0, 1))(x, kern)
+        np.testing.assert_allclose(np.asarray(gx_s), np.asarray(gx_g), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(gw_s), np.asarray(gw_g), atol=2e-5)
 
     @pytest.mark.parametrize(
         "k,cin,cout,g", [(3, 24, 24, 3), (7, 96, 96, 12), (5, 32, 64, 4)]
