@@ -183,10 +183,17 @@ def _interpolate_linear_intscale(x: Array, r: int) -> Array:
 
 
 def interpolate_nearest(x: Array, out_size: int) -> Array:
-    """F.interpolate(mode='nearest') parity for (N, L, C)."""
+    """F.interpolate(mode='nearest') parity for (N, L, C).
+
+    Integer upscale factors take the gather-free ``jnp.repeat`` path —
+    ``floor(d * L/out)`` with ``out = r*L`` is exactly ``d // r`` — so the
+    backward is a clean windowed reduce instead of a scatter (same
+    motivation as the integer path of :func:`interpolate_linear`)."""
     L_in = x.shape[-2]
     if L_in == out_size:
         return x
+    if out_size % L_in == 0:
+        return jnp.repeat(x, out_size // L_in, axis=-2)
     idx = jnp.floor(jnp.arange(out_size, dtype=jnp.float32) * (L_in / out_size))
     return x[:, idx.astype(jnp.int32), :]
 

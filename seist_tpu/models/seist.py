@@ -85,8 +85,40 @@ class MLP(nn.Module):
         return x
 
 
+def _triple_product_kernel(w_in: Array, w_d: Array, w_p: Array) -> Array:
+    """The DSConv pipeline's exact collapse into one dense conv kernel:
+    ``A[j,c,o] = sum_d w_in[c,d] * w_d[j,d] * w_p[d,o]`` (valid because the
+    three stages have no bias and no nonlinearity between them). fp32
+    accumulation regardless of the compute dtype — under bf16 policy this
+    rounds ONCE (at the caller's cast) where the staged pipeline rounds
+    after each of the three matmuls. Shared by DSConvNormAct._composed and
+    StemBlock._fused_paths."""
+    return jnp.einsum(
+        "cd,jd,do->jco",
+        w_in,
+        w_d,
+        w_p,
+        preferred_element_type=jnp.float32,
+    )
+
+
 class DSConvNormAct(nn.Module):
-    """Depthwise-separable conv (ref: seist.py:124-155)."""
+    """Depthwise-separable conv (ref: seist.py:124-155).
+
+    Two checkpoint-identical lowerings (``impl`` / env SEIST_DSCONV_IMPL):
+
+    * ``'paths'`` — the literal pipeline: 1x1 in-proj -> depthwise k ->
+      1x1 pconv (3 device passes over the activation).
+    * ``'composed'`` (TPU default) — algebraic collapse: with no bias and
+      no nonlinearity between the three stages, the pipeline is EXACTLY
+      one dense conv whose kernel is the tap-wise triple product
+      ``A[j,c,o] = sum_d Win[c,d] * w[j,d] * Wp[d,o]`` (tiny einsum over
+      the weights, recomputed per step). One dense conv1d is the shape
+      XLA maps best onto the MXU at these channel counts (BASELINE.md:
+      phasenet 4.1% vs SeisT 0.8% MFU), and the activation is read and
+      written ONCE in each direction instead of three times — the stems
+      built from this block were 42% of the seist_l step before.
+    """
 
     in_dim: int
     out_dim: int
@@ -94,24 +126,57 @@ class DSConvNormAct(nn.Module):
     stride: int
     norm: str = "batch"
     act: Callable = common.gelu
+    # None -> env SEIST_DSCONV_IMPL, else 'composed' on TPU / 'paths' off
+    impl: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
-        x = nn.Dense(self.in_dim, use_bias=False, name="in_proj", **_dense_kw)(x)
-        x = common.auto_pad_1d(x, self.kernel_size, self.stride)
-        # Shift-FMA depthwise lowering (same dconv/kernel param tree as the
-        # grouped nn.Conv it replaces) — see common.DepthwiseConv1D for why
-        # XLA's grouped conv is pathological at these channel counts.
-        x = common.DepthwiseConv1D(
-            self.in_dim,
-            self.kernel_size,
-            stride=self.stride,
-            name="dconv",
-            **_conv_kw,
-        )(x)
-        x = nn.Dense(self.out_dim, use_bias=False, name="pconv", **_dense_kw)(x)
+        impl = self.impl or os.environ.get("SEIST_DSCONV_IMPL") or (
+            "composed" if jax.default_backend() == "tpu" else "paths"
+        )
+        if impl not in ("paths", "composed"):
+            raise ValueError(f"unknown dsconv impl {impl!r}")
+        if impl == "composed":
+            x = self._composed(x)
+        else:
+            x = nn.Dense(
+                self.in_dim, use_bias=False, name="in_proj", **_dense_kw
+            )(x)
+            x = common.auto_pad_1d(x, self.kernel_size, self.stride)
+            # Shift-FMA depthwise lowering (same dconv/kernel param tree as
+            # the grouped nn.Conv it replaces) — see common.DepthwiseConv1D
+            # for why XLA's grouped conv is pathological at these channel
+            # counts.
+            x = common.DepthwiseConv1D(
+                self.in_dim,
+                self.kernel_size,
+                stride=self.stride,
+                name="dconv",
+                **_conv_kw,
+            )(x)
+            x = nn.Dense(
+                self.out_dim, use_bias=False, name="pconv", **_dense_kw
+            )(x)
         x = common.make_norm(self.norm, use_running_average=not train, name="norm")(x)
         return self.act(x)
+
+    def _composed(self, x: Array) -> Array:
+        """in_proj∘dconv∘pconv as ONE dense conv (same param tree: the
+        _Kernel twins declare the identical leaves the per-stage modules
+        would). Padding commutes exactly: in_proj is 1x1 with no bias, so
+        padding the input with zeros equals padding its output."""
+        w_in = _Kernel((x.shape[-1], self.in_dim), name="in_proj")()
+        w_d = _Kernel((self.kernel_size, 1, self.in_dim), name="dconv")()
+        w_p = _Kernel((self.in_dim, self.out_dim), name="pconv")()
+        kernel = _triple_product_kernel(w_in, w_d[:, 0, :], w_p).astype(x.dtype)
+        xp = common.auto_pad_1d(x, self.kernel_size, self.stride)
+        return jax.lax.conv_general_dilated(
+            xp,
+            kernel,
+            window_strides=(self.stride,),
+            padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
 
 
 class _Kernel(nn.Module):
@@ -183,6 +248,10 @@ class StemBlock(nn.Module):
       bank, one block-diagonal pointwise matmul (3C lanes instead of C),
       and one merged BatchNorm whose per-channel stats are exactly the
       per-path norms'.
+    * ``'fused'`` — ONE dense conv for all 3 paths: each path collapses
+      to a dense kernel via the DSConvNormAct triple product, the three
+      kernels are tap-centered into one (K, Cin, 3*Cout) bank, and the
+      path concat becomes the conv's out-channel axis (see _fused_paths).
 
     ``'merged'`` is a measured NEGATIVE result on TPU v5e and therefore
     not the default: interleaved A/B on seist_l_dpk fp32 b256 gave
@@ -211,15 +280,17 @@ class StemBlock(nn.Module):
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
         impl = self.impl or os.environ.get("SEIST_STEM_IMPL") or "paths"
-        if impl not in ("merged", "paths"):
+        if impl not in ("merged", "paths", "fused"):
             raise ValueError(f"unknown stem impl {impl!r}")
-        if impl == "merged" and self.norm != "batch":
+        if impl in ("merged", "fused") and self.norm != "batch":
             raise ValueError(
-                "SEIST_STEM_IMPL=merged only supports norm='batch' "
+                f"SEIST_STEM_IMPL={impl} only supports norm='batch' "
                 f"(got {self.norm!r}); use the 'paths' impl"
             )
         if impl == "merged":
             x = self._merged_paths(x, train)
+        elif impl == "fused":
+            x = self._fused_paths(x, train)
         else:
             outs = [
                 DSConvNormAct(
@@ -240,8 +311,6 @@ class StemBlock(nn.Module):
 
     def _merged_paths(self, x: Array, train: bool) -> Array:
         """All 3 DSConvNormAct paths in 3 device passes instead of ~9."""
-        from seist_tpu.train.precision import policy_dtype
-
         P, C, O = self.npath, self.in_dim, self.out_dim
         ks = [self.kernel_size + 4 * dk for dk in range(P)]
         K = ks[-1]
@@ -269,9 +338,16 @@ class StemBlock(nn.Module):
         # one block-diagonal pointwise matmul (P*C -> P*O)
         w_p = jax.scipy.linalg.block_diag(*[l[2] for l in leaves])
         h = h @ w_p
-        # merged BatchNorm1dParity (common.py): per-channel batch stats are
-        # identical to the per-path norms'; running stats are written back
-        # into each path's own batch_stats leaves.
+        return self._merged_bn_act(h, leaves, train, x.dtype)
+
+    def _merged_bn_act(self, h: Array, leaves, train: bool, in_dtype) -> Array:
+        """Merged BatchNorm1dParity (common.py) over path-concatenated
+        channels: per-channel batch stats are identical to the per-path
+        norms'; running stats are written back into each path's own
+        batch_stats leaves. Shared by the 'merged' and 'fused' lowerings."""
+        from seist_tpu.train.precision import policy_dtype
+
+        O = self.out_dim
         scale = jnp.concatenate([l[3][0] for l in leaves])
         bias = jnp.concatenate([l[3][1] for l in leaves])
         if not train:
@@ -293,8 +369,45 @@ class StemBlock(nn.Module):
                     l[3][3].value = m * l[3][3].value + (1 - m) * unbiased[sl]
         inv = jax.lax.rsqrt(var + common.BN_EPSILON) * scale
         h = (h.astype(jnp.float32) - mean) * inv + bias
-        h = h.astype(policy_dtype() or x.dtype)
+        h = h.astype(policy_dtype() or in_dtype)
         return self.act(h)
+
+    def _fused_paths(self, x: Array, train: bool) -> Array:
+        """All 3 paths as ONE dense conv. Composes DSConvNormAct._composed
+        (per-path triple-product kernels A_i, exact — no bias and no
+        nonlinearity inside a path) with the merged-stem tap geometry:
+        path i's K_i-tap kernel sits at tap offset (K - k_i)//2 of the
+        K-tap bank, which under K-kernel 'same' padding reproduces the
+        path's own asymmetric padding exactly (even kernel-size deltas;
+        see _merged_paths). The path concat disappears entirely — the
+        conv's out-channel axis IS the concatenation — so the input is
+        read once and one (N, L_out, P*O) tensor is written where 'paths'
+        reads x three times and writes 3 tensors plus a concat copy.
+        Unlike 'merged' (a measured -12%: shift-FMA strided-slice
+        backward scatter), the dense conv's backward is XLA's native
+        conv-transpose — no scatter, no layout flip."""
+        P, C, O = self.npath, self.in_dim, self.out_dim
+        ks = [self.kernel_size + 4 * dk for dk in range(P)]
+        K = ks[-1]
+        leaves = [
+            _DSConvPathLeaves(x.shape[-1], C, O, k, name=f"conv{i}")()
+            for i, k in enumerate(ks)
+        ]
+        cin = x.shape[-1]
+        kern = jnp.zeros((K, cin, P * O), jnp.float32)
+        for i, (k_i, l) in enumerate(zip(ks, leaves)):
+            a = _triple_product_kernel(l[0], l[1][:, 0, :], l[2])
+            off = (K - k_i) // 2
+            kern = kern.at[off : off + k_i, :, i * O : (i + 1) * O].set(a)
+        xp = common.auto_pad_1d(x, K, self.stride)
+        h = jax.lax.conv_general_dilated(
+            xp,
+            kern.astype(x.dtype),
+            window_strides=(self.stride,),
+            padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        return self._merged_bn_act(h, leaves, train, x.dtype)
 
 
 class GroupConvBlock(nn.Module):

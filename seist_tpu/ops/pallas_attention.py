@@ -6,8 +6,12 @@ M = L/r. XLA's unfused path materializes the (N, H, L, M) probability
 tensor in HBM — at the reference training shape (batch 500, stage 1:
 L=1024, M=128) that is ~0.5 GB of HBM traffic per layer per direction.
 This kernel fuses qk-matmul + softmax + (dropout) + pv-matmul in VMEM
-(one grid step per batch-head; L, M and E are small enough that a whole
-batch-head's Q/K/V fit on-chip), writing only the (L, E) output.
+(one grid step per batch element, heads unrolled in-kernel over the
+feature axis; L, M and H*E are small enough that a whole batch element's
+Q/K/V fit on-chip), writing only the (L, H*E) output. Q/K/V enter as
+(N, L, H*E) — exactly the layout the Dense projections produce — so no
+head transpose is ever materialized in HBM (the (N,L,H,E)->(N,H,L,E)
+copies were ~2 ms/step in the round-2 seist_l profile).
 
 Training works through a custom VJP whose backward is a second fused
 kernel (recompute-p flash-style backward), so no probability tensor is
@@ -97,59 +101,77 @@ def _softmax_rows(q, k, scale):
     return p / p.sum(axis=-1, keepdims=True)
 
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, *, scale, rate):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, *, scale, rate, heads):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32)  # (L, E)
-    k = k_ref[0].astype(jnp.float32)  # (M, E)
-    v = v_ref[0].astype(jnp.float32)  # (M, E)
-    p = _softmax_rows(q, k, scale)
-    if rate > 0.0:
-        p = _apply_dropout(p, seed_ref[0], pl.program_id(0), rate)
-    o_ref[0] = jnp.dot(p, v, preferred_element_type=jnp.float32).astype(
-        o_ref.dtype
-    )
+    q = q_ref[0].astype(jnp.float32)  # (L, H*E)
+    k = k_ref[0].astype(jnp.float32)  # (M, H*E)
+    v = v_ref[0].astype(jnp.float32)  # (M, H*E)
+    e = q.shape[-1] // heads
+    for h in range(heads):
+        sl = slice(h * e, (h + 1) * e)
+        p = _softmax_rows(q[:, sl], k[:, sl], scale)
+        if rate > 0.0:
+            pid = pl.program_id(0) * heads + h
+            p = _apply_dropout(p, seed_ref[0], pid, rate)
+        o_ref[0, :, sl] = jnp.dot(
+            p, v[:, sl], preferred_element_type=jnp.float32
+        ).astype(o_ref.dtype)
 
 
 def _bwd_kernel(
-    seed_ref, q_ref, k_ref, v_ref, g_ref, dq_ref, dk_ref, dv_ref, *, scale, rate
+    seed_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    g_ref,
+    dq_ref,
+    dk_ref,
+    dv_ref,
+    *,
+    scale,
+    rate,
+    heads,
 ):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)  # (L, E) upstream grad
-    p = _softmax_rows(q, k, scale)  # recomputed probs (L, M)
-    if rate > 0.0:
-        pd = _apply_dropout(p, seed_ref[0], pl.program_id(0), rate)
-    else:
-        pd = p
-    dv = jnp.dot(pd.T, g, preferred_element_type=jnp.float32)
-    dpd = jnp.dot(g, v.T, preferred_element_type=jnp.float32)  # (L, M)
-    if rate > 0.0:
-        # d(dropout)/dp is the same keep/scale mask; reuse via pd = mask*p/kp:
-        # where p > 0, mask*inv_keep = pd / p. Regenerate instead (exact,
-        # avoids 0/0): mask comes from the same counter stream.
-        dp = _apply_dropout(dpd, seed_ref[0], pl.program_id(0), rate)
-    else:
-        dp = dpd
-    ds = p * (dp - (dp * p).sum(axis=-1, keepdims=True))  # softmax vjp
-    dq = jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
-    dk = jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * scale
-    dq_ref[0] = dq.astype(dq_ref.dtype)
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    qa = q_ref[0].astype(jnp.float32)  # (L, H*E)
+    ka = k_ref[0].astype(jnp.float32)  # (M, H*E)
+    va = v_ref[0].astype(jnp.float32)
+    ga = g_ref[0].astype(jnp.float32)  # (L, H*E) upstream grad
+    e = qa.shape[-1] // heads
+    for h in range(heads):
+        sl = slice(h * e, (h + 1) * e)
+        q, k, v, g = qa[:, sl], ka[:, sl], va[:, sl], ga[:, sl]
+        pid = pl.program_id(0) * heads + h
+        p = _softmax_rows(q, k, scale)  # recomputed probs (L, M)
+        if rate > 0.0:
+            pd = _apply_dropout(p, seed_ref[0], pid, rate)
+        else:
+            pd = p
+        dv = jnp.dot(pd.T, g, preferred_element_type=jnp.float32)
+        dpd = jnp.dot(g, v.T, preferred_element_type=jnp.float32)  # (L, M)
+        if rate > 0.0:
+            # d(dropout)/dp is the same keep/scale mask; reuse via pd =
+            # mask*p/kp: where p > 0, mask*inv_keep = pd / p. Regenerate
+            # instead (exact, avoids 0/0): same counter stream.
+            dp = _apply_dropout(dpd, seed_ref[0], pid, rate)
+        else:
+            dp = dpd
+        ds = p * (dp - (dp * p).sum(axis=-1, keepdims=True))  # softmax vjp
+        dq = jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+        dk = jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * scale
+        dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
+        dk_ref[0, :, sl] = dk.astype(dk_ref.dtype)
+        dv_ref[0, :, sl] = dv.astype(dv_ref.dtype)
 
 
-def _flatten_heads(x):
+def _fold_heads(x):
+    """(N, L, H, E) -> (N, L, H*E): a pure bitcast reshape (no transpose —
+    the heads stay interleaved on the feature axis exactly as the q/k/v
+    Dense projections produce them; the kernel slices per head in VMEM)."""
     n, l, h, e = x.shape
-    return jnp.transpose(x, (0, 2, 1, 3)).reshape(n * h, l, e)
-
-
-def _unflatten_heads(x, n, h):
-    nh, l, e = x.shape
-    return jnp.transpose(x.reshape(n, h, l, e), (0, 2, 1, 3))
+    return x.reshape(n, l, h * e)
 
 
 def _call_fused(kernel, out_shapes, seed, inputs, interpret):
@@ -179,10 +201,10 @@ def _call_fused(kernel, out_shapes, seed, inputs, interpret):
     )(seed, *inputs)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _fused(q3, k3, v3, seed, scale, rate, interpret):
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _fused(q3, k3, v3, seed, scale, rate, heads, interpret):
     o = _call_fused(
-        partial(_fwd_kernel, scale=scale, rate=rate),
+        partial(_fwd_kernel, scale=scale, rate=rate, heads=heads),
         jax.ShapeDtypeStruct(q3.shape, q3.dtype),
         seed,
         (q3, k3, v3),
@@ -191,14 +213,17 @@ def _fused(q3, k3, v3, seed, scale, rate, interpret):
     return o
 
 
-def _fused_fwd(q3, k3, v3, seed, scale, rate, interpret):
-    return _fused(q3, k3, v3, seed, scale, rate, interpret), (q3, k3, v3, seed)
+def _fused_fwd(q3, k3, v3, seed, scale, rate, heads, interpret):
+    return (
+        _fused(q3, k3, v3, seed, scale, rate, heads, interpret),
+        (q3, k3, v3, seed),
+    )
 
 
-def _fused_bwd(scale, rate, interpret, res, g):
+def _fused_bwd(scale, rate, heads, interpret, res, g):
     q3, k3, v3, seed = res
     dq, dk, dv = _call_fused(
-        partial(_bwd_kernel, scale=scale, rate=rate),
+        partial(_bwd_kernel, scale=scale, rate=rate, heads=heads),
         (
             jax.ShapeDtypeStruct(q3.shape, q3.dtype),
             jax.ShapeDtypeStruct(k3.shape, k3.dtype),
@@ -245,14 +270,15 @@ def fused_pooled_attention(
     on_tpu = jax.default_backend() == "tpu"
     if not (on_tpu or interpret or force):
         return _einsum_attention(q, k, v, scale, dropout_rate, dropout_seed)
-    n, _, h, _ = q.shape
+    h = q.shape[2]
     o3 = _fused(
-        _flatten_heads(q),
-        _flatten_heads(k),
-        _flatten_heads(v),
+        _fold_heads(q),
+        _fold_heads(k),
+        _fold_heads(v),
         dropout_seed,
         scale,
         float(dropout_rate),
+        h,
         interpret,
     )
-    return _unflatten_heads(o3, n, h)
+    return o3.reshape(q.shape)

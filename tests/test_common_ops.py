@@ -79,6 +79,23 @@ def test_identity_when_same_size(rng):
     assert interpolate_linear(x, 8) is x
 
 
+@pytest.mark.parametrize("out", [16, 32, 48, 100, 37])
+def test_nearest_matches_torch_interpolate(rng, out):
+    """Both the integer-factor repeat path and the gather path must match
+    torch F.interpolate(mode='nearest') (ditingmotion's upsampler)."""
+    torch = pytest.importorskip("torch")
+    x = rng.standard_normal((2, 16, 3)).astype(np.float32)
+    want = (
+        torch.nn.functional.interpolate(
+            torch.from_numpy(x.transpose(0, 2, 1)), size=out, mode="nearest"
+        )
+        .numpy()
+        .transpose(0, 2, 1)
+    )
+    got = np.asarray(common.interpolate_nearest(jnp.asarray(x), out))
+    np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+
 class TestConvLowerings:
     """DepthwiseConv1D / GroupedConv1D: every lowering must match the
     nn.Conv(feature_group_count=...) it replaces, on the same param tree

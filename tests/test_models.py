@@ -219,6 +219,107 @@ def test_eqt_banded_mask_matches_torch():
         np.testing.assert_array_equal(ours, ref, err_msg=f"width {w}")
 
 
+class TestComposedDSConv:
+    """DSConvNormAct's composed lowering (one dense conv from the
+    in_proj*dconv*pconv triple product) must be checkpoint-identical and
+    numerically equivalent to the literal 3-stage pipeline
+    (seist_tpu/models/seist.py DSConvNormAct docstring)."""
+
+    def _make(self, impl, stride, k=11):
+        from seist_tpu.models.seist import DSConvNormAct
+
+        return DSConvNormAct(
+            in_dim=8, out_dim=16, kernel_size=k, stride=stride, impl=impl
+        )
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    def test_param_tree_and_values_identical(self, stride):
+        x = jnp.zeros((2, 64, 3))
+        key = jax.random.PRNGKey(0)
+        vp = self._make("paths", stride).init(key, x, True)
+        vc = self._make("composed", stride).init(key, x, True)
+        fp = jax.tree_util.tree_flatten_with_path(vp)[0]
+        fc = jax.tree_util.tree_flatten_with_path(vc)[0]
+        assert [p for p, _ in fp] == [p for p, _ in fc]
+        for (p, a), (_, b) in zip(fp, fc):
+            np.testing.assert_array_equal(a, b, err_msg=str(p))
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    @pytest.mark.parametrize("train", [False, True])
+    def test_outputs_and_stats_match(self, stride, train):
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 63, 3))
+        variables = self._make("paths", stride).init(
+            jax.random.PRNGKey(0), x, True
+        )
+        outs = {}
+        stats = {}
+        for impl in ("paths", "composed"):
+            m = self._make(impl, stride)
+            if train:
+                y, mut = m.apply(variables, x, True, mutable=["batch_stats"])
+                stats[impl] = mut["batch_stats"]
+            else:
+                y = m.apply(variables, x, False)
+            outs[impl] = y
+        np.testing.assert_allclose(
+            outs["paths"], outs["composed"], rtol=2e-5, atol=2e-5
+        )
+        if train:
+            fa = jax.tree_util.tree_flatten_with_path(stats["paths"])[0]
+            fb = jax.tree_util.tree_flatten_with_path(stats["composed"])[0]
+            assert [p for p, _ in fa] == [p for p, _ in fb]
+            for (p, a), (_, b) in zip(fa, fb):
+                np.testing.assert_allclose(
+                    a, b, rtol=2e-5, atol=2e-5, err_msg=str(p)
+                )
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    def test_gradients_match(self, stride):
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 48, 3))
+        variables = self._make("paths", stride, k=7).init(
+            jax.random.PRNGKey(0), x, True
+        )
+
+        def loss(impl, params):
+            m = self._make(impl, stride, k=7)
+            y, _ = m.apply(
+                {**variables, "params": params}, x, True,
+                mutable=["batch_stats"],
+            )
+            return jnp.sum(y * jnp.cos(y))
+
+        gp = jax.grad(lambda p: loss("paths", p))(variables["params"])
+        gc = jax.grad(lambda p: loss("composed", p))(variables["params"])
+        fa = jax.tree_util.tree_flatten_with_path(gp)[0]
+        fb = jax.tree_util.tree_flatten_with_path(gc)[0]
+        assert [p for p, _ in fa] == [p for p, _ in fb]
+        for (p, a), (_, b) in zip(fa, fb):
+            np.testing.assert_allclose(
+                a, b, rtol=5e-4, atol=5e-5, err_msg=str(p)
+            )
+
+    def test_full_model_forward_matches(self):
+        import os
+
+        from seist_tpu.models import api
+
+        x = jax.random.normal(jax.random.PRNGKey(2), (1, 512, 3))
+        model = api.create_model("seist_s_dpk", in_samples=512)
+        variables = model.init(jax.random.PRNGKey(0), x, False)
+        prev = os.environ.get("SEIST_DSCONV_IMPL")
+        try:
+            os.environ["SEIST_DSCONV_IMPL"] = "paths"
+            y_paths = model.apply(variables, x, False)
+            os.environ["SEIST_DSCONV_IMPL"] = "composed"
+            y_comp = model.apply(variables, x, False)
+        finally:
+            if prev is None:
+                os.environ.pop("SEIST_DSCONV_IMPL", None)
+            else:
+                os.environ["SEIST_DSCONV_IMPL"] = prev
+        np.testing.assert_allclose(y_paths, y_comp, rtol=1e-5, atol=1e-5)
+
+
 class TestMergedStem:
     """StemBlock's merged lowering must be checkpoint-identical and
     numerically equivalent to the literal 3-path architecture
@@ -231,12 +332,13 @@ class TestMergedStem:
             in_dim=16, out_dim=16, kernel_size=11, stride=stride, impl=impl
         )
 
+    @pytest.mark.parametrize("other", ["merged", "fused"])
     @pytest.mark.parametrize("stride", [1, 2])
-    def test_param_tree_and_values_identical(self, stride):
+    def test_param_tree_and_values_identical(self, stride, other):
         x = jnp.zeros((2, 64, 3))
         key = jax.random.PRNGKey(0)
         vp = self._make("paths", stride).init(key, x, True)
-        vm = self._make("merged", stride).init(key, x, True)
+        vm = self._make(other, stride).init(key, x, True)
         fp = jax.tree_util.tree_flatten_with_path(vp)[0]
         fm = jax.tree_util.tree_flatten_with_path(vm)[0]
         assert [p for p, _ in fp] == [p for p, _ in fm]
@@ -250,7 +352,7 @@ class TestMergedStem:
         variables = self._make("paths", stride).init(jax.random.PRNGKey(0), x, True)
         outs = {}
         stats = {}
-        for impl in ("paths", "merged"):
+        for impl in ("paths", "merged", "fused"):
             m = self._make(impl, stride)
             if train:
                 y, mut = m.apply(variables, x, True, mutable=["batch_stats"])
@@ -258,15 +360,19 @@ class TestMergedStem:
             else:
                 y = m.apply(variables, x, False)
             outs[impl] = y
-        np.testing.assert_allclose(
-            outs["paths"], outs["merged"], rtol=2e-5, atol=2e-5
-        )
-        if train:
-            fa = jax.tree_util.tree_flatten_with_path(stats["paths"])[0]
-            fb = jax.tree_util.tree_flatten_with_path(stats["merged"])[0]
-            assert [p for p, _ in fa] == [p for p, _ in fb]
-            for (p, a), (_, b) in zip(fa, fb):
-                np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5, err_msg=str(p))
+        for other in ("merged", "fused"):
+            np.testing.assert_allclose(
+                outs["paths"], outs[other], rtol=2e-5, atol=2e-5,
+                err_msg=other,
+            )
+            if train:
+                fa = jax.tree_util.tree_flatten_with_path(stats["paths"])[0]
+                fb = jax.tree_util.tree_flatten_with_path(stats[other])[0]
+                assert [p for p, _ in fa] == [p for p, _ in fb]
+                for (p, a), (_, b) in zip(fa, fb):
+                    np.testing.assert_allclose(
+                        a, b, rtol=2e-5, atol=2e-5, err_msg=f"{other}:{p}"
+                    )
 
     def test_full_model_forward_matches(self):
         import os

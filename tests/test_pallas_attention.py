@@ -18,8 +18,11 @@ def _qkv(rng, n=2, l=64, m=16, h=2, e=8):
     return q, k, v
 
 
-def test_forward_matches_einsum(rng):
-    q, k, v = _qkv(rng)
+@pytest.mark.parametrize("h", [1, 2, 3])
+def test_forward_matches_einsum(rng, h):
+    # h=3, e=8 is the real SeisT stage-0 attention shape; the in-kernel
+    # head unroll slices the folded (L, H*E) feature axis per head.
+    q, k, v = _qkv(rng, h=h)
     want = np.asarray(_einsum_attention(q, k, v, 1.0 / np.sqrt(q.shape[-1])))
     got = np.asarray(fused_pooled_attention(q, k, v, interpret=True))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
@@ -133,10 +136,13 @@ def test_dropout_deterministic_per_seed(rng):
     assert not np.array_equal(a, c)
 
 
-def test_dropout_kernel_matches_einsum_fallback(rng):
+@pytest.mark.parametrize("h", [1, 3])
+def test_dropout_kernel_matches_einsum_fallback(rng, h):
     # Kernel (interpret) and XLA fallback share the counter-based PRNG, so
-    # outputs agree including which entries were dropped.
-    q, k, v = _qkv(rng, l=32, m=8)
+    # outputs agree including which entries were dropped — per head: the
+    # kernel's in-kernel pid is program_id*H + h, matching the fallback's
+    # flattened (n, h) order.
+    q, k, v = _qkv(rng, l=32, m=8, h=h)
     scale = 1.0 / np.sqrt(q.shape[-1])
     want = np.asarray(
         _einsum_attention(q, k, v, scale, dropout_rate=0.3, dropout_seed=_seed())
@@ -150,8 +156,9 @@ def test_dropout_kernel_matches_einsum_fallback(rng):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
-def test_dropout_custom_vjp_matches_einsum_grads(rng):
-    q, k, v = _qkv(rng, n=1, l=32, m=8)
+@pytest.mark.parametrize("h", [1, 3])
+def test_dropout_custom_vjp_matches_einsum_grads(rng, h):
+    q, k, v = _qkv(rng, n=1, l=32, m=8, h=h)
     scale = 1.0 / np.sqrt(q.shape[-1])
 
     def loss_fused(q, k, v):

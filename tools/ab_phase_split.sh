@@ -1,0 +1,34 @@
+#!/bin/bash
+# Watcher v2: wait for the TPU tunnel, then
+#   1. Mosaic-compile + numerics check of the head-folded attention kernel
+#      (tools/check_attn_tpu.py)
+#   2. A/B bench: working tree (phase-split stride lowering + head-folded
+#      attention) vs pre-change HEAD 74aad2c (worktree /tmp/repo_head),
+#      bracketed NEW -> OLD -> NEW to expose chip drift; plus one NEW run
+#      at batch 256 for comparability with the bf16 matrix rows.
+# JSON lines land in tools/ab_phase_split.log.
+LOG=/root/repo/tools/ab_phase_split.log
+probe() {
+  timeout 70 python -c "
+import jax, jax.numpy as jnp
+r = jax.jit(lambda a, b: a @ b)(jnp.ones((128,128)), jnp.ones((128,128)))
+r.block_until_ready(); print('UP')" 2>/dev/null | grep -q UP
+}
+echo "watcher2 start $(date)" >> "$LOG"
+until probe; do sleep 240; done
+echo "tunnel UP $(date)" >> "$LOG"
+
+echo "=== attn kernel check $(date)" >> "$LOG"
+(cd /root/repo && timeout 900 python tools/check_attn_tpu.py 2>/dev/null) >> "$LOG"
+echo "attn check rc=$?" >> "$LOG"
+
+run() {  # $1 = dir, $2 = tag, $3 = extra env (optional BENCH_BATCH)
+  echo "=== $2 $(date)" >> "$LOG"
+  (cd "$1" && env $3 BENCH_STEPS=15 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=120 \
+     timeout 900 python bench.py 2>/dev/null) >> "$LOG"
+}
+run /root/repo      "NEW (1st) b512"
+run /tmp/repo_head  "OLD head b512"
+run /root/repo      "NEW (2nd) b512"
+run /root/repo      "NEW b256" "BENCH_BATCH=256"
+echo "ALL DONE $(date)" >> "$LOG"
