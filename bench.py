@@ -23,7 +23,7 @@ numbers and no GPU is available here — see BASELINE.md for an analytical
 A100 anchor; the measured value lives in tools/reference_baseline.json).
 
 Env knobs: BENCH_MODEL, BENCH_BATCH, BENCH_SAMPLES, BENCH_STEPS,
-BENCH_DTYPE (fp32|bf16), BENCH_MODE (train|loader), BENCH_STEPS_PER_CALL
+BENCH_DTYPE (fp32|bf16), BENCH_MODE (train|eval|loader), BENCH_STEPS_PER_CALL
 (k>1 scans k optimizer updates inside one jitted call — dispatch
 amortization; see train/step.py make_multi_train_step), BENCH_DONATE.
 """
@@ -105,10 +105,12 @@ def _fail(
             continue
         try:
             with open(path) as f:
-                cached = json.load(f)
+                data = json.load(f)
         except Exception:  # noqa: BLE001 - unreadable cache, try next
             continue
-        if cached.get("metric") != metric:
+        # metric -> payload map, or a legacy single-payload file.
+        cached = data.get(metric) if "metric" not in data else data
+        if not cached or cached.get("metric") != metric:
             continue
         if config and any(cached.get(k) != v for k, v in config.items()):
             continue  # different dtype/batch/... — do not misattribute
@@ -138,7 +140,12 @@ def probe_backend(
     subprocess can always be killed.
     """
     code = (
-        "import jax, jax.numpy as jnp;"
+        # The sandbox sitecustomize registers the TPU backend at interpreter
+        # start, so JAX_PLATFORMS in the env alone is not honored — force it
+        # via jax.config before any device query (same pattern as main.py).
+        "import os, jax, jax.numpy as jnp;"
+        "os.environ.get('JAX_PLATFORMS') and "
+        "jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS']);"
         "d = jax.devices();"
         "r = jax.jit(lambda a, b: a @ b)"
         "(jnp.ones((128, 128)), jnp.ones((128, 128)));"
@@ -251,6 +258,74 @@ def _synthetic_batch(spec, batch: int, in_samples: int, k: int = 1):
     return jax.tree.map(jax.device_put, stacked)
 
 
+def _cost_flops(step) -> float:
+    """Total FLOPs of a compiled executable (best-effort; 0.0 if the
+    backend doesn't expose cost analysis)."""
+    try:
+        cost = step.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return float(cost.get("flops", 0.0))
+    except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
+        _eprint(f"cost_analysis unavailable: {e!r}")
+        return 0.0
+
+
+def _emit_and_cache(payload: dict) -> None:
+    """Emit the JSON line and persist it for _fail's marked cached replay
+    (the metric+config keys in the payload make a replay attributable).
+
+    The cache file maps metric -> payload so an eval-mode run cannot
+    evict the train entry the driver's round-end bench.py relies on
+    (legacy single-payload files are upgraded in place)."""
+    entries = {}
+    try:
+        with open(_CACHE_WRITE) as f:
+            prev = json.load(f)
+        entries = prev if "metric" not in prev else {prev["metric"]: prev}
+    except (OSError, ValueError):
+        pass
+    entries[payload["metric"]] = payload
+    try:
+        os.makedirs(os.path.dirname(_CACHE_WRITE), exist_ok=True)
+        with open(_CACHE_WRITE, "w") as f:
+            json.dump(entries, f)
+    except OSError as e:
+        _eprint(f"could not cache result: {e}")
+    _emit(payload)
+
+
+def _setup_model(cfg: dict, tx=None):
+    """Shared bench scaffolding: registry load, task spec, model, and an
+    initialized TrainState at the benchmark batch shape. ``tx`` defaults
+    to plain Adam (fine for eval, where the optimizer is never applied);
+    bench_train passes its cyclic-schedule optimizer so the LR-schedule
+    cost stays inside the timed step like production."""
+    import seist_tpu
+    from seist_tpu import taskspec
+    from seist_tpu.models import api
+    from seist_tpu.train import build_optimizer, create_train_state
+
+    seist_tpu.load_all()
+    model_name, in_samples = cfg["model"], cfg["in_samples"]
+    spec = taskspec.get_task_spec(model_name)
+    loss_fn = taskspec.make_loss(model_name)
+    in_channels = taskspec.get_num_inchannels(model_name)
+    model = api.create_model(
+        model_name, in_channels=in_channels, in_samples=in_samples
+    )
+    variables = api.init_variables(
+        model,
+        in_samples=in_samples,
+        in_channels=in_channels,
+        batch_size=cfg["batch"],
+    )
+    state = create_train_state(
+        model, variables, tx if tx is not None else build_optimizer("adam", 1e-3)
+    )
+    return spec, loss_fn, state
+
+
 def bench_train(device_kind: str) -> None:
     import jax
 
@@ -261,18 +336,12 @@ def bench_train(device_kind: str) -> None:
     # wall time.
     enable_compile_cache(verbose=True)
 
-    import seist_tpu
-    from seist_tpu import taskspec
-    from seist_tpu.models import api
     from seist_tpu.train import (
         build_cyclic_schedule,
         build_optimizer,
-        create_train_state,
         make_multi_train_step,
         make_train_step,
     )
-
-    seist_tpu.load_all()
 
     cfg = env_config()
     model_name = cfg["model"]
@@ -285,21 +354,8 @@ def bench_train(device_kind: str) -> None:
     metric = f"{model_name}_train_throughput"
     unit = "waveforms/sec/chip"
 
-    spec = taskspec.get_task_spec(model_name)
-    loss_fn = taskspec.make_loss(model_name)
-    in_channels = taskspec.get_num_inchannels(model_name)
-
-    model = api.create_model(
-        model_name, in_channels=in_channels, in_samples=in_samples
-    )
-    variables = api.init_variables(
-        model,
-        in_samples=in_samples,
-        in_channels=in_channels,
-        batch_size=batch,
-    )
     sched = build_cyclic_schedule(8e-5, 1e-3, total_steps=10_000)
-    state = create_train_state(model, variables, build_optimizer("adam", sched))
+    spec, loss_fn, state = _setup_model(cfg, tx=build_optimizer("adam", sched))
 
     x, y = _synthetic_batch(spec, batch, in_samples, k=spc)
     step_fn = (
@@ -324,14 +380,7 @@ def bench_train(device_kind: str) -> None:
         .compile()
     )
     _eprint(f"compiled in {time.time() - t0:.1f}s (donate={donate})")
-    flops_per_step = 0.0
-    try:
-        cost = step.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops_per_step = float(cost.get("flops", 0.0))
-    except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
-        _eprint(f"cost_analysis unavailable: {e!r}")
+    flops_per_step = _cost_flops(step)
 
     t0 = time.time()
     for _ in range(warmup_steps):
@@ -375,13 +424,75 @@ def bench_train(device_kind: str) -> None:
         "steps_per_call": spc,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
-    try:  # cache for _fail's marked replay when the tunnel is down
-        os.makedirs(os.path.dirname(_CACHE_WRITE), exist_ok=True)
-        with open(_CACHE_WRITE, "w") as f:
-            json.dump(payload, f)
-    except OSError as e:
-        _eprint(f"could not cache result: {e}")
-    _emit(payload)
+    _emit_and_cache(payload)
+
+
+def bench_eval(device_kind: str) -> None:
+    """Inference/eval throughput: the jitted no-grad eval step (forward +
+    masked loss, running BN stats — train/step.py make_eval_step, the body
+    the reference's validate.py:54-127 runs per batch). The deployment
+    half of the story (tools/predict.py, demo_predict.py) runs this same
+    forward; BENCH_MODE=eval gives it a measured number."""
+    import jax
+
+    from seist_tpu.utils.misc import enable_compile_cache
+
+    enable_compile_cache(verbose=True)
+
+    import jax.numpy as jnp
+
+    from seist_tpu.train import make_eval_step
+
+    cfg = env_config()
+    model_name, in_samples = cfg["model"], cfg["in_samples"]
+    batch, dtype = cfg["batch"], cfg["dtype"]
+    warmup_steps = 5
+    bench_steps = int(os.environ.get("BENCH_STEPS", 30))
+
+    spec, loss_fn, state = _setup_model(cfg)
+    x, y = _synthetic_batch(spec, batch, in_samples)
+    mask = jnp.ones((batch,), jnp.float32)
+
+    step_fn = make_eval_step(spec, loss_fn, compute_dtype=dtype)
+    t0 = time.time()
+    step = jax.jit(step_fn).lower(state, x, y, mask).compile()
+    _eprint(f"compiled in {time.time() - t0:.1f}s")
+    flops_per_step = _cost_flops(step)
+
+    for _ in range(warmup_steps):
+        loss, _outputs = step(state, x, y, mask)
+    jax.block_until_ready(loss)
+    _eprint(f"warmup done, loss={float(loss):.4f}")
+
+    t0 = time.perf_counter()
+    for _ in range(bench_steps):
+        loss, _outputs = step(state, x, y, mask)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    wfs = batch * bench_steps / dt
+    flops_per_wf = flops_per_step / batch if flops_per_step else 0.0
+    _emit_and_cache(
+        {
+            "metric": f"{model_name}_eval_throughput",
+            "value": round(wfs, 2),
+            "unit": "waveforms/sec/chip",
+            # No comparator: tools/reference_baseline.json records train
+            # throughput only.
+            "vs_baseline": None,
+            "step_time_ms": round(dt / bench_steps * 1e3, 2),
+            "mfu": round(wfs * flops_per_wf / _peak_flops(device_kind), 4)
+            if flops_per_wf
+            else 0.0,
+            "mfu_note": "vs bf16 dense peak",
+            "flops_per_waveform": round(flops_per_wf),
+            "dtype": dtype,
+            "device": device_kind,
+            "batch": batch,
+            "in_samples": in_samples,
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+    )
 
 
 def bench_loader() -> None:
@@ -392,9 +503,17 @@ def bench_loader() -> None:
 
 
 def main() -> None:
+    if os.environ.get("JAX_PLATFORMS"):
+        # Honor JAX_PLATFORMS=cpu for off-TPU smoke runs (the sitecustomize
+        # registers the TPU backend regardless of the env var; main.py:15
+        # uses the same override).
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     mode = os.environ.get("BENCH_MODE", "train")
     model_name = env_config()["model"]
-    metric = f"{model_name}_train_throughput"
+    kind_suffix = "eval" if mode == "eval" else "train"
+    metric = f"{model_name}_{kind_suffix}_throughput"
     unit = "waveforms/sec/chip"
 
     if mode == "loader":
@@ -425,7 +544,10 @@ def main() -> None:
         )
         return
     try:
-        bench_train(kind)
+        if mode == "eval":
+            bench_eval(kind)
+        else:
+            bench_train(kind)
     except Exception as e:  # noqa: BLE001 - one JSON line, not a traceback
         import traceback
 
