@@ -431,17 +431,33 @@ def test_gradient_and_bn_parity_train_mode(torch_models):
 
 
 @pytest.mark.slow
-def test_pretrained_forward_parity_tpu_lowerings(torch_models, monkeypatch):
-    """Golden parity THROUGH the TPU-default conv lowerings (shift-FMA
-    depthwise + block-diagonal-dense grouped; models/common.py). Off-TPU
+@pytest.mark.parametrize(
+    "env",
+    [
+        # round-2 defaults-on-TPU: shift-FMA depthwise + block-diag-dense
+        # grouped, with the per-path stems
+        {"SEIST_DWCONV_IMPL": "shift", "SEIST_GCONV_IMPL": "dense"},
+        # composed DSConv (the TPU default since the triple-product
+        # lowering) + fused one-conv stem, on published weights
+        {
+            "SEIST_DSCONV_IMPL": "composed",
+            "SEIST_STEM_IMPL": "fused",
+            "SEIST_GCONV_IMPL": "dense",
+        },
+    ],
+    ids=["shift+dense", "composed+fused"],
+)
+def test_pretrained_forward_parity_tpu_lowerings(torch_models, monkeypatch, env):
+    """Golden parity THROUGH the TPU-default conv lowerings
+    (models/common.py, models/seist.py DSConvNormAct/StemBlock). Off-TPU
     the defaults fall back to native grouped convs, so without forcing the
     env this path would only ever be exercised on real hardware."""
     import torch
 
     from parity import convert_state_dict
 
-    monkeypatch.setenv("SEIST_DWCONV_IMPL", "shift")
-    monkeypatch.setenv("SEIST_GCONV_IMPL", "dense")
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
 
     ckpt = "seist_s_dpk_diting"
     model_name = "seist_s_dpk"
