@@ -1,0 +1,23 @@
+#!/bin/bash
+# Watcher 3: after tools/ab_phase_split.sh finishes (ALL DONE marker),
+# isolate the contribution of each lowering, same session:
+#   - SEIST_STEM_IMPL=fused     (composed DSConv + one-conv stems)
+#   - SEIST_DSCONV_IMPL=paths   (phase-split shift-FMA stems, no composed)
+#   - matrix-comparable b256 rows for seist_s/l_dpk at the new default
+#   - eval-mode numbers for the flagship + phasenet
+LOG=/root/repo/tools/ab_phase_split.log
+until grep -q "ALL DONE" "$LOG" 2>/dev/null; do sleep 120; done
+
+run() {  # $1 = tag, rest = env overrides
+  tag=$1; shift
+  echo "=== impl A/B: $tag $(date)" >> "$LOG"
+  (cd /root/repo && env "$@" BENCH_STEPS=15 BENCH_PROBE_ATTEMPTS=1 \
+     BENCH_PROBE_TIMEOUT=120 timeout 900 python bench.py 2>/dev/null) >> "$LOG"
+}
+run "fused stem b512"        SEIST_STEM_IMPL=fused
+run "paths dsconv b512"      SEIST_DSCONV_IMPL=paths
+run "default b256"           BENCH_BATCH=256
+run "fused stem b256"        SEIST_STEM_IMPL=fused BENCH_BATCH=256
+run "eval seist_l b256"      BENCH_MODE=eval BENCH_BATCH=256
+run "eval phasenet b256"     BENCH_MODE=eval BENCH_MODEL=phasenet BENCH_BATCH=256
+echo "IMPL AB DONE $(date)" >> "$LOG"

@@ -12,7 +12,10 @@ rate (from BENCH env DEVICE_WFS or the default below) — the ratio that
 matters; >= 2.0 means the pipeline can feed the chip with headroom.
 
 Env knobs: BENCH_BATCH (500), BENCH_SAMPLES (8192), BENCH_BATCHES (8),
-BENCH_WORKERS (os.cpu_count), DEVICE_WFS.
+BENCH_WORKERS (os.cpu_count), DEVICE_WFS, BENCH_DATASET
+(synthetic | diting_light — the latter writes a DiTing-light-format
+CSV+HDF5 fixture once under logs/ and measures the real h5py/pandas
+reader path end to end).
 """
 
 from __future__ import annotations
@@ -42,15 +45,51 @@ def run() -> None:
     workers = int(os.environ.get("BENCH_WORKERS", os.cpu_count() or 1))
     device_wfs = float(os.environ.get("DEVICE_WFS", 4236.0))
 
+    dataset_name = os.environ.get("BENCH_DATASET", "synthetic")
     spec = taskspec.get_task_spec("seist_l_dpk")
+    ds_kw: dict = {}
+    data_dir = ""
+    if dataset_name == "synthetic":
+        ds_kw = {"num_events": batch * 4}
+    elif dataset_name == "diting_light":
+        # Real-format reader path: write the fixture once (keyed by shape)
+        # and reuse it across runs.
+        from tools.fixtures import write_diting_light_fixture
+
+        n_events = max(batch * 2, 512)
+        data_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            os.pardir,
+            "logs",
+            f"loader_fixture_{n_events}x{in_samples}",
+        )
+        # Sentinel written only after the full fixture lands — the CSV is
+        # the FIRST artifact the writer produces, so its existence alone
+        # would turn an interrupted write into a permanently broken cache.
+        marker = os.path.join(data_dir, ".complete")
+        if not os.path.exists(marker):
+            t0 = time.perf_counter()
+            write_diting_light_fixture(
+                data_dir, n_events=n_events, trace_samples=in_samples
+            )
+            with open(marker, "w") as f:
+                f.write("ok\n")
+            print(
+                f"fixture written in {time.perf_counter() - t0:.1f}s: "
+                f"{data_dir}",
+                file=sys.stderr,
+            )
+    else:
+        raise SystemExit(f"unknown BENCH_DATASET {dataset_name!r}")
     dataset = pipeline.from_task_spec(
         spec,
-        "synthetic",
+        dataset_name,
         "train",
         seed=0,
         in_samples=in_samples,
         augmentation=True,
-        dataset_kwargs={"num_events": batch * 4},
+        data_dir=data_dir,
+        dataset_kwargs=ds_kw,
     )
     loader = pipeline.Loader(
         dataset,
@@ -89,6 +128,7 @@ def run() -> None:
                 "batch": batch,
                 "workers": workers,
                 "augmentation": True,
+                "dataset": dataset_name,
             }
         )
     )

@@ -7,6 +7,7 @@ probe each, cached results on tunnel failure), writing
 
 Usage:
     python tools/bench_matrix.py [--steps 20] [--only seist_m_pmp,...]
+    python tools/bench_matrix.py --mode eval --out tools/bench_matrix_eval.json
 """
 
 from __future__ import annotations
@@ -45,8 +46,23 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--only", type=str, default="")
-    ap.add_argument("--out", default=os.path.join(_TOOLS, "bench_matrix.json"))
+    ap.add_argument(
+        "--mode",
+        default="train",
+        choices=["train", "eval"],
+        help="bench.py BENCH_MODE: full train step or no-grad eval step",
+    )
+    ap.add_argument(
+        "--out",
+        default=None,
+        help="result JSON (default: bench_matrix.json, or "
+        "bench_matrix_eval.json under --mode eval, so an eval sweep can "
+        "never clobber the train matrix BASELINE.md cites)",
+    )
     args = ap.parse_args()
+    if args.out is None:
+        name = "bench_matrix_eval.json" if args.mode == "eval" else "bench_matrix.json"
+        args.out = os.path.join(_TOOLS, name)
 
     only = set(args.only.split(",")) if args.only else None
     results = {}
@@ -62,6 +78,7 @@ def main() -> None:
             BENCH_MODEL=model,
             BENCH_BATCH=str(batch),
             BENCH_STEPS=str(args.steps),
+            BENCH_MODE=args.mode,
             BENCH_PROBE_ATTEMPTS="2",
         )
         # Pin the dtype unless the caller chose one: the matrix's rows are
