@@ -530,11 +530,22 @@ def make_norm(
     raise NotImplementedError(f"Unknown norm '{norm}'")
 
 
+def _lstm_unroll() -> int:
+    """Scan unroll factor for LSTM recurrences (env SEIST_LSTM_UNROLL).
+
+    The per-step matmuls are tiny (hidden 16-64), so a serial scan is
+    latency-bound on TPU; unrolling the scan body lets XLA software-
+    pipeline consecutive steps. Pure scheduling — the math is unchanged
+    for any factor (lax.scan semantics)."""
+    return int(os.environ.get("SEIST_LSTM_UNROLL", "8"))
+
+
 class LSTM(nn.Module):
     """Unidirectional LSTM over (N, L, C) returning (outputs, final_h).
 
     torch ``nn.LSTM`` parity at the architecture level; the recurrence is a
-    ``lax.scan`` per flax nn.RNN (SURVEY.md §7 'LSTM baselines on TPU').
+    ``lax.scan`` per flax nn.RNN (SURVEY.md §7 'LSTM baselines on TPU'),
+    unrolled by :func:`_lstm_unroll` steps per scan iteration.
     """
 
     hidden: int
@@ -542,7 +553,9 @@ class LSTM(nn.Module):
     @nn.compact
     def __call__(self, x: Array) -> Tuple[Array, Array]:
         cell = nn.OptimizedLSTMCell(features=self.hidden)
-        carry, outputs = nn.RNN(cell, return_carry=True)(x)
+        carry, outputs = nn.RNN(
+            cell, return_carry=True, unroll=_lstm_unroll()
+        )(x)
         # carry = (c, h) for OptimizedLSTMCell
         return outputs, carry[1]
 

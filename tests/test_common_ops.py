@@ -79,6 +79,36 @@ def test_identity_when_same_size(rng):
     assert interpolate_linear(x, 8) is x
 
 
+def test_lstm_unroll_is_pure_scheduling(rng, monkeypatch):
+    """SEIST_LSTM_UNROLL must not change LSTM math (fwd or grad) — it only
+    unrolls the scan body so XLA can pipeline the tiny per-step matmuls
+    (common._lstm_unroll). Odd L exercises the remainder handling."""
+    x = jnp.asarray(rng.standard_normal((2, 37, 5)), jnp.float32)
+    m = common.BiLSTM(hidden=7)
+    v = m.init(jax.random.PRNGKey(0), x)
+
+    def fwd_and_grad(unroll):
+        monkeypatch.setenv("SEIST_LSTM_UNROLL", unroll)
+        o, h = m.apply(v, x)
+
+        def loss(v):
+            o, h = m.apply(v, x)
+            return (o**2).sum() + (h**2).sum()
+
+        return o, h, jax.grad(loss)(v)
+
+    o1, h1, g1 = fwd_and_grad("1")
+    o8, h8, g8 = fwd_and_grad("8")
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o8), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h8), atol=1e-6)
+    fa = jax.tree_util.tree_flatten_with_path(g1)[0]
+    fb = jax.tree_util.tree_flatten_with_path(g8)[0]
+    for (p, a), (_, b) in zip(fa, fb):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, err_msg=str(p)
+        )
+
+
 @pytest.mark.parametrize("out", [16, 32, 48, 100, 37])
 def test_nearest_matches_torch_interpolate(rng, out):
     """Both the integer-factor repeat path and the gather path must match

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Watcher 4: after tools/ab_impls.sh (IMPL AB DONE marker), collect the
+# seist_s_dpk b256 rows ab_impls.sh's header promised but never ran
+# (review finding), same session: default lowering + fused stem.
+LOG=/root/repo/tools/ab_phase_split.log
+until grep -q "IMPL AB DONE" "$LOG" 2>/dev/null; do sleep 120; done
+
+run() {  # $1 = tag, rest = env overrides
+  tag=$1; shift
+  echo "=== impl A/B: $tag $(date)" >> "$LOG"
+  (cd /root/repo && env "$@" BENCH_STEPS=15 BENCH_PROBE_ATTEMPTS=1 \
+     BENCH_PROBE_TIMEOUT=120 timeout 900 python bench.py 2>/dev/null) >> "$LOG"
+}
+run "seist_s default b256"    BENCH_MODEL=seist_s_dpk BENCH_BATCH=256
+run "seist_s fused b256"      BENCH_MODEL=seist_s_dpk BENCH_BATCH=256 SEIST_STEM_IMPL=fused
+run "eqt b256 unroll8"        BENCH_MODEL=eqtransformer BENCH_BATCH=256
+run "eqt b256 unroll1"        BENCH_MODEL=eqtransformer BENCH_BATCH=256 SEIST_LSTM_UNROLL=1
+echo "IMPL AB2 DONE $(date)" >> "$LOG"
