@@ -34,6 +34,7 @@ Pallas interpreter for CPU testing.
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 from typing import Optional
 
@@ -267,6 +268,10 @@ def fused_pooled_attention(
     if dropout_seed is None:
         dropout_seed = jnp.zeros((1,), jnp.int32)
     dropout_seed = dropout_seed.astype(jnp.int32)
+    # Escape hatch: SEIST_ATTN_IMPL=einsum forces the identical-math XLA
+    # path even on TPU (e.g. if a Mosaic version rejects the kernel).
+    if os.environ.get("SEIST_ATTN_IMPL") == "einsum" and not interpret:
+        return _einsum_attention(q, k, v, scale, dropout_rate, dropout_seed)
     on_tpu = jax.default_backend() == "tpu"
     if not (on_tpu or interpret or force):
         return _einsum_attention(q, k, v, scale, dropout_rate, dropout_seed)
