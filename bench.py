@@ -503,13 +503,9 @@ def bench_loader() -> None:
 
 
 def main() -> None:
-    if os.environ.get("JAX_PLATFORMS"):
-        # Honor JAX_PLATFORMS=cpu for off-TPU smoke runs (the sitecustomize
-        # registers the TPU backend regardless of the env var; main.py:15
-        # uses the same override).
-        import jax
+    from seist_tpu.utils.platform import honor_jax_platforms
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    honor_jax_platforms()
     mode = os.environ.get("BENCH_MODE", "train")
     model_name = env_config()["model"]
     kind_suffix = "eval" if mode == "eval" else "train"

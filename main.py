@@ -3,16 +3,9 @@
 Thin wrapper over seist_tpu.cli (the reference's root main.py equivalent).
 """
 
-import os
+from seist_tpu.utils.platform import honor_jax_platforms
 
-if os.environ.get("JAX_PLATFORMS"):
-    # Honor JAX_PLATFORMS even where a sitecustomize registers an
-    # accelerator plugin at interpreter start (the env var alone is ignored
-    # there, and a wedged remote backend then hangs init for minutes):
-    # jax.config wins over the registration if set before any device query.
-    import jax
-
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+honor_jax_platforms()
 
 from seist_tpu.cli import main
 

@@ -270,7 +270,14 @@ def fused_pooled_attention(
     dropout_seed = dropout_seed.astype(jnp.int32)
     # Escape hatch: SEIST_ATTN_IMPL=einsum forces the identical-math XLA
     # path even on TPU (e.g. if a Mosaic version rejects the kernel).
-    if os.environ.get("SEIST_ATTN_IMPL") == "einsum" and not interpret:
+    # Explicit kernel requests (interpret/force, used by parity tooling)
+    # take precedence over the ambient env var.
+    env_impl = os.environ.get("SEIST_ATTN_IMPL")
+    if env_impl not in (None, "", "fused", "einsum"):
+        raise ValueError(
+            f"unknown SEIST_ATTN_IMPL {env_impl!r} (use fused or einsum)"
+        )
+    if env_impl == "einsum" and not (interpret or force):
         return _einsum_attention(q, k, v, scale, dropout_rate, dropout_seed)
     on_tpu = jax.default_backend() == "tpu"
     if not (on_tpu or interpret or force):

@@ -1,0 +1,22 @@
+"""JAX platform override helper shared by every entry script.
+
+The sandbox's sitecustomize registers the TPU backend at interpreter
+start, so the ``JAX_PLATFORMS`` env var alone is NOT honored; each entry
+point must force it through ``jax.config`` BEFORE any device query, or a
+dead TPU tunnel hangs backend init for minutes. This helper keeps that
+invariant in one place — call it first thing in ``main()``, before
+anything that could touch devices.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms() -> None:
+    """Apply JAX_PLATFORMS from the environment via jax.config (no-op when
+    unset). Safe to call any time before the first device query."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

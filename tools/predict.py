@@ -43,13 +43,9 @@ def main() -> None:
                     "with record length (4 per window span)")
     args = ap.parse_args()
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # Same trap as main.py: a sitecustomize-registered accelerator
-        # plugin ignores the env var, and a wedged remote backend then
-        # hangs init — jax.config wins if set before any device query.
-        import jax
+    from seist_tpu.utils.platform import honor_jax_platforms
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    honor_jax_platforms()
 
     import numpy as np
     import pandas as pd
