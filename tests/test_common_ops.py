@@ -79,6 +79,59 @@ def test_identity_when_same_size(rng):
     assert interpolate_linear(x, 8) is x
 
 
+class TestNoScatterBackward:
+    """HLO regression locks for the round-2 lowering work: the backward
+    passes of the conv lowerings must not contain scatter ops (XLA lowers
+    the transpose of a strided slice to scatter-adds — the pathology the
+    phase-split and composed lowerings exist to remove; BASELINE.md)."""
+
+    def _grad_hlo(self, fn, *args):
+        g = jax.jit(jax.grad(fn))
+        return g.lower(*args).compile().as_text()
+
+    @pytest.mark.parametrize("s", [2, 4])
+    def test_depthwise_shift_stride_backward(self, rng, s):
+        x = jnp.asarray(rng.standard_normal((2, 64, 8)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((11, 8)), jnp.float32)
+
+        def loss(x):
+            return jnp.sum(common.depthwise_shift_fma(x, w, s) ** 2)
+
+        assert " scatter(" not in self._grad_hlo(loss, x)
+
+    def test_dsconv_backward(self, rng):
+        # impl='composed' only: the 'paths' impl lowers to grouped conv on
+        # the CPU CI backend, so a scatter lock there would be vacuous.
+        from seist_tpu.models.seist import DSConvNormAct
+
+        m = DSConvNormAct(
+            in_dim=8, out_dim=16, kernel_size=11, stride=2, impl="composed"
+        )
+        x = jnp.asarray(rng.standard_normal((2, 64, 3)), jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x, True)
+
+        def loss(x):
+            y, _ = m.apply(v, x, True, mutable=["batch_stats"])
+            return jnp.sum(y**2)
+
+        assert " scatter(" not in self._grad_hlo(loss, x)
+
+    def test_fused_stem_backward(self, rng):
+        from seist_tpu.models.seist import StemBlock
+
+        m = StemBlock(
+            in_dim=8, out_dim=16, kernel_size=11, stride=2, impl="fused"
+        )
+        x = jnp.asarray(rng.standard_normal((2, 64, 3)), jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x, True)
+
+        def loss(x):
+            y, _ = m.apply(v, x, True, mutable=["batch_stats"])
+            return jnp.sum(y**2)
+
+        assert " scatter(" not in self._grad_hlo(loss, x)
+
+
 def test_lstm_unroll_is_pure_scheduling(rng, monkeypatch):
     """SEIST_LSTM_UNROLL must not change LSTM math (fwd or grad) — it only
     unrolls the scan body so XLA can pipeline the tiny per-step matmuls
