@@ -112,8 +112,10 @@ def vis_phase_picking(
             for i, (pidx, plabel) in enumerate(
                 zip(true_phase_idxs, true_phase_labels)
             ):
+                # pick indices arrive in samples; the x axis is seconds
+                # whenever sampling_rate is given
                 ax.vlines(
-                    x=[pidx],
+                    x=[pidx / sampling_rate if sampling_rate else pidx],
                     ymin=lo * 1.1,
                     ymax=hi * 1.1,
                     colors=[colors[i % 2]],
