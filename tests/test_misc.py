@@ -1,0 +1,73 @@
+"""Direct unit tests for the utils/misc parity helpers (ref utils/misc.py:
+get_safe_path :41-52, cal_snr :228-248, setup_seed :14-21). These were
+previously covered only transitively (SOS reader, worker CSV paths)."""
+
+import numpy as np
+import pytest
+
+from seist_tpu.utils.misc import (
+    cal_snr,
+    count_params,
+    get_safe_path,
+    setup_seed,
+    strftimedelta,
+)
+
+
+class TestCalSnr:
+    def test_hand_computed_value(self):
+        # signal amplitude 2x the noise -> SNR = 10*log10(4) per channel
+        L, w, pat = 2000, 500, 1000
+        data = np.ones((3, L), np.float32)
+        data[:, pat : pat + w] = 2.0
+        snr = cal_snr(data, pat, window=w)
+        np.testing.assert_allclose(snr, 10 * np.log10(4.0), rtol=1e-6)
+
+    def test_out_of_bounds_window_returns_zeros(self):
+        data = np.ones((3, 600), np.float32)
+        np.testing.assert_array_equal(cal_snr(data, 100, window=500), 0.0)
+        np.testing.assert_array_equal(cal_snr(data, 200, window=500), 0.0)
+
+    def test_silent_channel_returns_zero(self):
+        data = np.zeros((1, 2000), np.float32)
+        np.testing.assert_array_equal(cal_snr(data, 1000, window=500), 0.0)
+
+
+class TestGetSafePath:
+    def test_passthrough_when_free(self, tmp_path):
+        p = str(tmp_path / "results.csv")
+        assert get_safe_path(p) == p
+
+    def test_recursive_new_suffix(self, tmp_path):
+        # ref misc.py:41-52: existing paths dedupe by appending _new
+        p = tmp_path / "results.csv"
+        p.write_text("x")
+        first = get_safe_path(str(p))
+        assert first == str(tmp_path / "results_new.csv")
+        (tmp_path / "results_new.csv").write_text("y")
+        assert get_safe_path(str(p)) == str(tmp_path / "results_new_new.csv")
+
+
+def test_setup_seed_determinism():
+    import random
+
+    k1 = setup_seed(123)
+    a_np, a_py = np.random.rand(3), random.random()
+    k2 = setup_seed(123)
+    b_np, b_py = np.random.rand(3), random.random()
+    np.testing.assert_array_equal(a_np, b_np)
+    assert a_py == b_py
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+
+
+@pytest.mark.parametrize(
+    "seconds,expect",
+    [(0, "0:00:00"), (61, "0:01:01"), (3723.9, "1:02:03"), (86400, "24:00:00")],
+)
+def test_strftimedelta(seconds, expect):
+    assert strftimedelta(seconds) == expect
+
+
+def test_count_params():
+    tree = {"a": np.zeros((2, 3)), "b": {"c": np.zeros((5,))}}
+    assert count_params(tree) == 11

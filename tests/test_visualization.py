@@ -22,8 +22,10 @@ def test_vis_phase_picking_writes_png(rng, tmp_path, monkeypatch):
     preds = np.clip(
         rng.standard_normal((3, L)).astype(np.float32) * 0.1 + 0.2, 0, 1
     )
-    # Keep the figure alive so the pick markers can be inspected.
-    monkeypatch.setattr(plt, "close", lambda *a, **k: None)
+    # Capture the figure (instead of letting the function close it) so the
+    # pick markers can be inspected; really closed at the end of the test.
+    captured = []
+    monkeypatch.setattr(plt, "close", lambda fig=None, *a, **k: captured.append(fig))
     paths = vis_phase_picking(
         waveforms=waves,
         waveforms_labels=["Z", "N", "E"],
@@ -41,13 +43,16 @@ def test_vis_phase_picking_writes_png(rng, tmp_path, monkeypatch):
         assert (tmp_path / p.split("/")[-1]).stat().st_size > 0
     # Units: pick indices are samples, the x axis is seconds — the vlines
     # must land at idx / fs, inside the waveform's 5.12 s extent.
-    fig = plt.figure(plt.get_fignums()[-1])
+    assert len(captured) == 1
+    fig = captured[0]
     vline_xs = sorted(
         seg[0][0]
         for coll in fig.axes[0].collections
         for seg in coll.get_segments()
     )
     np.testing.assert_allclose(vline_xs, [64 / 50, 128 / 50])
+    monkeypatch.undo()
+    plt.close(fig)
 
 
 def test_vis_waves_preds_targets_writes_png(rng, tmp_path):
