@@ -101,6 +101,44 @@ def test_train_then_test(e2e_run):
     assert np.isfinite(loss)
 
 
+def test_training_learns_p_picks(tmp_path_factory):
+    """Training must actually LEARN, not merely keep the loss finite: 30
+    constant-LR epochs of phasenet on the synthetic dataset reach P-pick
+    F1 0.75 on the held-out test split (~2 min incl. compile on this
+    host). Guards against silent optimizer / label / postprocess /
+    metric-wiring regressions the loss-only e2e can't see."""
+    import json
+
+    from seist_tpu.train.worker import test_worker, train_worker
+
+    logdir = str(tmp_path_factory.mktemp("learn_logs"))
+    logger.set_logdir(logdir)
+    # Dataset left at its defaults (256 events, 12000-sample traces): this
+    # matches the CLI calibration run; smaller fixtures train noisily.
+    args = make_args(
+        in_samples=512,
+        batch_size=32,
+        epochs=30,
+        use_lr_scheduler=False,
+        max_lr=1e-3,
+        patience=1000,
+        dataset_kwargs={},
+    )
+    ckpt = train_worker(args)
+    assert ckpt and os.path.exists(ckpt)
+    args.checkpoint = ckpt
+    test_worker(args)
+    metrics_json = os.path.join(logdir, "test_metrics_synthetic.json")
+    assert os.path.exists(metrics_json), os.listdir(logdir)
+    with open(metrics_json) as f:
+        payload = json.load(f)
+    # Measured 0.75 at this exact seeded config (27 test events; chance is
+    # ~0); 0.6 leaves margin for legitimate augmentation/label changes
+    # while still failing hard on a model that didn't learn.
+    f1 = payload["metrics"]["ppk"]["f1"]
+    assert f1 >= 0.6, payload["metrics"]
+
+
 def test_results_csv_written(e2e_run):
     logdir, _, _ = e2e_run
     csvs = [f for f in os.listdir(logdir) if f.startswith("test_results_")]

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Watcher 5: after tools/ab_impls2.sh (IMPL AB2 DONE), refresh the full
+# bf16 per-config matrix at the new default lowerings and capture an
+# eval-mode matrix, into separate files so the old tables remain as the
+# round-2 historical record.
+LOG=/root/repo/tools/ab_phase_split.log
+until grep -q "IMPL AB2 DONE" "$LOG" 2>/dev/null; do sleep 120; done
+
+cd /root/repo
+echo "=== bf16 matrix refresh $(date)" >> "$LOG"
+BENCH_DTYPE=bf16 timeout 10800 python tools/bench_matrix.py --steps 15 \
+  --out tools/bench_matrix_bf16_r2b.json >> "$LOG" 2>/dev/null
+echo "=== eval matrix $(date)" >> "$LOG"
+BENCH_DTYPE=bf16 timeout 7200 python tools/bench_matrix.py --steps 15 \
+  --mode eval --out tools/bench_matrix_eval.json >> "$LOG" 2>/dev/null
+echo "MATRIX REFRESH DONE $(date)" >> "$LOG"
