@@ -8,9 +8,17 @@ until grep -q "IMPL AB2 DONE" "$LOG" 2>/dev/null; do sleep 120; done
 
 cd /root/repo
 echo "=== bf16 matrix refresh $(date)" >> "$LOG"
-BENCH_DTYPE=bf16 timeout 10800 python tools/bench_matrix.py --steps 15 \
-  --out tools/bench_matrix_bf16_r2b.json >> "$LOG" 2>/dev/null
+if BENCH_DTYPE=bf16 timeout 10800 python tools/bench_matrix.py --steps 15 \
+    --out tools/bench_matrix_bf16_r2b.json >> "$LOG" 2>/dev/null; then
+  train_rc=ok
+else
+  train_rc="FAILED rc=$?"
+fi
 echo "=== eval matrix $(date)" >> "$LOG"
-BENCH_DTYPE=bf16 timeout 7200 python tools/bench_matrix.py --steps 15 \
-  --mode eval --out tools/bench_matrix_eval.json >> "$LOG" 2>/dev/null
-echo "MATRIX REFRESH DONE $(date)" >> "$LOG"
+if BENCH_DTYPE=bf16 timeout 7200 python tools/bench_matrix.py --steps 15 \
+    --mode eval --out tools/bench_matrix_eval.json >> "$LOG" 2>/dev/null; then
+  eval_rc=ok
+else
+  eval_rc="FAILED rc=$?"
+fi
+echo "MATRIX REFRESH DONE (train: $train_rc, eval: $eval_rc) $(date)" >> "$LOG"

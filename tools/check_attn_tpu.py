@@ -68,8 +68,10 @@ def main() -> int:
             np.testing.assert_allclose(
                 np.asarray(fwd_k), np.asarray(fwd_e), rtol=2e-4, atol=2e-4
             )
-            gk = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2)))(q, k, v)
-            ge = jax.jit(jax.grad(loss_einsum, argnums=(0, 1, 2)))(q, k, v)
+            gk_f = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2)))
+            ge_f = jax.jit(jax.grad(loss_einsum, argnums=(0, 1, 2)))
+            gk = gk_f(q, k, v)
+            ge = ge_f(q, k, v)
             for a, b, nm in zip(gk, ge, "qkv"):
                 np.testing.assert_allclose(
                     np.asarray(a),
@@ -78,7 +80,25 @@ def main() -> int:
                     atol=2e-3,
                     err_msg=f"d{nm}",
                 )
-            print(f"OK   n={n} l={l} m={m} h={h} e={e}")
+
+            # Microbench: fused vs einsum fwd+bwd (20 reps after warmup),
+            # reusing the already-compiled grad wrappers above.
+            import time
+
+            def t(fn):
+                fn(q, k, v)[0].block_until_ready()
+                t0 = time.perf_counter()
+                for _ in range(20):
+                    out = fn(q, k, v)
+                out[0].block_until_ready()
+                return (time.perf_counter() - t0) / 20 * 1e6
+
+            us_k, us_e = t(gk_f), t(ge_f)
+            print(
+                f"OK   n={n} l={l} m={m} h={h} e={e}  "
+                f"fwd+bwd fused {us_k:.0f}us vs einsum {us_e:.0f}us "
+                f"({us_e / us_k:.2f}x)"
+            )
         except Exception as exc:  # noqa: BLE001 - report and continue
             failures += 1
             msg = str(exc).splitlines()[0][:160] if str(exc) else repr(exc)
