@@ -502,10 +502,45 @@ def bench_loader() -> None:
     run()
 
 
+def _warn_stale_watcher_queues() -> None:
+    """A queued-measurement log that starts but never reaches a terminal
+    marker means a watcher died silently — round 2 lost its most important
+    numbers that way. Surface it on every bench run."""
+    import glob
+    import re
+
+    terminal_re = re.compile(r"ALL DONE|REFRESH DONE|DONE \(")
+    for path in glob.glob(os.path.join(_REPO, "tools", "ab_*.log")):
+        try:
+            # A watcher mid-run legitimately has no terminal marker yet —
+            # only call it stale once the log has sat untouched for 30 min
+            # (every runner step appends, refreshing mtime).
+            import time as _time
+
+            if _time.time() - os.path.getmtime(path) < 1800:
+                continue
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            continue
+        # Stale iff the LAST start marker has no terminal marker after it —
+        # catches a dead second watcher appending to a log whose first
+        # watcher finished (the exact round-2 failure mode).
+        last_start = None
+        for m in re.finditer(r"\bstart\b", text):
+            last_start = m.end()
+        if last_start is not None and not terminal_re.search(text, last_start):
+            _eprint(
+                f"WARNING: stale watcher queue {path} — started but has no "
+                f"terminal status; its measurements likely never ran"
+            )
+
+
 def main() -> None:
     from seist_tpu.utils.platform import honor_jax_platforms
 
     honor_jax_platforms()
+    _warn_stale_watcher_queues()
     mode = os.environ.get("BENCH_MODE", "train")
     model_name = env_config()["model"]
     kind_suffix = "eval" if mode == "eval" else "train"

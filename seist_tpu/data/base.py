@@ -11,6 +11,7 @@ same seed must yield the same split across train and later test runs
 from __future__ import annotations
 
 import copy
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import pandas as pd
@@ -18,6 +19,59 @@ import pandas as pd
 from seist_tpu.utils.logger import logger
 
 Event = Dict[str, Any]
+
+
+class _H5Handles(threading.local):
+    """Per-thread LRU cache of read-only h5py file handles.
+
+    h5py file opens cost ~0.3 ms each (profiled: 2 opens/sample dominated
+    the DiTing read stage); handles are NOT thread-safe to share, so each
+    loader thread keeps its own. Capped (LRU-evicted handles are closed)
+    so threads x part-files cannot exhaust the process fd limit: 28 DiTing
+    parts x 32 threads would be ~900 fds uncapped vs 1024 default ulimit.
+    Process-pool workers each get a fresh module state, so the cache
+    composes with ``--loader-processes``.
+    """
+
+    MAX_OPEN = 16  # per thread
+
+    def __init__(self):
+        from collections import OrderedDict
+
+        self.handles: "OrderedDict[str, Any]" = OrderedDict()
+
+
+_h5_local = _H5Handles()
+
+
+def open_h5(path: str, group: Optional[str] = None):
+    """Thread-cached read-only ``h5py.File`` (see :class:`_H5Handles`).
+
+    With ``group``, returns the (also cached) named group — saves the
+    per-sample path walk when every event lives under one root group.
+    """
+    import h5py
+
+    cache = _h5_local.handles
+    entry = cache.get(path)
+    if entry is None or not entry[0]:  # File is falsy once closed/invalid
+        entry = (h5py.File(path, "r"), {})
+        cache[path] = entry
+        if len(cache) > _H5Handles.MAX_OPEN:
+            _, (old_f, _) = cache.popitem(last=False)
+            try:
+                old_f.close()
+            except Exception:  # noqa: BLE001 - already-invalid handle
+                pass
+    else:
+        cache.move_to_end(path)
+    f, groups = entry
+    if group is None:
+        return f
+    g = groups.get(group)
+    if g is None:
+        g = groups[group] = f[group]
+    return g
 
 
 class DatasetBase:
@@ -77,6 +131,21 @@ class DatasetBase:
             meta_df = meta_df.iloc[lo:hi, :]
             logger.info(f"Data Split: {self._mode}: {lo}-{hi}")
         return meta_df
+
+    # -- fast row access -----------------------------------------------------
+    def _row_dict(self, idx: int) -> Dict[str, Any]:
+        """Metadata row ``idx`` as a plain dict, via a one-time column->numpy
+        cache. ``DataFrame.iloc[idx]`` + per-field ``Series.__getitem__`` cost
+        ~1 ms/sample in the loader hot path (profiled); numpy scalar indexing
+        is ~30x cheaper and readers keep the same ``row[col]`` syntax."""
+        cols = getattr(self, "_col_cache", None)
+        if cols is None:
+            cols = {
+                c: self._meta_data[c].to_numpy()
+                for c in self._meta_data.columns
+            }
+            self._col_cache = cols
+        return {c: a[idx] for c, a in cols.items()}
 
     # -- public API (ref base.py:67-90) --------------------------------------
     def __len__(self) -> int:

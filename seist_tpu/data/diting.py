@@ -19,7 +19,7 @@ from typing import Tuple
 import numpy as np
 import pandas as pd
 
-from seist_tpu.data.base import DatasetBase, Event
+from seist_tpu.data.base import DatasetBase, Event, open_h5
 from seist_tpu.registry import register_dataset
 
 _META_DTYPES = {
@@ -101,14 +101,12 @@ class DiTing(DatasetBase):
         return self._shuffle_and_split(meta_df)
 
     def _load_event_data(self, idx: int) -> Tuple[Event, dict]:
-        row = self._meta_data.iloc[idx]
+        row = self._row_dict(idx)
         key = normalize_key(str(row["key"]))
         path = os.path.join(self._data_dir, f"DiTing330km_part_{row['part']}.hdf5")
 
-        import h5py
-
-        with h5py.File(path, "r") as f:
-            data = np.array(f.get("earthquake/" + key)).astype(np.float32).T
+        grp = open_h5(path, group="earthquake")
+        data = np.array(grp.get(key)).astype(np.float32).T
 
         motion = row["p_motion"]
         if pd.notnull(motion) and str(motion).lower() not in ("", "n"):
@@ -145,7 +143,7 @@ class DiTing(DatasetBase):
             "dis": [row["dis"]] if pd.notnull(row["dis"]) else [],
             "snr": snr,
         }
-        return event, row.to_dict()
+        return event, row
 
 
 class DiTingLight(DiTing):

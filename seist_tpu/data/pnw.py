@@ -20,7 +20,7 @@ from typing import Tuple
 import numpy as np
 import pandas as pd
 
-from seist_tpu.data.base import DatasetBase, Event
+from seist_tpu.data.base import DatasetBase, Event, open_h5
 from seist_tpu.registry import register_dataset
 
 
@@ -57,14 +57,12 @@ class PNW(DatasetBase):
         return self._shuffle_and_split(meta_df)
 
     def _load_event_data(self, idx: int) -> Tuple[Event, dict]:
-        row = self._meta_data.iloc[idx]
+        row = self._row_dict(idx)
         bucket, n = parse_trace_name(row["trace_name"])
 
-        import h5py
-
         path = os.path.join(self._data_dir, "comcat_waveforms.hdf5")
-        with h5py.File(path, "r") as f:
-            data = np.nan_to_num(np.array(f.get(f"data/{bucket}")[n], dtype=np.float32))
+        f = open_h5(path)
+        data = np.nan_to_num(np.array(f.get(f"data/{bucket}")[n], dtype=np.float32))
 
         motion = {"positive": 0, "negative": 1, "undecidable": 2, "": 3}[
             str(row["trace_P_polarity"]).lower()
@@ -87,7 +85,7 @@ class PNW(DatasetBase):
             "clr": [0],  # compatibility with other datasets (ref pnw.py:146)
             "snr": snr,
         }
-        return event, row.to_dict()
+        return event, row
 
 
 class PNWLight(PNW):

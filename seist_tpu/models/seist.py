@@ -533,13 +533,24 @@ class AttentionBlock(nn.Module):
         k = nn.Dropout(self.key_drop_rate, deterministic=not train)(k)
 
         rate = self.attn_drop_rate if train else 0.0
+        seed = None
+        if rate > 0.0:
+            seed = jax.random.randint(
+                self.make_rng("dropout"),
+                (1,),
+                0,
+                jnp.iinfo(jnp.int32).max,
+                dtype=jnp.int32,
+            )
         mesh = _active_seq_mesh()
         if mesh is not None:
             # --seq-shards: sequence-parallel exact attention over the
             # mesh's `seq` axis (Q blocks resident, K/V rotating on ICI —
-            # ops/ring_attention.py). Long-context path the reference lacks;
-            # probability dropout is not applied here (key-dropout above
-            # still is) — logged once by the worker when rates are nonzero.
+            # ops/ring_attention.py). Long-context path the reference lacks.
+            # Probability dropout (ref seist.py:383-388) applies inside the
+            # ring accumulation with the SAME counter-based mask as the
+            # dense/fused paths, so seq-parallel training semantics match
+            # single-device training exactly.
             from seist_tpu.ops.ring_attention import ring_attention
 
             out = ring_attention(
@@ -549,6 +560,8 @@ class AttentionBlock(nn.Module):
                 mesh,
                 batch_axis="data",
                 scale=1.0 / math.sqrt(E),
+                dropout_rate=rate,
+                dropout_seed=seed,
             )
         else:
             # Fused Pallas kernel on TPU (qk + softmax + dropout + pv in
@@ -558,15 +571,6 @@ class AttentionBlock(nn.Module):
             # flax 'dropout' stream.
             from seist_tpu.ops.pallas_attention import fused_pooled_attention
 
-            seed = None
-            if rate > 0.0:
-                seed = jax.random.randint(
-                    self.make_rng("dropout"),
-                    (1,),
-                    0,
-                    jnp.iinfo(jnp.int32).max,
-                    dtype=jnp.int32,
-                )
             out = fused_pooled_attention(
                 q,
                 k,

@@ -33,6 +33,7 @@ Pallas interpreter for CPU testing.
 
 from __future__ import annotations
 
+import logging
 import math
 import os
 from functools import partial
@@ -43,24 +44,31 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+_log = logging.getLogger("seist_tpu.pallas_attention")
 
-def _uniform01(seed, pid, l: int, m: int) -> jnp.ndarray:
-    """Deterministic (L, M) uniforms in [0, 1) for batch-head slice ``pid``.
 
-    Counter-based (murmur3-finalizer over a linear element index), pure jnp
-    — runs identically inside a Pallas kernel, under the interpreter, and in
-    the XLA fallback, so all three paths agree bit-for-bit on the mask.
+def _wrap_i32(v: int) -> jnp.ndarray:
+    """Python int -> int32 constant with explicit two's-complement wrap.
+
+    ``jnp.int32(big)`` raises under numpy>=2; the counter math here wraps
+    mod 2^32 by design (long-context L*M can exceed 2^31 — the hash mixes
+    the wrapped bits the same way on every path).
     """
-    # int32 throughout (Mosaic lacks uint32<->float casts): multiplies wrap
-    # two's-complement — identical low 32 bits to the uint32 murmur mix —
-    # and shifts are explicit logical shifts.
+    return jnp.int32(np.uint32(int(v) & 0xFFFFFFFF).astype(np.int32))
+
+
+def _mix_to_uniform(x, seed) -> jnp.ndarray:
+    """murmur3-finalizer hash of int32 counter array ``x`` -> U[0,1).
+
+    int32 throughout (Mosaic lacks uint32<->float casts): multiplies wrap
+    two's-complement — identical low 32 bits to the uint32 murmur mix —
+    and shifts are explicit logical shifts.
+    """
+
     def c(u):  # uint32 constant as wrapped int32
         return jnp.int32(np.uint32(u).astype(np.int32))
 
     shr = lambda x, n: lax.shift_right_logical(x, jnp.int32(n))
-    row = lax.broadcasted_iota(jnp.int32, (l, m), 0)
-    col = lax.broadcasted_iota(jnp.int32, (l, m), 1)
-    x = pid.astype(jnp.int32) * jnp.int32(l * m) + row * jnp.int32(m) + col
     x = x ^ (seed.astype(jnp.int32) * c(0x9E3779B9))
     x = x ^ shr(x, 16)
     x = x * c(0x85EBCA6B)
@@ -68,6 +76,21 @@ def _uniform01(seed, pid, l: int, m: int) -> jnp.ndarray:
     x = x * c(0xC2B2AE35)
     x = x ^ shr(x, 16)
     return shr(x, 8).astype(jnp.float32) * (1.0 / (1 << 24))
+
+
+def _uniform01(seed, pid, l: int, m: int) -> jnp.ndarray:
+    """Deterministic (L, M) uniforms in [0, 1) for batch-head slice ``pid``.
+
+    Counter-based (murmur3-finalizer over a linear element index), pure jnp
+    — runs identically inside a Pallas kernel, under the interpreter, and in
+    the XLA fallback, so all three paths agree bit-for-bit on the mask.
+    The ring-attention path generates the same stream blockwise via
+    ``_uniform01_block``.
+    """
+    row = lax.broadcasted_iota(jnp.int32, (l, m), 0)
+    col = lax.broadcasted_iota(jnp.int32, (l, m), 1)
+    x = pid.astype(jnp.int32) * _wrap_i32(l * m) + row * _wrap_i32(m) + col
+    return _mix_to_uniform(x, seed)
 
 
 def _apply_dropout(p, seed, pid, rate: float):
@@ -240,6 +263,68 @@ def _fused_bwd(scale, rate, heads, interpret, res, g):
 _fused.defvjp(_fused_fwd, _fused_bwd)
 
 
+# -- kernel health probe ------------------------------------------------------
+#
+# A Mosaic version can reject the kernel at compile time (the head-folded
+# layout writes E-wide feature slices that are not 128-lane aligned). That
+# failure would surface only when the *enclosing* train-step jit compiles —
+# taking down the default train path. Instead, the first TPU-backend call per
+# (L, M, H*E, dropout?, dtype) signature eagerly compiles+runs the kernel
+# fwd+bwd on a batch-1 slice of the real shape (the grid is over batch, so
+# batch-1 exercises the exact per-step block shapes). On failure we log once
+# and route that signature to the identical-math einsum path. Explicit
+# requests (interpret/force/SEIST_ATTN_IMPL=fused) bypass the probe so parity
+# tooling still sees the raw error.
+
+_KERNEL_STATUS: dict = {}
+_FALLBACK_LOGGED = False
+
+
+def _probe_kernel(l, m, he, heads, rate, dtype) -> None:
+    q = jnp.zeros((1, l, he), dtype)
+    k = jnp.zeros((1, m, he), dtype)
+    seed = jnp.zeros((1,), jnp.int32)
+
+    def f(q, k, v):
+        return _fused(q, k, v, seed, 1.0, rate, heads, False).sum()
+
+    g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, k)
+    g[0].block_until_ready()
+
+
+def _kernel_usable(l, m, he, heads, rate, dtype) -> bool:
+    key = (l, m, he, heads, rate > 0.0, jnp.dtype(dtype).name)
+    hit = _KERNEL_STATUS.get(key)
+    if hit is not None:
+        return hit
+    try:
+        _probe_kernel(l, m, he, heads, float(rate), dtype)
+        ok = True
+    except Exception as exc:  # noqa: BLE001 - any compile/runtime rejection
+        global _FALLBACK_LOGGED
+        if not _FALLBACK_LOGGED:
+            _FALLBACK_LOGGED = True
+            _log.warning(
+                "fused attention kernel unusable for shape L=%d M=%d HE=%d "
+                "H=%d %s (%s: %s); falling back to the identical-math einsum "
+                "path (SEIST_ATTN_IMPL=fused to force the kernel)",
+                l,
+                m,
+                he,
+                heads,
+                jnp.dtype(dtype).name,
+                type(exc).__name__,
+                str(exc).splitlines()[0][:200] if str(exc) else "",
+            )
+        ok = False
+    _KERNEL_STATUS[key] = ok
+    return ok
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
 def fused_pooled_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -268,8 +353,10 @@ def fused_pooled_attention(
     if dropout_seed is None:
         dropout_seed = jnp.zeros((1,), jnp.int32)
     dropout_seed = dropout_seed.astype(jnp.int32)
-    # Escape hatch: SEIST_ATTN_IMPL=einsum forces the identical-math XLA
-    # path even on TPU (e.g. if a Mosaic version rejects the kernel).
+    # Escape hatches: SEIST_ATTN_IMPL=einsum forces the identical-math XLA
+    # path even on TPU; =fused forces the kernel (skipping the health probe,
+    # so a Mosaic rejection surfaces raw). Unset = auto: kernel on TPU with
+    # a one-time per-shape compile probe and automatic einsum fallback.
     # Explicit kernel requests (interpret/force, used by parity tooling)
     # take precedence over the ambient env var.
     env_impl = os.environ.get("SEIST_ATTN_IMPL")
@@ -279,10 +366,15 @@ def fused_pooled_attention(
         )
     if env_impl == "einsum" and not (interpret or force):
         return _einsum_attention(q, k, v, scale, dropout_rate, dropout_seed)
-    on_tpu = jax.default_backend() == "tpu"
-    if not (on_tpu or interpret or force):
+    if not (_on_tpu() or interpret or force):
         return _einsum_attention(q, k, v, scale, dropout_rate, dropout_seed)
     h = q.shape[2]
+    if not (interpret or force or env_impl == "fused"):
+        l, m, he = q.shape[1], k.shape[1], h * e
+        if not _kernel_usable(l, m, he, h, dropout_rate, q.dtype):
+            return _einsum_attention(
+                q, k, v, scale, dropout_rate, dropout_seed
+            )
     o3 = _fused(
         _fold_heads(q),
         _fold_heads(k),

@@ -1,5 +1,9 @@
 """Parallelism layer: device mesh, shardings, multi-host helpers."""
 
+from seist_tpu.parallel.collectives import (  # noqa: F401
+    collective_stats,
+    format_collective_stats,
+)
 from seist_tpu.parallel.dist import (  # noqa: F401
     barrier,
     broadcast_object,

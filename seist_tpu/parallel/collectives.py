@@ -1,0 +1,98 @@
+"""Collective-traffic accounting from compiled HLO.
+
+The reference's distributed story is NCCL calls whose traffic is invisible
+until profiled on a cluster (ref utils/misc.py:103-172). Here the entire
+communication schedule is decided by XLA at compile time, so the per-step
+collective payload — what will ride the ICI links — can be read directly
+off the optimized HLO of the compiled train step, with no hardware at all.
+
+``collective_stats`` parses an ``xla_computation.as_text()`` /
+``compiled.as_text()`` dump and returns, per collective kind
+(all-reduce, all-gather, reduce-scatter, collective-permute, all-to-all),
+the op count and the summed payload bytes (output-shape bytes of each
+collective op; ``-start``/``-done`` async pairs are counted once at the
+start op). These are payload bytes; actual link traffic per chip for a
+ring all-reduce of payload P over N devices is 2*(N-1)/N * P.
+
+Counts are STATIC: a collective inside a ``while``/``scan`` body is
+counted once, not per trip — e.g. ring attention's collective-permute
+executes axis_size-1 times per step but appears as x1 here. For loop-
+carried collectives multiply by the trip count yourself (the DP train
+step's gradient/BN all-reduces are loop-free, so its numbers are exact).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict
+
+_DTYPE_BYTES = {
+    "pred": 1,
+    "s8": 1,
+    "u8": 1,
+    "s16": 2,
+    "u16": 2,
+    "f16": 2,
+    "bf16": 2,
+    "s32": 4,
+    "u32": 4,
+    "f32": 4,
+    "s64": 8,
+    "u64": 8,
+    "f64": 8,
+    "c64": 8,
+    "c128": 16,
+}
+
+_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# `%x = f32[8,128]{1,0} all-reduce(...)` or tuple-shaped
+# `%x = (f32[8]{0}, f32[8]{0}) all-gather-start(...)`.
+_SHAPE_RE = re.compile(r"([a-z]+\d*)\[([\d,]*)\]")
+_OP_RE = re.compile(
+    r"=\s*(?P<lhs>\([^)]*\)|[a-z]+\d*\[[\d,]*\]\S*)\s*"
+    r"(?P<kind>" + "|".join(_KINDS) + r")(?P<suffix>-start|-done)?\("
+)
+
+
+def _shape_bytes(text: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        if dims:
+            n = math.prod(int(d) for d in dims.split(",") if d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Per-kind ``{count, bytes}`` for every collective in an HLO dump."""
+    stats: Dict[str, Dict[str, int]] = {}
+    for m in _OP_RE.finditer(hlo_text):
+        if m.group("suffix") == "-done":
+            continue  # counted at the paired -start
+        kind = m.group("kind")
+        entry = stats.setdefault(kind, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += _shape_bytes(m.group("lhs"))
+    return stats
+
+
+def format_collective_stats(stats: Dict[str, Dict[str, int]]) -> str:
+    if not stats:
+        return "no collectives"
+    parts = [
+        f"{kind} x{s['count']} {s['bytes'] / 1e6:.2f} MB"
+        for kind, s in sorted(stats.items())
+    ]
+    total = sum(s["bytes"] for s in stats.values())
+    return ", ".join(parts) + f" (total {total / 1e6:.2f} MB/step payload)"

@@ -247,8 +247,7 @@ def train_worker(args: Any) -> str:
     if seq_shards > 1:
         logger.info(
             f"Sequence parallelism: ring attention over {seq_shards} shards "
-            f"(--seq-shards); attention-probability dropout is not applied "
-            f"on the ring path (key/proj dropout still are)"
+            f"(--seq-shards); dropout semantics match dense training"
         )
     data_axis = mesh.shape[mesh_lib.AXIS_DATA]
     if (args.batch_size * jax.process_count()) % data_axis:

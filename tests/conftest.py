@@ -30,3 +30,30 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# Smoke lane (`pytest -m smoke`): the pure-unit subset that verifies the
+# round's core claims in <5 min on a 1-core host (measured ~90 s). Files are
+# marked here centrally so the lane can't silently drift as tests are added;
+# model-forward/e2e/golden tests stay out (jit compiles dominate them).
+_SMOKE_FILES = {
+    "test_losses.py",
+    "test_metrics.py",
+    "test_postprocess.py",
+    "test_misc.py",
+    "test_taskspec.py",
+    "test_preprocess.py",
+    "test_results.py",
+    "test_common_ops.py",
+    "test_collectives.py",
+    "test_visualization.py",
+    "test_stream.py",
+    "test_supervise.py",
+    "test_native.py",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if os.path.basename(str(item.fspath)) in _SMOKE_FILES:
+            item.add_marker(pytest.mark.smoke)

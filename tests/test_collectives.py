@@ -1,0 +1,72 @@
+"""Collective-traffic accounting from compiled HLO (parallel/collectives.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seist_tpu.parallel import (
+    collective_stats,
+    format_collective_stats,
+    make_mesh,
+)
+
+_FAKE_HLO = """
+  %ar = f32[128,4]{1,0} all-reduce(f32[128,4]{1,0} %p0), replica_groups={}
+  %ag.1 = (f32[8]{0}, f32[64]{0}) all-gather-start(f32[8]{0} %p1), dimensions={0}
+  %ag.2 = f32[64]{0} all-gather-done((f32[8]{0}, f32[64]{0}) %ag.1)
+  %cp = bf16[2,16]{1,0} collective-permute(bf16[2,16]{1,0} %p2)
+  %add = f32[4]{0} add(f32[4]{0} %a, f32[4]{0} %b)
+"""
+
+
+def test_parses_kinds_and_bytes():
+    stats = collective_stats(_FAKE_HLO)
+    assert stats["all-reduce"] == {"count": 1, "bytes": 128 * 4 * 4}
+    # -start counted once (both tuple elements), -done skipped.
+    assert stats["all-gather"] == {"count": 1, "bytes": (8 + 64) * 4}
+    assert stats["collective-permute"] == {"count": 1, "bytes": 2 * 16 * 2}
+    assert "add" not in stats
+
+
+def test_format_and_empty():
+    assert format_collective_stats({}) == "no collectives"
+    s = format_collective_stats(collective_stats(_FAKE_HLO))
+    assert "all-reduce x1" in s and "total" in s
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 virtual devices")
+def test_live_psum_shows_all_reduce():
+    mesh = make_mesh(data=8)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @jax.jit
+    def f(x):
+        y = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("data"))
+        )
+        return (y * 2).sum()
+
+    x = jnp.ones((16, 4))
+    hlo = f.lower(x).compile().as_text()
+    stats = collective_stats(hlo)
+    # The cross-shard sum must appear as some reduction collective.
+    assert any(
+        k in stats for k in ("all-reduce", "reduce-scatter", "all-gather")
+    ), stats
+
+
+def test_live_ppermute_bytes():
+    mesh = make_mesh(data=1, seq=min(8, jax.device_count()))
+    n_seq = mesh.shape["seq"]
+    if n_seq < 2:
+        pytest.skip("needs a seq axis")
+    from seist_tpu.ops.ring_attention import ring_attention
+
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((2, 8 * n_seq, 1, 8)).astype(np.float32)
+    f = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))
+    hlo = f.lower(q, q, q).compile().as_text()
+    stats = collective_stats(hlo)
+    assert "collective-permute" in stats
+    assert stats["collective-permute"]["bytes"] > 0

@@ -72,6 +72,67 @@ def test_cpu_fallback_is_einsum(rng):
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
 
 
+def test_kernel_compile_failure_falls_back(rng, monkeypatch, caplog):
+    # If Mosaic rejects the kernel (simulated: pretend we're on TPU so the
+    # health probe actually tries to compile the Pallas TPU kernel — which
+    # genuinely fails on this CPU host, exactly like a Mosaic rejection),
+    # the public API must log once and return the einsum result instead of
+    # raising inside the enclosing train-step jit.
+    import logging
+
+    from seist_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_on_tpu", lambda: True)
+    monkeypatch.setattr(pa, "_KERNEL_STATUS", {})
+    monkeypatch.setattr(pa, "_FALLBACK_LOGGED", False)
+    q, k, v = _qkv(rng)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    with caplog.at_level(logging.WARNING, "seist_tpu.pallas_attention"):
+        got = np.asarray(fused_pooled_attention(q, k, v, scale))
+        again = np.asarray(fused_pooled_attention(q, k, v, scale))
+    want = np.asarray(_einsum_attention(q, k, v, scale))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(again, want, rtol=1e-6, atol=1e-6)
+    fallback_logs = [
+        r for r in caplog.records if "falling back" in r.getMessage()
+    ]
+    assert len(fallback_logs) == 1  # logged once, cached after
+    assert pa._KERNEL_STATUS  # signature recorded as unusable
+
+
+def test_kernel_failure_fallback_inside_jit(rng, monkeypatch):
+    # The probe runs eagerly even when the call site is being traced under
+    # an outer jit (the train-step case): tracing must complete and the
+    # jitted function must produce the einsum result.
+    from seist_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_on_tpu", lambda: True)
+    monkeypatch.setattr(pa, "_KERNEL_STATUS", {})
+    monkeypatch.setattr(pa, "_FALLBACK_LOGGED", False)
+    q, k, v = _qkv(rng)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    got = np.asarray(
+        jax.jit(lambda q, k, v: fused_pooled_attention(q, k, v, scale))(
+            q, k, v
+        )
+    )
+    want = np.asarray(_einsum_attention(q, k, v, scale))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_env_fused_bypasses_probe(rng, monkeypatch):
+    # SEIST_ATTN_IMPL=fused must skip the health probe and surface the raw
+    # kernel error (parity tooling wants failures loud).
+    from seist_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_on_tpu", lambda: True)
+    monkeypatch.setattr(pa, "_KERNEL_STATUS", {})
+    monkeypatch.setenv("SEIST_ATTN_IMPL", "fused")
+    q, k, v = _qkv(rng)
+    with pytest.raises(Exception):
+        np.asarray(fused_pooled_attention(q, k, v))
+
+
 # -- in-kernel dropout -------------------------------------------------------
 
 

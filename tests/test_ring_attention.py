@@ -97,6 +97,105 @@ def test_dp_plus_sp_batch_axis(rng):
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
+# ------------------------------------------------ probability dropout parity
+import jax.numpy as jnp
+
+from seist_tpu.ops.pallas_attention import _einsum_attention
+
+
+def _seed(v=1234):
+    return jnp.asarray([v], jnp.int32)
+
+
+def _dense_dropout(q, k, v, rate, seed):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    return np.asarray(
+        _einsum_attention(q, k, v, scale, dropout_rate=rate, dropout_seed=seed)
+    )
+
+
+def test_dropout_matches_dense_mask_exactly(seq_mesh, rng):
+    # Same seed => the ring regenerates the dense path's mask slice per
+    # block, so outputs agree to fp tolerance (same math, same mask).
+    q, k, v = _qkv(rng, l=64)
+    want = _dense_dropout(q, k, v, 0.3, _seed())
+    got = np.asarray(
+        ring_attention(
+            q, k, v, seq_mesh, dropout_rate=0.3, dropout_seed=_seed()
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_dropout_matches_dense_pooled_kv(seq_mesh, rng):
+    # Pooled K/V (M != L): mask column space is the global M.
+    q = rng.normal(size=(2, 128, 2, 8)).astype(np.float32)
+    k = rng.normal(size=(2, 16, 2, 8)).astype(np.float32)
+    v = rng.normal(size=(2, 16, 2, 8)).astype(np.float32)
+    want = _dense_dropout(q, k, v, 0.25, _seed(7))
+    got = np.asarray(
+        ring_attention(
+            q, k, v, seq_mesh, dropout_rate=0.25, dropout_seed=_seed(7)
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_dropout_matches_dense_with_batch_axis(rng):
+    # dp x sp: the global batch offset must enter the mask stream so each
+    # data-shard regenerates its own rows of the dense mask.
+    mesh = make_mesh(data=4, model=1, seq=2)
+    q, k, v = _qkv(rng, n=4, l=64)
+    want = _dense_dropout(q, k, v, 0.3, _seed(3))
+    got = np.asarray(
+        ring_attention(
+            q,
+            k,
+            v,
+            mesh,
+            batch_axis="data",
+            dropout_rate=0.3,
+            dropout_seed=_seed(3),
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_dropout_grads_match_dense(seq_mesh, rng):
+    q, k, v = _qkv(rng, l=32)
+
+    def loss_ring(q, k, v):
+        return (
+            ring_attention(
+                q, k, v, seq_mesh, dropout_rate=0.3, dropout_seed=_seed()
+            )
+            ** 2
+        ).sum()
+
+    def loss_dense(q, k, v):
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        return (
+            _einsum_attention(
+                q, k, v, scale, dropout_rate=0.3, dropout_seed=_seed()
+            )
+            ** 2
+        ).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ring, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+            err_msg=f"d{name}",
+        )
+
+
+def test_dropout_requires_seed(seq_mesh, rng):
+    q, k, v = _qkv(rng, l=32)
+    with pytest.raises(ValueError, match="dropout_seed"):
+        ring_attention(q, k, v, seq_mesh, dropout_rate=0.3)
+
+
 # -------------------------------------------------- model path (--seq-shards)
 def test_seist_forward_matches_dense_under_seq_mesh(rng):
     """seist forward with an active seq-sharded mesh (the --seq-shards CLI

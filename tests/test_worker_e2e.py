@@ -101,6 +101,7 @@ def test_train_then_test(e2e_run):
     assert np.isfinite(loss)
 
 
+@pytest.mark.slow  # ~2 min incl. compile: 30-epoch learning regression
 def test_training_learns_p_picks(tmp_path_factory):
     """Training must actually LEARN, not merely keep the loss finite: 30
     constant-LR epochs of phasenet on the synthetic dataset reach P-pick

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-3: block until the TPU tunnel answers, then exit 0.
+# Driven interactively by the session (no fire-and-forget work here).
+probe() {
+  timeout 70 python -c "
+import jax, jax.numpy as jnp
+r = jax.jit(lambda a, b: a @ b)(jnp.ones((128,128)), jnp.ones((128,128)))
+r.block_until_ready(); print('UP')" 2>/dev/null | grep -q UP
+}
+n=0
+until probe; do
+  n=$((n+1))
+  echo "probe $n down $(date -u +%H:%M:%SZ)"
+  sleep 180
+done
+echo "TUNNEL UP $(date -u +%H:%M:%SZ)"
