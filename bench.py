@@ -408,11 +408,22 @@ def bench_train(device_kind: str) -> None:
         else 0.0
     )
 
+    # Two comparators (VERDICT r2: vs_baseline alone is misleading):
+    # vs_baseline keys on the measured torch-CPU anchor (the only
+    # magnitude-honest comparison available in a GPU-less sandbox);
+    # a100_analytical_wfs is what ONE A100 would do at OUR measured MFU of
+    # its 312 TFLOP/s bf16 peak — under that equal-MFU assumption the
+    # chip-vs-chip ratio reduces to the peak-FLOPs ratio (v5e/A100 ~ 0.63),
+    # which is the honest core of BASELINE.md's north-star argument.
+    a100_wfs = (
+        mfu * 312e12 / flops_per_wf if flops_per_wf and mfu else None
+    )
     payload = {
         "metric": metric,
         "value": round(wfs, 2),
         "unit": unit,
         "vs_baseline": _vs_baseline(wfs, model_name, in_samples),
+        "a100_analytical_wfs": round(a100_wfs, 1) if a100_wfs else None,
         "step_time_ms": round(step_ms, 2),
         "mfu": round(mfu, 4),
         "mfu_note": "vs bf16 dense peak",

@@ -281,15 +281,22 @@ _FALLBACK_LOGGED = False
 
 
 def _probe_kernel(l, m, he, heads, rate, dtype) -> None:
-    q = jnp.zeros((1, l, he), dtype)
-    k = jnp.zeros((1, m, he), dtype)
-    seed = jnp.zeros((1,), jnp.int32)
+    # ensure_compile_time_eval: the call site usually sits under the train
+    # step's jit trace — without escaping it, jnp.zeros would be tracers,
+    # the nested jit would inline instead of compile, and the probe would
+    # "fail" on a perfectly good kernel (permanently einsum-ing the
+    # default path). Inside this context the arrays are concrete and the
+    # jit genuinely compiles+runs on the backend.
+    with jax.ensure_compile_time_eval():
+        q = jnp.zeros((1, l, he), dtype)
+        k = jnp.zeros((1, m, he), dtype)
+        seed = jnp.zeros((1,), jnp.int32)
 
-    def f(q, k, v):
-        return _fused(q, k, v, seed, 1.0, rate, heads, False).sum()
+        def f(q, k, v):
+            return _fused(q, k, v, seed, 1.0, rate, heads, False).sum()
 
-    g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, k)
-    g[0].block_until_ready()
+        g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, k)
+        g[0].block_until_ready()
 
 
 def _kernel_usable(l, m, he, heads, rate, dtype) -> bool:

@@ -53,25 +53,35 @@ _KINDS = (
     "all-to-all",
 )
 
-# `%x = f32[8,128]{1,0} all-reduce(...)` or tuple-shaped
-# `%x = (f32[8]{0}, f32[8]{0}) all-gather-start(...)`.
+# `%x = f32[8,128]{1,0} all-reduce(...)` or tuple-shaped async starts with
+# TPU tiled layouts: `%x = (f32[388778]{0:T(1024)}, f32[388778]{0:T(1024)})
+# all-gather-start(...)` — the lhs is matched lazily up to the op keyword
+# because layout annotations nest parentheses.
 _SHAPE_RE = re.compile(r"([a-z]+\d*)\[([\d,]*)\]")
 _OP_RE = re.compile(
-    r"=\s*(?P<lhs>\([^)]*\)|[a-z]+\d*\[[\d,]*\]\S*)\s*"
+    r"=\s*(?P<lhs>[^=\n]*?)\s*"
     r"(?P<kind>" + "|".join(_KINDS) + r")(?P<suffix>-start|-done)?\("
 )
 
 
-def _shape_bytes(text: str) -> int:
-    total = 0
-    for dtype, dims in _SHAPE_RE.findall(text):
+def _payload_bytes(lhs: str) -> int:
+    """Payload of one collective = the LARGEST shape on its lhs.
+
+    Async ``-start`` ops (and TPU sync tuples) carry aliased input/output
+    copies of the same buffer in a tuple — summing all elements would
+    double-count, and collective-permute-start adds u32 context scalars.
+    The largest single shape is the transferred buffer for every kind
+    (all-gather's output, all-reduce's buffer, permute's block).
+    """
+    best = 0
+    for dtype, dims in _SHAPE_RE.findall(lhs):
         if dtype not in _DTYPE_BYTES:
             continue
         n = 1
         if dims:
             n = math.prod(int(d) for d in dims.split(",") if d)
-        total += n * _DTYPE_BYTES[dtype]
-    return total
+        best = max(best, n * _DTYPE_BYTES[dtype])
+    return best
 
 
 def collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
@@ -83,7 +93,7 @@ def collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
         kind = m.group("kind")
         entry = stats.setdefault(kind, {"count": 0, "bytes": 0})
         entry["count"] += 1
-        entry["bytes"] += _shape_bytes(m.group("lhs"))
+        entry["bytes"] += _payload_bytes(m.group("lhs"))
     return stats
 
 

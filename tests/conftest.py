@@ -12,16 +12,24 @@ import os
 # real TPU tunnel (e.g. "axon") and its sitecustomize registers that backend
 # at interpreter start, so the env var alone is not enough — the config update
 # below (before any device query) is what actually forces CPU.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+#
+# Escape hatch SEIST_TEST_TPU=1: leave the real TPU backend in place so the
+# hardware lane (golden parity through the composed/fused TPU-default
+# lowerings, tools/r3_silicon.sh parity step) runs on the chip. Virtual-mesh
+# multi-device tests will then see 1 device and skip.
+_USE_TPU = os.environ.get("SEIST_TEST_TPU") == "1"
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _USE_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -19,14 +19,30 @@ _FAKE_HLO = """
   %add = f32[4]{0} add(f32[4]{0} %a, f32[4]{0} %b)
 """
 
+# Real-TPU shape: async start with tiled layouts (nested parens in the
+# lhs tuple) and u32 context scalars on a permute.
+_TPU_HLO = """
+  %ars = (f32[388778]{0:T(1024)}, f32[388778]{0:T(1024)}) all-reduce-start(f32[388778]{0:T(1024)} %fusion.1)
+  %ard = f32[388778]{0:T(1024)} all-reduce-done((f32[388778]{0:T(1024)}, f32[388778]{0:T(1024)}) %ars)
+  %cps = (bf16[2,64]{1,0:T(8,128)(2,1)}, bf16[2,64]{1,0:T(8,128)(2,1)}, u32[]{:T(128)}, u32[]{:T(128)}) collective-permute-start(bf16[2,64]{1,0:T(8,128)(2,1)} %x)
+"""
+
 
 def test_parses_kinds_and_bytes():
     stats = collective_stats(_FAKE_HLO)
     assert stats["all-reduce"] == {"count": 1, "bytes": 128 * 4 * 4}
-    # -start counted once (both tuple elements), -done skipped.
-    assert stats["all-gather"] == {"count": 1, "bytes": (8 + 64) * 4}
+    # -start counted once, payload = LARGEST tuple shape (the output;
+    # summing would double-count aliased in/out buffers); -done skipped.
+    assert stats["all-gather"] == {"count": 1, "bytes": 64 * 4}
     assert stats["collective-permute"] == {"count": 1, "bytes": 2 * 16 * 2}
     assert "add" not in stats
+
+
+def test_parses_tpu_async_tiled_layouts():
+    stats = collective_stats(_TPU_HLO)
+    assert stats["all-reduce"] == {"count": 1, "bytes": 388778 * 4}
+    # permute payload = the bf16 block, not the u32 context scalars
+    assert stats["collective-permute"] == {"count": 1, "bytes": 2 * 64 * 2}
 
 
 def test_format_and_empty():

@@ -236,3 +236,39 @@ class TestProcessWorkers:
         finally:
             lt.close()
             lp.close()
+
+
+class TestH5HandleCache:
+    def test_lru_caps_open_files(self, tmp_path):
+        import h5py
+
+        from seist_tpu.data import base
+
+        paths = []
+        for i in range(base._H5Handles.MAX_OPEN + 4):
+            p = tmp_path / f"f{i}.h5"
+            with h5py.File(p, "w") as f:
+                f.create_dataset("g/x", data=[i])
+            paths.append(str(p))
+
+        # Fresh thread => fresh thread-local cache, isolated from other tests.
+        import threading
+
+        result = {}
+
+        def run():
+            for p in paths:
+                base.open_h5(p, group="g")
+            cache = base._h5_local.handles
+            result["n"] = len(cache)
+            result["evicted_closed"] = not cache.get(paths[0], (None,))[0]
+            # Evicted-and-reopened path must work (and re-cache).
+            f = base.open_h5(paths[0], group="g")
+            result["reopened"] = bool(f)
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join()
+        assert result["n"] <= base._H5Handles.MAX_OPEN + 1
+        assert result["evicted_closed"]  # oldest handle was closed, not leaked
+        assert result["reopened"]

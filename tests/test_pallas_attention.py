@@ -120,6 +120,37 @@ def test_kernel_failure_fallback_inside_jit(rng, monkeypatch):
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
 
 
+def test_probe_runs_eagerly_under_outer_jit(rng, monkeypatch):
+    # The probe must escape an ambient jit trace (ensure_compile_time_eval)
+    # and genuinely compile+run — otherwise tracer leakage would mark a
+    # GOOD kernel unusable and silently einsum the default TPU train path.
+    import jax.numpy as jnp
+
+    from seist_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_on_tpu", lambda: True)
+    monkeypatch.setattr(pa, "_KERNEL_STATUS", {})
+    seen = {}
+
+    def fake_probe(l, m, he, heads, rate, dtype):
+        x = jnp.zeros((2, 2))
+        if isinstance(x, jax.core.Tracer):
+            raise RuntimeError("probe saw tracers — not eager")
+        jax.jit(lambda a: a @ a)(x).block_until_ready()
+        seen["eager"] = True
+
+    monkeypatch.setattr(pa, "_probe_kernel", fake_probe)
+    # Stub the kernel so the outer jit can compile on CPU after the probe
+    # reports the (pretend) kernel healthy.
+    monkeypatch.setattr(
+        pa, "_fused", lambda q3, k3, v3, seed, *a: q3
+    )
+    q, k, v = _qkv(rng)
+    jax.jit(lambda q, k, v: fused_pooled_attention(q, k, v, 1.0))(q, k, v)
+    assert seen.get("eager")
+    assert list(pa._KERNEL_STATUS.values()) == [True]
+
+
 def test_env_fused_bypasses_probe(rng, monkeypatch):
     # SEIST_ATTN_IMPL=fused must skip the health probe and surface the raw
     # kernel error (parity tooling wants failures loud).

@@ -105,3 +105,33 @@ def write_diting_light_fixture(
             for key, data in waves[part].items():
                 f.create_dataset("earthquake/" + key, data=data)
     return root
+
+
+def ensure_loader_fixture(n_events: int, in_samples: int) -> str:
+    """Idempotent DiTing-light fixture under logs/, shared by the loader
+    tools (bench_loader / loader_stage_budget / gil_probe) so they all
+    measure the same data. The ``.complete`` sentinel is written only
+    after the full fixture lands — the CSV is the FIRST artifact the
+    writer produces, so its existence alone would turn an interrupted
+    write into a permanently broken cache."""
+    import time
+
+    root = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        os.pardir,
+        "logs",
+        f"loader_fixture_{n_events}x{in_samples}",
+    )
+    marker = os.path.join(root, ".complete")
+    if not os.path.exists(marker):
+        t0 = time.perf_counter()
+        write_diting_light_fixture(
+            root, n_events=n_events, trace_samples=in_samples
+        )
+        with open(marker, "w") as f:
+            f.write("ok\n")
+        print(
+            f"fixture written in {time.perf_counter() - t0:.1f}s: {root}",
+            file=sys.stderr,
+        )
+    return root

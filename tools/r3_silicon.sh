@@ -36,6 +36,11 @@ say "r3_silicon start $(date -u +%FT%TZ) HEAD=$(git rev-parse --short HEAD)"
 # 1. Mosaic compile + numerics of the head-folded attention kernel.
 run_step attn_check 900 /root/repo _=_ -- python tools/check_attn_tpu.py
 
+# 1b. Golden parity on the chip, through the TPU-default lowerings
+#     (published seist_s_dpk weights vs the torch reference).
+run_step parity_tpu_lowerings 2400 /root/repo SEIST_TEST_TPU=1 -- \
+  python -m pytest tests/test_golden_parity.py -k tpu_lowerings -q -p no:cacheprovider
+
 # 2-4. HEAD vs pre-2b OLD (74aad2c, worktree /tmp/repo_head), bracketed
 #      NEW->OLD->NEW to expose chip drift.
 run_step head_b512_1 900 /root/repo $B -- python bench.py
