@@ -17,10 +17,19 @@ the backend is probed in a short-timeout *subprocess* (a wedged backend
 init can hang uninterruptibly in-process), retried with backoff before the
 model is ever built.
 
-``vs_baseline`` compares against the torch reference measured on this
-host's CPU via tools/bench_reference.py (the reference publishes no
-numbers and no GPU is available here — see BASELINE.md for an analytical
-A100 anchor; the measured value lives in tools/reference_baseline.json).
+``vs_baseline`` (train mode) = measured wf/s divided by the FROZEN
+analytical A100 anchor: one A100 (312 TFLOP/s bf16) assumed to reach 3%
+MFU on this workload — the midpoint of BASELINE.md's "A100 analytical
+anchor" band (~4k-7k wf/s at seist_l_dpk's 1.70 GFLOP/wf). The frozen
+denominator makes the ratio move linearly with our measured throughput
+(VERDICT r3 #8; the round-3 formulation was measurement-invariant).
+Diagnostics: ``a100_analytical_wfs`` = what one A100 would do at OUR
+measured MFU (equal-MFU construction, reduces to the peak-FLOPs ratio);
+``vs_torch_cpu_1core`` = ratio vs the torch reference timed on this
+host's single CPU core (tools/reference_baseline.json) — a magnitude
+sanity check, NOT a chip-class comparison. Missing comparators are
+``null`` in success payloads; the failure path emits ``vs_baseline: 0``
+for driver-schema compatibility.
 
 Env knobs: BENCH_MODEL, BENCH_BATCH, BENCH_SAMPLES, BENCH_STEPS,
 BENCH_DTYPE (fp32|bf16), BENCH_MODE (train|eval|loader), BENCH_STEPS_PER_CALL
@@ -38,6 +47,11 @@ import time
 from typing import Optional
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
+
+# Frozen analytical A100 anchor (see module docstring): 312 TFLOP/s bf16
+# at an assumed 3% MFU on this workload — the midpoint of BASELINE.md's
+# ~4k-7k wf/s band. Frozen so vs_baseline scales with OUR measurement.
+_A100_ANCHOR_FLOPS = 0.03 * 312e12
 
 # bf16 dense peak FLOP/s per chip, keyed by substring of device_kind.
 _PEAK_BF16 = {
@@ -187,7 +201,9 @@ def _peak_flops(device_kind: str) -> float:
     for key, peak in _PEAK_BF16.items():
         if key in dk:
             return peak
-    return _PEAK_BF16["v5e"]  # conservative default
+    if "tpu" in dk:
+        return _PEAK_BF16["v5e"]  # conservative default for unlisted TPUs
+    return 0.0  # non-TPU (cpu debug run): MFU-vs-TPU-peak is meaningless
 
 
 def _vs_baseline(
@@ -402,32 +418,41 @@ def bench_train(device_kind: str) -> None:
     wfs = batch * spc * bench_steps / dt
     step_ms = dt / (bench_steps * spc) * 1e3
     flops_per_wf = flops_per_step / batch if flops_per_step else 0.0
-    mfu = (
-        wfs * flops_per_wf / _peak_flops(device_kind)
-        if flops_per_wf
-        else 0.0
-    )
+    peak = _peak_flops(device_kind)
+    mfu = wfs * flops_per_wf / peak if (flops_per_wf and peak) else 0.0
 
-    # Two comparators (VERDICT r2: vs_baseline alone is misleading):
-    # vs_baseline keys on the measured torch-CPU anchor (the only
-    # magnitude-honest comparison available in a GPU-less sandbox);
-    # a100_analytical_wfs is what ONE A100 would do at OUR measured MFU of
-    # its 312 TFLOP/s bf16 peak — under that equal-MFU assumption the
-    # chip-vs-chip ratio reduces to the peak-FLOPs ratio (v5e/A100 ~ 0.63),
-    # which is the honest core of BASELINE.md's north-star argument.
+    # Comparators (VERDICT r3 #8: lead with the honest figure of merit).
+    # vs_baseline = wfs / (frozen A100 anchor wf/s); the anchor's wf/s =
+    # _A100_ANCHOR_FLOPS / flops_per_wf, so the ratio scales linearly
+    # with measured throughput (a 10x regression shows as 10x here).
+    # a100_analytical_wfs (diagnostic) = one A100 at OUR measured MFU —
+    # the equal-MFU construction that reduces to the peak-FLOPs ratio.
+    vs_anchor = (
+        round(wfs * flops_per_wf / _A100_ANCHOR_FLOPS, 3)
+        if flops_per_wf
+        else None
+    )
     a100_wfs = (
         mfu * 312e12 / flops_per_wf if flops_per_wf and mfu else None
     )
+    from seist_tpu.ops.pallas_attention import kernel_status_summary
+
     payload = {
         "metric": metric,
         "value": round(wfs, 2),
         "unit": unit,
-        "vs_baseline": _vs_baseline(wfs, model_name, in_samples),
+        "vs_baseline": vs_anchor,  # null when cost analysis gave no FLOPs
+        "baseline": (
+            "one A100 at a frozen 3% MFU analytical anchor "
+            "(312 TFLOP/s bf16; BASELINE.md ~4k-7k wf/s band midpoint)"
+        ),
         "a100_analytical_wfs": round(a100_wfs, 1) if a100_wfs else None,
+        "vs_torch_cpu_1core": _vs_baseline(wfs, model_name, in_samples),
         "step_time_ms": round(step_ms, 2),
         "mfu": round(mfu, 4),
         "mfu_note": "vs bf16 dense peak",
         "flops_per_waveform": round(flops_per_wf),
+        "kernel_status": kernel_status_summary(),
         "dtype": dtype,
         "device": device_kind,
         "batch": batch,
@@ -483,6 +508,8 @@ def bench_eval(device_kind: str) -> None:
 
     wfs = batch * bench_steps / dt
     flops_per_wf = flops_per_step / batch if flops_per_step else 0.0
+    from seist_tpu.ops.pallas_attention import kernel_status_summary
+
     _emit_and_cache(
         {
             "metric": f"{model_name}_eval_throughput",
@@ -491,9 +518,10 @@ def bench_eval(device_kind: str) -> None:
             # No comparator: tools/reference_baseline.json records train
             # throughput only.
             "vs_baseline": None,
+            "kernel_status": kernel_status_summary(),
             "step_time_ms": round(dt / bench_steps * 1e3, 2),
             "mfu": round(wfs * flops_per_wf / _peak_flops(device_kind), 4)
-            if flops_per_wf
+            if flops_per_wf and _peak_flops(device_kind)
             else 0.0,
             "mfu_note": "vs bf16 dense peak",
             "flops_per_waveform": round(flops_per_wf),

@@ -277,26 +277,36 @@ _fused.defvjp(_fused_fwd, _fused_bwd)
 # tooling still sees the raw error.
 
 _KERNEL_STATUS: dict = {}
+# Last observed probe outcome per signature, INCLUDING transient failures
+# (which are deliberately kept out of _KERNEL_STATUS so a later trace
+# re-probes). kernel_status_summary() reads this, so a transient failure
+# that baked einsum into a compiled step is still visible in bench JSON
+# and the worker log.
+_KERNEL_EVENTS: dict = {}
 _FALLBACK_LOGGED = False
 
 
 def _probe_kernel(l, m, he, heads, rate, dtype) -> None:
-    # ensure_compile_time_eval: the call site usually sits under the train
-    # step's jit trace — without escaping it, jnp.zeros would be tracers,
-    # the nested jit would inline instead of compile, and the probe would
-    # "fail" on a perfectly good kernel (permanently einsum-ing the
-    # default path). Inside this context the arrays are concrete and the
-    # jit genuinely compiles+runs on the backend.
-    with jax.ensure_compile_time_eval():
-        q = jnp.zeros((1, l, he), dtype)
-        k = jnp.zeros((1, m, he), dtype)
-        seed = jnp.zeros((1,), jnp.int32)
+    q = jnp.zeros((1, l, he), dtype)
+    k = jnp.zeros((1, m, he), dtype)
+    seed = jnp.zeros((1,), jnp.int32)
 
-        def f(q, k, v):
-            return _fused(q, k, v, seed, 1.0, rate, heads, False).sum()
+    def f(q, k, v):
+        return _fused(q, k, v, seed, 1.0, rate, heads, False).sum()
 
-        g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, k)
-        g[0].block_until_ready()
+    g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, k)
+    g[0].block_until_ready()
+
+
+_TRANSIENT_ERROR_MARKERS = ("RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED", "UNAVAILABLE")
+
+
+def _is_transient(exc: Exception) -> bool:
+    # A probe can fail for reasons that say nothing about Mosaic's ability to
+    # compile the kernel — e.g. HBM already occupied by the train state, or a
+    # flaky backend connection. Those must not poison the per-process cache.
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(marker in msg for marker in _TRANSIENT_ERROR_MARKERS)
 
 
 def _kernel_usable(l, m, he, heads, rate, dtype) -> bool:
@@ -305,9 +315,34 @@ def _kernel_usable(l, m, he, heads, rate, dtype) -> bool:
     if hit is not None:
         return hit
     try:
-        _probe_kernel(l, m, he, heads, float(rate), dtype)
+        # ensure_compile_time_eval: the call site usually sits under the train
+        # step's jit trace — without escaping it, jnp.zeros would be tracers,
+        # the nested jit would inline instead of compile, and the probe would
+        # "fail" on a perfectly good kernel (permanently einsum-ing the
+        # default path). Opening the context here (not inside _probe_kernel)
+        # guarantees the eager escape for ANY probe implementation.
+        with jax.ensure_compile_time_eval():
+            _probe_kernel(l, m, he, heads, float(rate), dtype)
         ok = True
     except Exception as exc:  # noqa: BLE001 - any compile/runtime rejection
+        head = str(exc).splitlines()[0][:200] if str(exc) else ""
+        if _is_transient(exc):
+            # Fall back for THIS trace (the enclosing jit bakes einsum in
+            # permanently for this program!) but leave the retry cache
+            # empty so a LATER trace — a re-jit, another shape — re-probes
+            # once memory pressure clears. Record the event so the
+            # fallback is still observable, and log every occurrence (the
+            # one-shot flag below is reserved for permanent rejections).
+            _KERNEL_EVENTS[key] = f"einsum-fallback (transient {head})"
+            _log.warning(
+                "fused attention probe hit a transient error for shape "
+                "L=%d M=%d HE=%d H=%d %s (%s: %s); THIS trace falls back "
+                "to the identical-math einsum path; the kernel will be "
+                "re-probed on the next trace",
+                l, m, he, heads, jnp.dtype(dtype).name,
+                type(exc).__name__, head,
+            )
+            return False
         global _FALLBACK_LOGGED
         if not _FALLBACK_LOGGED:
             _FALLBACK_LOGGED = True
@@ -315,17 +350,35 @@ def _kernel_usable(l, m, he, heads, rate, dtype) -> bool:
                 "fused attention kernel unusable for shape L=%d M=%d HE=%d "
                 "H=%d %s (%s: %s); falling back to the identical-math einsum "
                 "path (SEIST_ATTN_IMPL=fused to force the kernel)",
-                l,
-                m,
-                he,
-                heads,
-                jnp.dtype(dtype).name,
-                type(exc).__name__,
-                str(exc).splitlines()[0][:200] if str(exc) else "",
+                l, m, he, heads, jnp.dtype(dtype).name,
+                type(exc).__name__, head,
             )
         ok = False
     _KERNEL_STATUS[key] = ok
+    _KERNEL_EVENTS[key] = "fused" if ok else "einsum-fallback"
     return ok
+
+
+def kernel_status_summary() -> dict:
+    """Machine-readable outcome of the fused-kernel health probes so far
+    (VERDICT r3 #4: a Mosaic rejection must never silently cost the fused
+    win again). Returns ``{"overall": "fused"|"einsum-fallback"|"unprobed",
+    "signatures": {"L512/M16/HE96/H8/drop=False/bf16": "fused"|
+    "einsum-fallback"|"einsum-fallback (transient ...)"}}`` — bench.py
+    emits this in its JSON line and train/worker.py logs it after the
+    first step. Reads the EVENT log, so a transient probe failure (kept
+    out of the retry cache) is still reported for the trace it affected.
+    """
+    sigs = {}
+    for (l, m, he, heads, drop, dtype), status in _KERNEL_EVENTS.items():
+        sigs[f"L{l}/M{m}/HE{he}/H{heads}/drop={drop}/{dtype}"] = status
+    if not sigs:
+        overall = "unprobed"
+    elif all(v == "fused" for v in sigs.values()):
+        overall = "fused"
+    else:
+        overall = "einsum-fallback"
+    return {"overall": overall, "signatures": sigs}
 
 
 def _on_tpu() -> bool:

@@ -23,7 +23,9 @@ Masking is linear in the numerator and the softmax denominator is built
 from the *unmasked* probabilities, so the ring applies the mask (with the
 survivor scale) to each block's exp-numerator contribution to ``o`` while
 ``l`` keeps accumulating unmasked — ``o/l`` then equals dense-with-dropout
-bit-for-bit given the same mask. The mask comes from the same counter-based
+numerically up to fp reassociation of the online sums (the tests assert
+rtol/atol ~2e-5..2e-4), with the *same* dropout mask. The mask comes
+from the same counter-based
 PRNG the fused/einsum paths share (pallas_attention._mix_to_uniform),
 indexed by *global* (batch, head, row, col) so every device regenerates
 exactly its slice of the dense mask.
@@ -157,10 +159,12 @@ def ring_attention_local(
     o = jnp.zeros((n, h, lq, e), dtype=jnp.float32)
     m = jnp.full((n, h, lq), -jnp.inf, dtype=jnp.float32)
     l = jnp.zeros((n, h, lq), dtype=jnp.float32)
-    if hasattr(lax, "pvary"):
+    if hasattr(lax, "pcast"):
         # Newer shard_map tracks varying-axis types through scan: the carry
         # becomes seq-varying after one step, so the initial values must be
-        # marked varying too.
+        # marked varying too. (pcast replaced the deprecated lax.pvary.)
+        o, m, l = (lax.pcast(t, (axis_name,), to="varying") for t in (o, m, l))
+    elif "pvary" in dir(lax):  # pragma: no cover - pre-pcast jax
         o, m, l = (lax.pvary(t, (axis_name,)) for t in (o, m, l))
 
     # Peel the first (local-block) step so the scan rotates BEFORE each

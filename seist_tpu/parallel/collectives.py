@@ -9,10 +9,22 @@ off the optimized HLO of the compiled train step, with no hardware at all.
 ``collective_stats`` parses an ``xla_computation.as_text()`` /
 ``compiled.as_text()`` dump and returns, per collective kind
 (all-reduce, all-gather, reduce-scatter, collective-permute, all-to-all),
-the op count and the summed payload bytes (output-shape bytes of each
-collective op; ``-start``/``-done`` async pairs are counted once at the
-start op). These are payload bytes; actual link traffic per chip for a
-ring all-reduce of payload P over N devices is 2*(N-1)/N * P.
+the op count and the summed payload bytes. Payload of one op = the sum of
+its output-shape bytes: XLA's all-reduce combiner merges many gradient
+tensors into ONE tuple-shaped op (``(f32[a], f32[b], ...) all-reduce``)
+whose elements are all distinct transferred buffers (round 3 counted only
+the largest element, undercounting combined gradient all-reduces ~50x —
+VERDICT r3 #6). Async ``-start`` ops are the exception: their tuple
+repeats the buffer as (aliased input, output, context scalars), so only
+the largest element is counted there; ``-done`` pairs are skipped.
+These are payload bytes; actual link traffic per chip for a ring
+all-reduce of payload P over N devices is 2*(N-1)/N * P.
+
+``collective_ops`` returns the per-op detail (kind, payload, shapes, the
+tracing ``op_name`` metadata) so callers can attribute bytes — e.g.
+tools/collective_report.py splits gradient all-reduces (tuple elements
+matching model param shapes, batch-independent) from activation
+gathers/others (batch-dependent).
 
 Counts are STATIC: a collective inside a ``while``/``scan`` body is
 counted once, not per trip — e.g. ring attention's collective-permute
@@ -55,45 +67,95 @@ _KINDS = (
 
 # `%x = f32[8,128]{1,0} all-reduce(...)` or tuple-shaped async starts with
 # TPU tiled layouts: `%x = (f32[388778]{0:T(1024)}, f32[388778]{0:T(1024)})
-# all-gather-start(...)` — the lhs is matched lazily up to the op keyword
-# because layout annotations nest parentheses.
+# all-gather-start(...)`. HLO text is one instruction per line; the lhs is
+# everything from the FIRST `=` on the line to the op keyword. (An earlier
+# `[^=\n]*?` lhs silently truncated combined-tuple lhs at the `=` inside
+# XLA's `/*index=5*/` tuple comments, dropping most gradient tensors from
+# combined all-reduces — do not "simplify" this back.)
 _SHAPE_RE = re.compile(r"([a-z]+\d*)\[([\d,]*)\]")
 _OP_RE = re.compile(
-    r"=\s*(?P<lhs>[^=\n]*?)\s*"
-    r"(?P<kind>" + "|".join(_KINDS) + r")(?P<suffix>-start|-done)?\("
+    r"^[^=\n]*=\s*(?P<lhs>.*?)\s*"
+    r"(?P<kind>" + "|".join(_KINDS) + r")(?P<suffix>-start|-done)?\(",
+    re.M,
 )
 
 
-def _payload_bytes(lhs: str) -> int:
-    """Payload of one collective = the LARGEST shape on its lhs.
-
-    Async ``-start`` ops (and TPU sync tuples) carry aliased input/output
-    copies of the same buffer in a tuple — summing all elements would
-    double-count, and collective-permute-start adds u32 context scalars.
-    The largest single shape is the transferred buffer for every kind
-    (all-gather's output, all-reduce's buffer, permute's block).
-    """
-    best = 0
+def _shapes(lhs: str):
+    """(dtype, dims-tuple, bytes) for every array shape on an op's lhs."""
+    out = []
     for dtype, dims in _SHAPE_RE.findall(lhs):
         if dtype not in _DTYPE_BYTES:
             continue
-        n = 1
-        if dims:
-            n = math.prod(int(d) for d in dims.split(",") if d)
-        best = max(best, n * _DTYPE_BYTES[dtype])
-    return best
+        d = tuple(int(x) for x in dims.split(",") if x) if dims else ()
+        out.append((dtype, d, math.prod(d or (1,)) * _DTYPE_BYTES[dtype]))
+    return out
+
+
+def _payload_bytes(lhs: str, kind: str = "", is_start: bool = False) -> int:
+    """Payload of one collective op (see module docstring).
+
+    Sync ops: SUM of lhs shapes — a combined all-reduce's tuple elements
+    are distinct transferred buffers. Async ``-start`` ops alias each
+    transferred buffer as (input, output) in their lhs tuple:
+
+    * ``all-reduce-start`` — input and output shapes are identical, so
+      the payload is exactly SUM/2. This holds for the combined form too
+      (``((f32[a], f32[b]), (f32[a], f32[b])) all-reduce-start``), which
+      the max rule would undercount the same ~50x way the sync combiner
+      bug did.
+    * other ``-start`` kinds — the LARGEST shape (all-gather's output /
+      reduce-scatter's input / permute's block; their tuples also carry
+      non-equal shards and u32 context scalars, so neither sum nor sum/2
+      is right). A *combined* async gather/scatter would be undercounted
+      here; none appears in this framework's programs today.
+    """
+    sizes = [b for _, _, b in _shapes(lhs)]
+    if not sizes:
+        return 0
+    if is_start:
+        if kind == "all-reduce":
+            return sum(sizes) // 2
+        return max(sizes)
+    return sum(sizes)
+
+
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def collective_ops(hlo_text: str):
+    """Per-op detail: ``[{kind, bytes, shapes, op_name}]`` for every
+    collective (async pairs counted once at the ``-start``)."""
+    ops = []
+    for m in _OP_RE.finditer(hlo_text):
+        if m.group("suffix") == "-done":
+            continue
+        line_end = hlo_text.find("\n", m.end())
+        rest = hlo_text[m.end() : line_end if line_end != -1 else len(hlo_text)]
+        name = _OPNAME_RE.search(rest)
+        is_start = m.group("suffix") == "-start"
+        ops.append(
+            {
+                "kind": m.group("kind"),
+                "bytes": _payload_bytes(
+                    m.group("lhs"), m.group("kind"), is_start
+                ),
+                "shapes": [
+                    f"{dt}{list(d)}" for dt, d, _ in _shapes(m.group("lhs"))
+                ],
+                "shape_dims": [d for _, d, _ in _shapes(m.group("lhs"))],
+                "op_name": name.group(1) if name else "",
+            }
+        )
+    return ops
 
 
 def collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
     """Per-kind ``{count, bytes}`` for every collective in an HLO dump."""
     stats: Dict[str, Dict[str, int]] = {}
-    for m in _OP_RE.finditer(hlo_text):
-        if m.group("suffix") == "-done":
-            continue  # counted at the paired -start
-        kind = m.group("kind")
-        entry = stats.setdefault(kind, {"count": 0, "bytes": 0})
+    for op in collective_ops(hlo_text):
+        entry = stats.setdefault(op["kind"], {"count": 0, "bytes": 0})
         entry["count"] += 1
-        entry["bytes"] += _payload_bytes(m.group("lhs"))
+        entry["bytes"] += op["bytes"]
     return stats
 
 

@@ -425,6 +425,23 @@ def train_worker(args: Any) -> str:
     profile_from = 2 * updates_per_call  # skip the first two loop iterations
     tracing = False
 
+    kernel_status_logged = False
+
+    def _log_kernel_status_once() -> None:
+        # After the first step the attention-kernel health probes have run
+        # (they fire at trace time); surface the outcome so a silent Mosaic
+        # rejection -> einsum fallback is visible in every train run's log
+        # (VERDICT r3 #4).
+        nonlocal kernel_status_logged
+        if kernel_status_logged or not is_main_process():
+            return
+        kernel_status_logged = True
+        from seist_tpu.ops.pallas_attention import kernel_status_summary
+
+        status = kernel_status_summary()
+        if status["signatures"]:
+            logger.info(f"attention kernel status: {status}")
+
     def _maybe_trace(opt_step: int, loss) -> None:
         """``opt_step``: optimizer steps completed before this iteration."""
         nonlocal tracing, profile_steps
@@ -475,6 +492,7 @@ def train_worker(args: Any) -> str:
             ):
                 state, loss, _ = train_step(state, xk, yk, epoch_rng)
                 deferred_losses.append(loss)
+                _log_kernel_status_once()
                 _maybe_trace(call * updates_per_call, loss)
                 if call % args.log_step == 0:
                     loss_f = float(loss)
@@ -506,6 +524,7 @@ def train_worker(args: Any) -> str:
                     state, batch.inputs, batch.loss_targets, epoch_rng
                 )
                 deferred_losses.append(loss)
+                _log_kernel_status_once()
                 _maybe_trace(step, loss)
                 gstep = epoch * steps_per_epoch + step
 

@@ -45,6 +45,65 @@ def test_parses_tpu_async_tiled_layouts():
     assert stats["collective-permute"] == {"count": 1, "bytes": 2 * 64 * 2}
 
 
+_COMBINED_HLO = """
+  %arc = (f32[24,16]{1,0}, f32[5,8,16]{2,1,0}, f32[32]{0}) all-reduce(%g0, %g1, %g2), channel_id=1, metadata={op_name="jit(train_step)/transpose(jvp(SeismogramTransformer))/grad"}
+  %ag = f32[16,32,16]{2,1,0} all-gather(%act), channel_id=2, dimensions={0}, metadata={op_name="jit(train_step)/transpose(jvp(SeismogramTransformer))/stage1_block1/conv1/conv"}
+"""
+
+# XLA prints /*index=N*/ comments inside long tuples — the `=` inside them
+# truncated the round-3 lhs regex, dropping most combined-gradient tensors.
+_INDEXED_TUPLE_HLO = """
+  %arc = (f32[64]{0}, f32[96]{0}, f32[96,64]{1,0}, f32[5,32,64]{2,1,0}, f32[128]{0}, /*index=5*/f32[128,64]{1,0}, f32[64]{0}) all-reduce(%a, %b, %c, %d, %e, %f, %g), channel_id=3
+"""
+
+
+def test_indexed_tuple_lhs_not_truncated():
+    stats = collective_stats(_INDEXED_TUPLE_HLO)
+    want = (64 + 96 + 96 * 64 + 5 * 32 * 64 + 128 + 128 * 64 + 64) * 4
+    assert stats["all-reduce"] == {"count": 1, "bytes": want}
+
+
+# TPU async form of a COMBINED all-reduce: the start op's lhs aliases the
+# whole (inputs, outputs) pair, so payload = sum/2 — the max rule would
+# collapse it to the largest gradient tensor (the round-3 sync bug, async
+# edition).
+_COMBINED_ASYNC_HLO = """
+  %ars = ((f32[388778]{0}, f32[1024]{0}), (f32[388778]{0}, f32[1024]{0})) all-reduce-start(%g0, %g1)
+  %ard = (f32[388778]{0}, f32[1024]{0}) all-reduce-done(%ars)
+"""
+
+
+def test_combined_async_all_reduce_start_sums_half():
+    stats = collective_stats(_COMBINED_ASYNC_HLO)
+    assert stats["all-reduce"] == {
+        "count": 1,
+        "bytes": (388778 + 1024) * 4,
+    }
+
+
+def test_combined_tuple_all_reduce_sums_elements():
+    # XLA's all-reduce combiner merges many gradient tensors into ONE
+    # tuple-shaped sync op; every element is a distinct transferred buffer
+    # and must be SUMMED (round 3 took the max, undercounting ~50x).
+    stats = collective_stats(_COMBINED_HLO)
+    want = (24 * 16 + 5 * 8 * 16 + 32) * 4
+    assert stats["all-reduce"] == {"count": 1, "bytes": want}
+
+
+def test_collective_ops_detail():
+    from seist_tpu.parallel.collectives import collective_ops
+
+    ops = collective_ops(_COMBINED_HLO)
+    assert len(ops) == 2
+    ar, ag = ops
+    assert ar["kind"] == "all-reduce"
+    assert ar["shape_dims"] == [(24, 16), (5, 8, 16), (32,)]
+    assert "transpose(jvp" in ar["op_name"]
+    assert ag["kind"] == "all-gather"
+    assert ag["bytes"] == 16 * 32 * 16 * 4
+    assert "stage1_block1/conv1" in ag["op_name"]
+
+
 def test_format_and_empty():
     assert format_collective_stats({}) == "no collectives"
     s = format_collective_stats(collective_stats(_FAKE_HLO))

@@ -151,6 +151,75 @@ def test_probe_runs_eagerly_under_outer_jit(rng, monkeypatch):
     assert list(pa._KERNEL_STATUS.values()) == [True]
 
 
+def test_transient_probe_error_not_cached(rng, monkeypatch):
+    # A RESOURCE_EXHAUSTED probe failure says nothing about Mosaic's ability
+    # to compile the kernel (HBM may simply be full of train state). It must
+    # fall back for the call but NOT poison the per-process cache.
+    from seist_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_KERNEL_STATUS", {})
+    monkeypatch.setattr(pa, "_KERNEL_EVENTS", {})
+    calls = {"n": 0}
+
+    def flaky_probe(*a):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory on device")
+
+    monkeypatch.setattr(pa, "_probe_kernel", flaky_probe)
+    assert pa._kernel_usable(64, 16, 16, 2, 0.0, np.float32) is False
+    assert pa._KERNEL_STATUS == {}  # transient -> no retry-cache entry
+    # ...but the fallback is still OBSERVABLE (the trace that hit it baked
+    # einsum in permanently): summary must not say "unprobed".
+    s = pa.kernel_status_summary()
+    assert s["overall"] == "einsum-fallback"
+    assert "transient" in next(iter(s["signatures"].values()))
+    assert pa._kernel_usable(64, 16, 16, 2, 0.0, np.float32) is True
+    assert list(pa._KERNEL_STATUS.values()) == [True]
+    assert pa.kernel_status_summary()["overall"] == "fused"
+    # A genuine Mosaic rejection IS cached.
+    monkeypatch.setattr(
+        pa,
+        "_probe_kernel",
+        lambda *a: (_ for _ in ()).throw(ValueError("Mosaic lowering failed")),
+    )
+    monkeypatch.setattr(pa, "_KERNEL_STATUS", {})
+    monkeypatch.setattr(pa, "_KERNEL_EVENTS", {})
+    assert pa._kernel_usable(64, 16, 16, 2, 0.0, np.float32) is False
+    assert list(pa._KERNEL_STATUS.values()) == [False]
+    assert pa.kernel_status_summary()["overall"] == "einsum-fallback"
+
+
+def test_kernel_status_summary(monkeypatch):
+    # VERDICT r3 #4: the probe outcome must be machine-readable for bench.py
+    # and the worker startup log.
+    from seist_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_KERNEL_EVENTS", {})
+    assert pa.kernel_status_summary()["overall"] == "unprobed"
+    monkeypatch.setattr(
+        pa,
+        "_KERNEL_EVENTS",
+        {(512, 16, 96, 8, False, "bfloat16"): "fused"},
+    )
+    s = pa.kernel_status_summary()
+    assert s["overall"] == "fused"
+    assert s["signatures"] == {"L512/M16/HE96/H8/drop=False/bfloat16": "fused"}
+    monkeypatch.setattr(
+        pa,
+        "_KERNEL_EVENTS",
+        {
+            (512, 16, 96, 8, False, "bfloat16"): "fused",
+            (512, 16, 96, 8, True, "bfloat16"): "einsum-fallback",
+        },
+    )
+    s = pa.kernel_status_summary()
+    assert s["overall"] == "einsum-fallback"
+    assert s["signatures"]["L512/M16/HE96/H8/drop=True/bfloat16"] == (
+        "einsum-fallback"
+    )
+
+
 def test_env_fused_bypasses_probe(rng, monkeypatch):
     # SEIST_ATTN_IMPL=fused must skip the health probe and surface the raw
     # kernel error (parity tooling wants failures loud).

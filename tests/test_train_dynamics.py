@@ -1,0 +1,93 @@
+"""Training-dynamics parity: torch reference vs seist_tpu (VERDICT r3 #5).
+
+Both sides train phasenet (drop_rate=0) from the IDENTICAL initialization on
+byte-identical batches in the same order under the same cyclic LR schedule
+(tools/train_dynamics.py). Asserting the loss trajectories agree catches
+BN-momentum / LR-schedule / optimizer-epsilon / loss-scaling drift that
+single-step forward+gradient parity (tests/test_golden_parity.py) cannot see.
+
+Ref anchor: /root/reference/training/train.py:378-468 (the epoch loop being
+mirrored); validate.py:54-127 (the eval-mode val loss, which runs on BN
+running stats — the BN-momentum probe).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # two full (small) training runs
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOL = os.path.join(_REPO, "tools", "train_dynamics.py")
+
+
+def _run_side(side: str, tmp: str) -> dict:
+    out = os.path.join(tmp, f"{side}.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [
+            sys.executable,
+            _TOOL,
+            "--side",
+            side,
+            "--init",
+            os.path.join(tmp, "init.npz"),
+            "--out",
+            out,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=1200,
+        env=env,
+        cwd=_REPO,
+    )
+    assert r.returncode == 0, f"{side} side failed:\n{r.stdout}\n{r.stderr}"
+    with open(out) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def trajectories(tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("dyn"))
+    torch_run = _run_side("torch", tmp)  # writes init.npz first
+    jax_run = _run_side("jax", tmp)
+    return torch_run, jax_run
+
+
+def test_train_loss_trajectory_matches(trajectories):
+    torch_run, jax_run = trajectories
+    t = np.asarray(torch_run["train_loss_per_step"])
+    j = np.asarray(jax_run["train_loss_per_step"])
+    assert t.shape == j.shape and t.size >= 40
+    # Same init + same batches: step 0 is near-exact (pure forward parity);
+    # later steps accumulate fp drift through 40+ optimizer updates, BN
+    # stats and the exp_range LR decay, so the band widens with depth.
+    np.testing.assert_allclose(j[0], t[0], rtol=1e-5)
+    # Calibrated 2026-07-31 on this host: measured max rel drift 1.0e-4
+    # over 48 optimizer steps (first half 4.6e-5). Tolerances sit ~10-50x
+    # above that so only a real dynamics divergence (BN momentum, LR
+    # schedule, optimizer eps, loss scaling) trips them, not fp noise.
+    rel = np.abs(j - t) / np.maximum(np.abs(t), 1e-8)
+    assert rel[: len(rel) // 2].max() < 1e-3, (
+        f"first-half train-loss drift {rel[: len(rel) // 2].max():.2e}"
+    )
+    assert rel.max() < 5e-3, f"train-loss drift {rel.max():.2e} exceeds 5e-3"
+    # Both must actually LEARN (measured: 1.276 -> 1.143 over 6 epochs).
+    assert t[-8:].mean() < t[:8].mean() * 0.95
+    assert j[-8:].mean() < j[:8].mean() * 0.95
+
+
+def test_val_loss_trajectory_matches(trajectories):
+    # Eval-mode forward runs on BN *running* stats: a BN-momentum
+    # convention drift shows up here first (and only here).
+    torch_run, jax_run = trajectories
+    t = np.asarray(torch_run["val_loss_per_epoch"])
+    j = np.asarray(jax_run["val_loss_per_epoch"])
+    assert t.shape == j.shape and t.size >= 4
+    # Calibrated: measured max val drift 1.2e-4 across 6 epochs.
+    rel = np.abs(j - t) / np.maximum(np.abs(t), 1e-8)
+    assert rel.max() < 5e-3, f"val-loss drift {rel.max():.2e} exceeds 5e-3"

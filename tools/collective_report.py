@@ -46,6 +46,7 @@ def main() -> None:
     jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp
+    import numpy as np
 
     import seist_tpu
     from seist_tpu import taskspec
@@ -98,9 +99,45 @@ def main() -> None:
 
     t0 = time.time()
     compiled = step.lower(state, x_s, y_s, rng_s).compile()
-    stats = collective_stats(compiled.as_text())
+    from seist_tpu.parallel.collectives import collective_ops
+
+    hlo = compiled.as_text()
+    stats = collective_stats(hlo)
+    ops = collective_ops(hlo)
     total = sum(s["bytes"] for s in stats.values())
     n = args.devices
+
+    # Attribute the bytes (VERDICT r3 #6: make it self-evident which ops
+    # carry the gradient bytes). Gradient reductions are all-reduces of
+    # param-shaped tensors INSIDE the backward pass (op_name metadata
+    # carries XLA's "transpose(jvp(...))" marker); BN cross-replica
+    # batch-stat sums are also (C,)-shaped all-reduces — same shapes as
+    # BN scale/bias params — but sit in the forward, so the op_name test
+    # keeps them out of the gradient bucket. Collectives with a
+    # batch-sized leading dim are activation traffic and scale WITH
+    # batch; the rest is BN batch-stats + loss scalars.
+    param_shapes = {
+        tuple(np.shape(x)) for x in jax.tree.leaves(state.params)
+    }
+    grad_bytes = grad_ops = act_bytes = act_ops = other_bytes = 0
+    per_shard_batch = args.batch // n
+    for op in ops:
+        dims = op["shape_dims"]
+        if (
+            op["kind"] == "all-reduce"
+            and "transpose(jvp" in op["op_name"]
+            and any(tuple(d) in param_shapes for d in dims)
+        ):
+            grad_bytes += op["bytes"]
+            grad_ops += 1
+        elif any(
+            d and d[0] in (args.batch, per_shard_batch) and len(d) >= 2
+            for d in dims
+        ):
+            act_bytes += op["bytes"]
+            act_ops += 1
+        else:
+            other_bytes += op["bytes"]
     print(
         json.dumps(
             {
@@ -113,6 +150,26 @@ def main() -> None:
                 "devices": n,
                 "per_kind": stats,
                 "param_bytes_mb": round(n_params * 4 / 1e6, 3),
+                "gradient_allreduce": {
+                    "ops": grad_ops,
+                    "mb": round(grad_bytes / 1e6, 3),
+                    "note": (
+                        "backward-pass (transpose(jvp)) all-reduce ops "
+                        "with param-shaped tuple elements == the fp32 "
+                        "gradient bytes; batch-independent"
+                    ),
+                },
+                "activation_collectives": {
+                    "ops": act_ops,
+                    "mb": round(act_bytes / 1e6, 3),
+                    "note": (
+                        "batch-leading-dim buffers (backward-pass "
+                        "activation gathers); scales WITH batch"
+                    ),
+                },
+                "bn_stat_and_scalar_collectives_mb": round(
+                    other_bytes / 1e6, 3
+                ),
                 "ring_allreduce_link_traffic_mb": round(
                     total * 2 * (n - 1) / n / 1e6, 3
                 ),

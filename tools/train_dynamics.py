@@ -1,0 +1,238 @@
+"""Training-dynamics parity harness: torch reference vs seist_tpu (VERDICT r3 #5).
+
+Forward/gradient parity (tools/parity.py) proves single-step math; this tool
+probes what those tests cannot see — BN-momentum convention, LR-schedule
+shape, optimizer-epsilon, loss-scaling drift — by training BOTH frameworks
+from the IDENTICAL initialization on byte-identical fixture batches in the
+same order with the same cyclic LR schedule, and recording the full loss
+trajectories:
+
+  * per-step train loss (ref training/train.py:90-135: loss on the train=True
+    forward of each batch, recorded before the optimizer step applies)
+  * per-epoch val loss (ref training/train.py:397-410 -> validate.py:54-127:
+    eval-mode forward, which runs on BN *running* stats — the only place a
+    BN-momentum drift can show up)
+
+Model: phasenet with drop_rate=0 (dropout masks are framework-RNG-specific,
+so a trajectory comparison must exclude them; everything else — conv/BN/
+softmax/CE dynamics under the reference's CyclicLR (train.py:343-354) — is
+deterministic and directly comparable).
+
+Usage (each side prints one JSON line and optionally writes it to --out):
+    python tools/train_dynamics.py --side torch --out /tmp/torch.json
+    python tools/train_dynamics.py --side jax --init /tmp/dyn_init.npz \
+        --out /tmp/jax.json
+
+The torch side writes its INITIAL state-dict to --init (npz) so the jax side
+trains from the converted identical weights. tests/test_train_dynamics.py
+runs both and asserts the trajectories agree within tolerance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# One config both sides share — keep in lockstep with the test.
+CFG = {
+    "model": "phasenet",
+    "in_samples": 512,
+    "batch": 8,
+    "steps_per_epoch": 8,
+    "epochs": 6,
+    "val_n": 32,
+    "base_lr": 8e-5,
+    "max_lr": 1e-3,
+    "warmup_steps": 16,
+    "down_steps": 32,
+    "data_seed": 123,
+    "init_seed": 7,
+}
+
+
+def make_data(cfg=CFG):
+    """Deterministic synthetic picks, identical bytes for both sides.
+
+    Returns (x, y) with torch layout (N, C, L) fp32; the jax side
+    transposes to channels-last. Labels are (non, ppk, spk) prob curves
+    (gaussian sigma=10, the reference's label quirk preprocess.py:698).
+    """
+    n = cfg["batch"] * cfg["steps_per_epoch"] + cfg["val_n"]
+    L = cfg["in_samples"]
+    rng = np.random.default_rng(cfg["data_seed"])
+    t = np.arange(L, dtype=np.float32)
+    x = rng.standard_normal((n, 3, L)).astype(np.float32) * 0.1
+    tp = rng.integers(L // 8, L // 2, size=n)
+    ts = tp + rng.integers(L // 16, L // 4, size=n)
+    y = np.zeros((n, 3, L), np.float32)
+    for i in range(n):
+        env_p = np.where(t >= tp[i], np.exp(-(t - tp[i]) / (L / 8)), 0.0)
+        env_s = np.where(t >= ts[i], np.exp(-(t - ts[i]) / (L / 8)), 0.0)
+        x[i] += np.sin(2 * np.pi * t / 11.0) * env_p
+        x[i, 1:] += 1.5 * np.sin(2 * np.pi * t / 17.0) * env_s
+        y[i, 1] = np.exp(-((t - tp[i]) ** 2) / (2 * 10.0**2))
+        y[i, 2] = np.exp(-((t - ts[i]) ** 2) / (2 * 10.0**2))
+    # Per-sample std normalization (norm_mode="std", ref preprocess.py):
+    x /= x.std(axis=(1, 2), keepdims=True) + 1e-12
+    y[:, 0] = np.clip(1.0 - y[:, 1] - y[:, 2], 0.0, 1.0)
+    n_train = cfg["batch"] * cfg["steps_per_epoch"]
+    return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
+
+
+def run_torch(init_path: str, cfg=CFG) -> dict:
+    import torch
+
+    from tools.bench_reference import _install_timm_stub
+
+    _install_timm_stub()  # reference seist.py imports timm's DropPath
+    sys.path.insert(0, "/root/reference")
+    from models import create_model  # reference models/_factory.py
+    from models.loss import CELoss  # reference models/loss.py:8-29
+
+    torch.manual_seed(cfg["init_seed"])
+    model = create_model(
+        cfg["model"], in_channels=3, in_samples=cfg["in_samples"], drop_rate=0.0
+    )
+    # Persist the initial weights for the jax side (npz of numpy arrays).
+    np.savez(
+        init_path,
+        **{k: v.detach().cpu().numpy() for k, v in model.state_dict().items()},
+    )
+
+    loss_fn = CELoss(weight=[[1], [1], [1]])
+    opt = torch.optim.Adam(model.parameters(), lr=cfg["base_lr"])
+    total = cfg["epochs"] * cfg["steps_per_epoch"]
+    sched = torch.optim.lr_scheduler.CyclicLR(
+        opt,
+        base_lr=cfg["base_lr"],
+        max_lr=cfg["max_lr"],
+        step_size_up=cfg["warmup_steps"],
+        step_size_down=cfg["down_steps"],
+        mode="exp_range",
+        gamma=cfg["base_lr"] ** ((total * 2) ** -1),  # ref train.py:350
+        cycle_momentum=False,
+    )
+
+    (xt, yt), (xv, yv) = make_data(cfg)
+    xt, yt = torch.from_numpy(xt), torch.from_numpy(yt)
+    xv, yv = torch.from_numpy(xv), torch.from_numpy(yv)
+    b = cfg["batch"]
+
+    train_losses, val_losses = [], []
+    for _epoch in range(cfg["epochs"]):
+        model.train()
+        for s in range(cfg["steps_per_epoch"]):
+            xb, yb = xt[s * b : (s + 1) * b], yt[s * b : (s + 1) * b]
+            opt.zero_grad()
+            loss = loss_fn(model(xb), yb)
+            loss.backward()
+            opt.step()
+            sched.step()  # per optimizer step, ref train.py:115
+            train_losses.append(float(loss.item()))
+        model.eval()
+        with torch.no_grad():
+            val_losses.append(float(loss_fn(model(xv), yv).item()))
+    return {
+        "side": "torch",
+        "train_loss_per_step": train_losses,
+        "val_loss_per_epoch": val_losses,
+        "config": cfg,
+    }
+
+
+def run_jax(init_path: str, cfg=CFG) -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import seist_tpu
+    from seist_tpu import taskspec
+    from seist_tpu.models import api
+    from seist_tpu.train import (
+        build_cyclic_schedule,
+        build_optimizer,
+        create_train_state,
+        make_eval_step,
+        make_train_step,
+    )
+    from tools.parity import convert_state_dict
+
+    seist_tpu.load_all()
+    model = api.create_model(
+        cfg["model"], in_samples=cfg["in_samples"], drop_rate=0.0
+    )
+    variables = api.init_variables(
+        model, in_samples=cfg["in_samples"], batch_size=cfg["batch"]
+    )
+    sd = dict(np.load(init_path))
+    variables = convert_state_dict(sd, variables)
+
+    total = cfg["epochs"] * cfg["steps_per_epoch"]
+    sched = build_cyclic_schedule(
+        cfg["base_lr"],
+        cfg["max_lr"],
+        total_steps=total,
+        warmup_steps=cfg["warmup_steps"],
+        down_steps=cfg["down_steps"],
+    )
+    state = create_train_state(model, variables, build_optimizer("adam", sched))
+
+    spec = taskspec.get_task_spec(cfg["model"])
+    loss_fn = taskspec.make_loss(cfg["model"])
+    train_step = jax.jit(make_train_step(spec, loss_fn))
+    eval_step = jax.jit(make_eval_step(spec, loss_fn))
+
+    (xt, yt), (xv, yv) = make_data(cfg)
+    # channels-last for this framework
+    xt, yt = xt.transpose(0, 2, 1), yt.transpose(0, 2, 1)
+    xv, yv = xv.transpose(0, 2, 1), yv.transpose(0, 2, 1)
+    b = cfg["batch"]
+    rng = jax.random.PRNGKey(0)  # drop_rate=0: stream is never consumed
+    vmask = jnp.ones((xv.shape[0],), jnp.float32)
+
+    train_losses, val_losses = [], []
+    for _epoch in range(cfg["epochs"]):
+        for s in range(cfg["steps_per_epoch"]):
+            xb, yb = xt[s * b : (s + 1) * b], yt[s * b : (s + 1) * b]
+            state, loss, _ = train_step(state, jnp.asarray(xb), jnp.asarray(yb), rng)
+            train_losses.append(float(loss))
+        vloss, _ = eval_step(state, jnp.asarray(xv), jnp.asarray(yv), vmask)
+        val_losses.append(float(vloss))
+    return {
+        "side": "jax",
+        "train_loss_per_step": train_losses,
+        "val_loss_per_epoch": val_losses,
+        "config": cfg,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--side", choices=("torch", "jax"), required=True)
+    ap.add_argument(
+        "--init",
+        default=os.path.join(_REPO, "logs", "dyn_init.npz"),
+        help="npz path the torch side writes / the jax side reads",
+    )
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    os.makedirs(os.path.dirname(os.path.abspath(args.init)), exist_ok=True)
+
+    result = run_torch(args.init) if args.side == "torch" else run_jax(args.init)
+    line = json.dumps(result)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line)
+    print(line)
+
+
+if __name__ == "__main__":
+    main()
