@@ -32,7 +32,9 @@ sanity check, NOT a chip-class comparison. Missing comparators are
 for driver-schema compatibility.
 
 Env knobs: BENCH_MODEL, BENCH_BATCH, BENCH_SAMPLES, BENCH_STEPS,
-BENCH_DTYPE (fp32|bf16), BENCH_MODE (train|eval|loader), BENCH_STEPS_PER_CALL
+BENCH_DTYPE (fp32|bf16), BENCH_MODE (train|eval|loader|stream;
+stream = ops/stream.py continuous-record annotate, record-seconds/sec,
+knobs BENCH_RECORD_SECONDS/BENCH_STRIDE), BENCH_STEPS_PER_CALL
 (k>1 scans k optimizer updates inside one jitted call — dispatch
 amortization; see train/step.py make_multi_train_step), BENCH_DONATE.
 """
@@ -534,6 +536,88 @@ def bench_eval(device_kind: str) -> None:
     )
 
 
+def bench_stream(device_kind: str) -> None:
+    """Continuous-record serving throughput (VERDICT r3 #3): ops/stream.py
+    ``annotate`` — sliding-window forward + on-device overlap stitch +
+    fixed-shape peak picking — over a synthetic record, reported as
+    record-seconds annotated per wall-second. The reference's deployment
+    surface scores one fixed window at a time (demo_predict.py:59-97);
+    this is the path a real deployment runs.
+
+    Env: BENCH_MODEL (dpk family / phasenet), BENCH_RECORD_SECONDS (600),
+    BENCH_STRIDE (window//2), BENCH_SAMPLES = window (8192).
+    """
+    import jax
+    import numpy as np
+
+    from seist_tpu.utils.misc import enable_compile_cache
+
+    enable_compile_cache(verbose=True)
+
+    import seist_tpu
+    from seist_tpu import taskspec
+    from seist_tpu.models import api
+    from seist_tpu.ops.stream import annotate
+
+    seist_tpu.load_all()
+    cfg = env_config()
+    model_name, window = cfg["model"], cfg["in_samples"]
+    batch = cfg["batch"]
+    fs = 100
+    rec_seconds = int(os.environ.get("BENCH_RECORD_SECONDS", 600))
+    stride = int(os.environ.get("BENCH_STRIDE", window // 2))
+    spec = taskspec.get_task_spec(model_name)
+    channel0 = spec.labels[0][0]
+
+    model = api.create_model(model_name, in_samples=window)
+    variables = api.init_variables(
+        model, in_samples=window, batch_size=batch
+    )
+
+    def apply_fn(x):
+        return model.apply(variables, x, train=False)
+
+    rng = np.random.default_rng(0)
+    record = rng.standard_normal((rec_seconds * fs, 3)).astype(np.float32)
+
+    kw = dict(
+        window=window,
+        stride=stride,
+        batch_size=batch,
+        sampling_rate=fs,
+        channel0=channel0,
+    )
+    t0 = time.time()
+    annotate(apply_fn, record, **kw)  # compile + warmup
+    _eprint(f"stream warmup (incl. compile) {time.time() - t0:.1f}s")
+    steps = int(os.environ.get("BENCH_STEPS", 3))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = annotate(apply_fn, record, **kw)
+    dt = time.perf_counter() - t0
+    rss = rec_seconds * steps / dt
+    _emit_and_cache(
+        {
+            "metric": f"{model_name}_stream_throughput",
+            "value": round(rss, 2),
+            "unit": "record-seconds/sec",
+            "vs_baseline": None,  # the reference has no continuous path
+            "record_seconds": rec_seconds,
+            # cache-key field (_fail matches on it): the window IS the
+            # model's in_samples.
+            "in_samples": window,
+            "window": window,
+            "stride": stride,
+            "batch": batch,
+            "sampling_rate_hz": fs,
+            "n_picks": int(out["ppk"].size + out["spk"].size),
+            "device": device_kind,
+            "dtype": "fp32",
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+    )
+
+
 def bench_loader() -> None:
     """Input-pipeline-only throughput: full augmentation, no device."""
     from tools.bench_loader import run
@@ -582,9 +666,11 @@ def main() -> None:
     _warn_stale_watcher_queues()
     mode = os.environ.get("BENCH_MODE", "train")
     model_name = env_config()["model"]
-    kind_suffix = "eval" if mode == "eval" else "train"
+    kind_suffix = {"eval": "eval", "stream": "stream"}.get(mode, "train")
     metric = f"{model_name}_{kind_suffix}_throughput"
-    unit = "waveforms/sec/chip"
+    unit = (
+        "record-seconds/sec" if mode == "stream" else "waveforms/sec/chip"
+    )
 
     if mode == "loader":
         try:
@@ -601,8 +687,15 @@ def main() -> None:
         return
 
     # A cached replay must match this run's exact configuration — never
-    # attribute another dtype/batch/length's number to this one.
+    # attribute another dtype/batch/length's number to this one. Each
+    # mode matches only the keys its payload actually carries: stream
+    # runs fp32 regardless of BENCH_DTYPE and has no steps_per_call;
+    # eval has no steps_per_call.
     config = {k: v for k, v in env_config().items() if k != "model"}
+    if mode == "stream":
+        config = {k: config[k] for k in ("batch", "in_samples")}
+    elif mode == "eval":
+        config.pop("steps_per_call", None)
     kind = probe_backend()
     if kind is None:
         n = os.environ.get("BENCH_PROBE_ATTEMPTS", "3")
@@ -616,6 +709,8 @@ def main() -> None:
     try:
         if mode == "eval":
             bench_eval(kind)
+        elif mode == "stream":
+            bench_stream(kind)
         else:
             bench_train(kind)
     except Exception as e:  # noqa: BLE001 - one JSON line, not a traceback
