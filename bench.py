@@ -596,12 +596,15 @@ def bench_stream(device_kind: str) -> None:
         out = annotate(apply_fn, record, **kw)
     dt = time.perf_counter() - t0
     rss = rec_seconds * steps / dt
+    from seist_tpu.ops.pallas_attention import kernel_status_summary
+
     _emit_and_cache(
         {
             "metric": f"{model_name}_stream_throughput",
             "value": round(rss, 2),
             "unit": "record-seconds/sec",
             "vs_baseline": None,  # the reference has no continuous path
+            "kernel_status": kernel_status_summary(),
             "record_seconds": rec_seconds,
             # cache-key field (_fail matches on it): the window IS the
             # model's in_samples.
@@ -693,7 +696,15 @@ def main() -> None:
     # eval has no steps_per_call.
     config = {k: v for k, v in env_config().items() if k != "model"}
     if mode == "stream":
-        config = {k: config[k] for k in ("batch", "in_samples")}
+        window = config["in_samples"]
+        config = {
+            "batch": config["batch"],
+            "in_samples": window,
+            "stride": int(os.environ.get("BENCH_STRIDE", window // 2)),
+            "record_seconds": int(
+                os.environ.get("BENCH_RECORD_SECONDS", 600)
+            ),
+        }
     elif mode == "eval":
         config.pop("steps_per_call", None)
     kind = probe_backend()

@@ -1,0 +1,71 @@
+#!/bin/bash
+# Round-4 on-silicon evidence runner (VERDICT r3 #1-#4).
+#
+# Wraps the full round-3 sequence (tools/r3_silicon.sh: Mosaic attn check,
+# on-chip golden parity through TPU-default lowerings, bracketed HEAD-vs-old
+# A/B, per-lowering isolation, batch scaling, eval matrix, bf16 matrix) and
+# appends the round-4 evidence: continuous-record stream throughput and a
+# hard assert that the HEAD bench ran the FUSED attention kernel (a Mosaic
+# rejection must fail loudly, never silently cost the +105% again).
+#
+# Usage:  bash tools/r4_silicon.sh            (log: tools/ab_r4.log)
+# Skip r3 steps with R3_SKIP="tag1 tag2" as before.
+set -u
+LOG=/root/repo/tools/ab_r4.log
+R4_START="$(date -u +%FT%TZ)"  # freshness floor for the bench asserts
+cd /root/repo
+
+say() { echo "$*" >> "$LOG"; }
+
+run_step() {  # run_step <tag> <timeout_s> [ENV=VAL ...] -- cmd...
+  local tag=$1 to=$2; shift 2
+  local envs=()
+  while [ "$1" != "--" ]; do envs+=("$1"); shift; done
+  shift
+  say "=== $tag $(date -u +%FT%TZ)"
+  if env "${envs[@]:-_=_}" timeout "$to" "$@" >> "$LOG" 2>&1; then
+    say "STATUS ok $tag"
+  else
+    say "STATUS fail $tag rc=$?"
+  fi
+}
+
+say "r4_silicon start $(date -u +%FT%TZ) HEAD=$(git rev-parse --short HEAD)"
+
+# 1. The complete round-3 evidence sequence at today's HEAD.
+bash tools/r3_silicon.sh "$LOG"
+
+B="BENCH_STEPS=15 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=120"
+
+# 2. Kernel-status hard assert on the HEAD train bench (VERDICT r3 #4):
+#    the seist_l_dpk cache entry must have been measured DURING this
+#    script run (logs/last_bench.json only ever stores fresh successes,
+#    so recency — not a 'cached' flag — is the freshness test) and must
+#    report overall == "fused".
+run_step kernel_status_assert 60 R4_START="$R4_START" -- \
+  python - <<'EOF'
+import json, os, sys
+d = json.load(open("logs/last_bench.json"))
+e = d.get("seist_l_dpk_train_throughput") or {}
+start = os.environ["R4_START"]  # captured at script start
+print("kernel_status:", json.dumps(e.get("kernel_status")),
+      "measured_at:", e.get("measured_at"), "run started:", start)
+assert e.get("measured_at", "") >= start, (
+    "seist_l_dpk cache entry predates this run - the HEAD bench never "
+    "landed a fresh measurement"
+)
+ks = e.get("kernel_status") or {}
+assert ks.get("overall") == "fused", f"fused kernel NOT used: {ks}"
+sys.exit(0)
+EOF
+
+# 3. Continuous-record serving throughput (VERDICT r3 #3, deployment half).
+run_step stream_seist_s 900 $B BENCH_MODE=stream BENCH_MODEL=seist_s_dpk -- python bench.py
+run_step stream_phasenet 900 $B BENCH_MODE=stream BENCH_MODEL=phasenet -- python bench.py
+
+# 4. Steady-state profile of the flagship step for the MFU breakdown
+#    (stems <15% target; VERDICT r3 #2).
+run_step profile_flagship 1200 _=_ -- python tools/profile_step.py \
+  --model-name seist_l_dpk --batch 512 --steps 10 --out logs/r4_trace
+
+say "R4 ALL DONE $(date -u +%FT%TZ)"

@@ -13,10 +13,12 @@ trajectories:
     eval-mode forward, which runs on BN *running* stats — the only place a
     BN-momentum drift can show up)
 
-Model: phasenet with drop_rate=0 (dropout masks are framework-RNG-specific,
-so a trajectory comparison must exclude them; everything else — conv/BN/
-softmax/CE dynamics under the reference's CyclicLR (train.py:343-354) — is
-deterministic and directly comparable).
+Models (--model): phasenet (plain conv/BN/softmax/CE) and seist_s_dpk
+(the flagship family: multi-path stems, grouped convs, pooled attention,
+DropPath residuals, BCE) — each with every drop rate zeroed, because
+dropout masks are framework-RNG-specific and must be excluded from a
+trajectory comparison; everything else under the reference's CyclicLR
+(train.py:343-354) is deterministic and directly comparable.
 
 Usage (each side prints one JSON line and optionally writes it to --out):
     python tools/train_dynamics.py --side torch --out /tmp/torch.json
@@ -56,6 +58,33 @@ CFG = {
     "init_seed": 7,
 }
 
+# Per-model specifics: kwargs that zero every dropout (masks are
+# framework-RNG-specific and must be excluded from a trajectory
+# comparison; both factories accept the same names), the label layout,
+# and the reference loss. phasenet: softmax CE over (non, ppk, spk)
+# (ref config.py:67-75); seist dpk family: sigmoid BCE over
+# (det, ppk, spk) with weights [[.5],[1],[1]] (ref config.py:138) —
+# covering the flagship architecture's attention / DropPath / grouped
+# convs / multi-stem dynamics, not just phasenet's plain conv+BN.
+MODELS = {
+    "phasenet": {
+        "zero_drop_kwargs": {"drop_rate": 0.0},
+        "labels": "non_ppk_spk",
+        "ref_loss": "ce",
+    },
+    "seist_s_dpk": {
+        "zero_drop_kwargs": {
+            "path_drop_rate": 0.0,
+            "attn_drop_rate": 0.0,
+            "key_drop_rate": 0.0,
+            "mlp_drop_rate": 0.0,
+            "other_drop_rate": 0.0,
+        },
+        "labels": "det_ppk_spk",
+        "ref_loss": "bce_dpk",
+    },
+}
+
 
 def make_data(cfg=CFG):
     """Deterministic synthetic picks, identical bytes for both sides.
@@ -81,7 +110,15 @@ def make_data(cfg=CFG):
         y[i, 2] = np.exp(-((t - ts[i]) ** 2) / (2 * 10.0**2))
     # Per-sample std normalization (norm_mode="std", ref preprocess.py):
     x /= x.std(axis=(1, 2), keepdims=True) + 1e-12
-    y[:, 0] = np.clip(1.0 - y[:, 1] - y[:, 2], 0.0, 1.0)
+    if MODELS[cfg["model"]]["labels"] == "det_ppk_spk":
+        # det: 1 over [tp, ts + 0.4*(ts-tp)] (the reference's coda-scaled
+        # detection span; exact shape is irrelevant here — both sides
+        # train on the identical bytes).
+        for i in range(n):
+            end = ts[i] + 0.4 * (ts[i] - tp[i])
+            y[i, 0] = ((t >= tp[i]) & (t <= end)).astype(np.float32)
+    else:
+        y[:, 0] = np.clip(1.0 - y[:, 1] - y[:, 2], 0.0, 1.0)
     n_train = cfg["batch"] * cfg["steps_per_epoch"]
     return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
 
@@ -94,11 +131,15 @@ def run_torch(init_path: str, cfg=CFG) -> dict:
     _install_timm_stub()  # reference seist.py imports timm's DropPath
     sys.path.insert(0, "/root/reference")
     from models import create_model  # reference models/_factory.py
-    from models.loss import CELoss  # reference models/loss.py:8-29
+    from models.loss import BCELoss, CELoss  # reference models/loss.py
 
+    spec = MODELS[cfg["model"]]
     torch.manual_seed(cfg["init_seed"])
     model = create_model(
-        cfg["model"], in_channels=3, in_samples=cfg["in_samples"], drop_rate=0.0
+        cfg["model"],
+        in_channels=3,
+        in_samples=cfg["in_samples"],
+        **spec["zero_drop_kwargs"],
     )
     # Persist the initial weights for the jax side (npz of numpy arrays).
     np.savez(
@@ -106,7 +147,10 @@ def run_torch(init_path: str, cfg=CFG) -> dict:
         **{k: v.detach().cpu().numpy() for k, v in model.state_dict().items()},
     )
 
-    loss_fn = CELoss(weight=[[1], [1], [1]])
+    if spec["ref_loss"] == "bce_dpk":
+        loss_fn = BCELoss(weight=[[0.5], [1], [1]])  # ref config.py:138
+    else:
+        loss_fn = CELoss(weight=[[1], [1], [1]])
     opt = torch.optim.Adam(model.parameters(), lr=cfg["base_lr"])
     total = cfg["epochs"] * cfg["steps_per_epoch"]
     sched = torch.optim.lr_scheduler.CyclicLR(
@@ -167,7 +211,9 @@ def run_jax(init_path: str, cfg=CFG) -> dict:
 
     seist_tpu.load_all()
     model = api.create_model(
-        cfg["model"], in_samples=cfg["in_samples"], drop_rate=0.0
+        cfg["model"],
+        in_samples=cfg["in_samples"],
+        **MODELS[cfg["model"]]["zero_drop_kwargs"],
     )
     variables = api.init_variables(
         model, in_samples=cfg["in_samples"], batch_size=cfg["batch"]
@@ -217,6 +263,7 @@ def run_jax(init_path: str, cfg=CFG) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--side", choices=("torch", "jax"), required=True)
+    ap.add_argument("--model", choices=sorted(MODELS), default="phasenet")
     ap.add_argument(
         "--init",
         default=os.path.join(_REPO, "logs", "dyn_init.npz"),
@@ -226,7 +273,12 @@ def main() -> None:
     args = ap.parse_args()
     os.makedirs(os.path.dirname(os.path.abspath(args.init)), exist_ok=True)
 
-    result = run_torch(args.init) if args.side == "torch" else run_jax(args.init)
+    cfg = dict(CFG, model=args.model)
+    result = (
+        run_torch(args.init, cfg)
+        if args.side == "torch"
+        else run_jax(args.init, cfg)
+    )
     line = json.dumps(result)
     if args.out:
         with open(args.out, "w") as f:
