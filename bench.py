@@ -64,6 +64,18 @@ _PEAK_BF16 = {
     "v6": 918e12,
 }
 
+# HBM bandwidth per chip (bytes/s), same keys. Used for the roofline
+# context: ridge intensity = peak_flops / bw; a program whose
+# arithmetic intensity sits below the ridge is memory-bound and its MFU
+# ceiling is intensity/ridge, not 1.0.
+_HBM_BW = {
+    "v4": 1.2e12,
+    "v5 lite": 0.82e12,
+    "v5e": 0.82e12,
+    "v5p": 2.77e12,
+    "v6": 1.64e12,
+}
+
 
 def _eprint(*a) -> None:
     print(*a, file=sys.stderr, flush=True)
@@ -105,6 +117,20 @@ def env_config() -> dict:
         # Micro-steps scanned inside one jitted call (amortizes
         # per-dispatch cost; see train/step.py make_multi_train_step).
         "steps_per_call": int(os.environ.get("BENCH_STEPS_PER_CALL", 1)),
+    }
+
+
+def stream_config() -> dict:
+    """Stream-mode knobs (BENCH_MODE=stream) — shared by bench_stream()
+    and main()'s cache-key config so a cached replay is always attributed
+    to the stride/record-length that actually ran."""
+    cfg = env_config()
+    window = cfg["in_samples"]
+    return {
+        "batch": cfg["batch"],
+        "in_samples": window,
+        "stride": int(os.environ.get("BENCH_STRIDE", window // 2)),
+        "record_seconds": int(os.environ.get("BENCH_RECORD_SECONDS", 600)),
     }
 
 
@@ -276,17 +302,45 @@ def _synthetic_batch(spec, batch: int, in_samples: int, k: int = 1):
     return jax.tree.map(jax.device_put, stacked)
 
 
-def _cost_flops(step) -> float:
-    """Total FLOPs of a compiled executable (best-effort; 0.0 if the
-    backend doesn't expose cost analysis)."""
+def _cost_analysis(step) -> tuple:
+    """(flops, bytes_accessed) of a compiled executable (best-effort;
+    zeros if the backend doesn't expose cost analysis)."""
     try:
         cost = step.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
-        return float(cost.get("flops", 0.0))
+        return (
+            float(cost.get("flops", 0.0)),
+            float(cost.get("bytes accessed", 0.0)),
+        )
     except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
         _eprint(f"cost_analysis unavailable: {e!r}")
-        return 0.0
+        return 0.0, 0.0
+
+
+def _roofline(flops: float, bytes_accessed: float, device_kind: str):
+    """Roofline context for the compiled step (VERDICT r3 #2: 'a written
+    roofline proof of the bound' needs the program's actual arithmetic
+    intensity, which XLA's cost analysis exposes as bytes-accessed).
+
+    Returns None when either input is unavailable. ``mfu_bound`` is the
+    ceiling the MEMORY system imposes: intensity/ridge, capped at 1.0 —
+    measured MFU far below it means the gap is overhead (layout copies,
+    dispatch, serialization), not bandwidth."""
+    peak = _peak_flops(device_kind)
+    dk = device_kind.lower()
+    bw = next((v for k, v in _HBM_BW.items() if k in dk), None)
+    if not (flops and bytes_accessed and peak and bw):
+        return None
+    intensity = flops / bytes_accessed
+    ridge = peak / bw
+    return {
+        "bytes_accessed": round(bytes_accessed),
+        "arithmetic_intensity": round(intensity, 2),
+        "ridge_intensity": round(ridge, 2),
+        "memory_bound": intensity < ridge,
+        "mfu_bound": round(min(1.0, intensity / ridge), 4),
+    }
 
 
 def _emit_and_cache(payload: dict) -> None:
@@ -398,7 +452,7 @@ def bench_train(device_kind: str) -> None:
         .compile()
     )
     _eprint(f"compiled in {time.time() - t0:.1f}s (donate={donate})")
-    flops_per_step = _cost_flops(step)
+    flops_per_step, bytes_per_step = _cost_analysis(step)
 
     t0 = time.time()
     for _ in range(warmup_steps):
@@ -454,6 +508,7 @@ def bench_train(device_kind: str) -> None:
         "mfu": round(mfu, 4),
         "mfu_note": "vs bf16 dense peak",
         "flops_per_waveform": round(flops_per_wf),
+        "roofline": _roofline(flops_per_step, bytes_per_step, device_kind),
         "kernel_status": kernel_status_summary(),
         "dtype": dtype,
         "device": device_kind,
@@ -495,7 +550,7 @@ def bench_eval(device_kind: str) -> None:
     t0 = time.time()
     step = jax.jit(step_fn).lower(state, x, y, mask).compile()
     _eprint(f"compiled in {time.time() - t0:.1f}s")
-    flops_per_step = _cost_flops(step)
+    flops_per_step, bytes_per_step = _cost_analysis(step)
 
     for _ in range(warmup_steps):
         loss, _outputs = step(state, x, y, mask)
@@ -527,6 +582,9 @@ def bench_eval(device_kind: str) -> None:
             else 0.0,
             "mfu_note": "vs bf16 dense peak",
             "flops_per_waveform": round(flops_per_wf),
+            "roofline": _roofline(
+                flops_per_step, bytes_per_step, device_kind
+            ),
             "dtype": dtype,
             "device": device_kind,
             "batch": batch,
@@ -561,11 +619,12 @@ def bench_stream(device_kind: str) -> None:
 
     seist_tpu.load_all()
     cfg = env_config()
-    model_name, window = cfg["model"], cfg["in_samples"]
-    batch = cfg["batch"]
+    scfg = stream_config()
+    model_name, window = cfg["model"], scfg["in_samples"]
+    batch = scfg["batch"]
     fs = 100
-    rec_seconds = int(os.environ.get("BENCH_RECORD_SECONDS", 600))
-    stride = int(os.environ.get("BENCH_STRIDE", window // 2))
+    rec_seconds = scfg["record_seconds"]
+    stride = scfg["stride"]
     spec = taskspec.get_task_spec(model_name)
     channel0 = spec.labels[0][0]
 
@@ -696,15 +755,7 @@ def main() -> None:
     # eval has no steps_per_call.
     config = {k: v for k, v in env_config().items() if k != "model"}
     if mode == "stream":
-        window = config["in_samples"]
-        config = {
-            "batch": config["batch"],
-            "in_samples": window,
-            "stride": int(os.environ.get("BENCH_STRIDE", window // 2)),
-            "record_seconds": int(
-                os.environ.get("BENCH_RECORD_SECONDS", 600)
-            ),
-        }
+        config = stream_config()
     elif mode == "eval":
         config.pop("steps_per_call", None)
     kind = probe_backend()

@@ -58,6 +58,7 @@ _SMOKE_FILES = {
     "test_stream.py",
     "test_supervise.py",
     "test_native.py",
+    "test_bench_unit.py",
 }
 
 

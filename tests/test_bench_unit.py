@@ -1,0 +1,154 @@
+"""Pure-unit tests for bench.py's measurement/replay machinery.
+
+The cached-replay path has lost rounds before (round 1: in-process hang;
+round 3: the only recorded number WAS a replay), so its attribution rules
+— a cached number must never be replayed for a different configuration —
+are locked here. No backend is touched: bench.py's module level imports
+only the stdlib.
+"""
+
+import importlib
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    import bench as bench_mod
+
+    bench_mod = importlib.reload(bench_mod)
+    # Redirect both cache locations into the sandbox.
+    write = str(tmp_path / "logs" / "last_bench.json")
+    monkeypatch.setattr(bench_mod, "_CACHE_WRITE", write)
+    monkeypatch.setattr(bench_mod, "_CACHE_READ", (write,))
+    return bench_mod
+
+
+def _emitted(capsys):
+    return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+
+def test_emit_and_cache_is_metric_keyed(bench, capsys):
+    bench._emit_and_cache({"metric": "a_train_throughput", "value": 1.0})
+    bench._emit_and_cache({"metric": "a_eval_throughput", "value": 2.0})
+    with open(bench._CACHE_WRITE) as f:
+        entries = json.load(f)
+    # An eval run must not evict the train entry (round-3 regression).
+    assert set(entries) == {"a_train_throughput", "a_eval_throughput"}
+
+
+def test_fail_replays_only_matching_config(bench, capsys):
+    payload = {
+        "metric": "m_train_throughput",
+        "value": 123.0,
+        "unit": "waveforms/sec/chip",
+        "dtype": "bf16",
+        "batch": 512,
+        "in_samples": 8192,
+        "steps_per_call": 1,
+    }
+    bench._emit_and_cache(payload)
+    capsys.readouterr()
+
+    # Same config -> replay, marked cached with the error attached.
+    bench._fail(
+        "m_train_throughput",
+        "waveforms/sec/chip",
+        "backend unavailable",
+        config={"dtype": "bf16", "batch": 512, "in_samples": 8192,
+                "steps_per_call": 1},
+    )
+    out = _emitted(capsys)
+    assert out["value"] == 123.0
+    assert out["cached"] is True
+    assert out["error"] == "backend unavailable"
+
+    # ANY differing key (dtype here) -> no replay, honest zero.
+    bench._fail(
+        "m_train_throughput",
+        "waveforms/sec/chip",
+        "backend unavailable",
+        config={"dtype": "fp32", "batch": 512, "in_samples": 8192,
+                "steps_per_call": 1},
+    )
+    out = _emitted(capsys)
+    assert out["value"] == 0 and "cached" not in out
+
+
+def test_fail_stream_config_includes_stride_and_record(bench, capsys):
+    # Stream payloads carry stride/record_seconds; a replay for a run at a
+    # different stride would misattribute throughput (stride halving
+    # nearly doubles the windows per record-second).
+    bench._emit_and_cache(
+        {
+            "metric": "m_stream_throughput",
+            "value": 900.0,
+            "unit": "record-seconds/sec",
+            "batch": 32,
+            "in_samples": 8192,
+            "stride": 4096,
+            "record_seconds": 600,
+        }
+    )
+    capsys.readouterr()
+    bench._fail(
+        "m_stream_throughput",
+        "record-seconds/sec",
+        "down",
+        config={"batch": 32, "in_samples": 8192, "stride": 512,
+                "record_seconds": 600},
+    )
+    assert _emitted(capsys)["value"] == 0
+    bench._fail(
+        "m_stream_throughput",
+        "record-seconds/sec",
+        "down",
+        config={"batch": 32, "in_samples": 8192, "stride": 4096,
+                "record_seconds": 600},
+    )
+    out = _emitted(capsys)
+    assert out["value"] == 900.0 and out["cached"] is True
+
+
+def test_peak_flops_non_tpu_is_zero(bench):
+    # A CPU debug run must not fabricate an MFU against a TPU peak.
+    assert bench._peak_flops("cpu") == 0.0
+    assert bench._peak_flops("TPU v5 lite") == 197e12
+    assert bench._peak_flops("TPU v4") == 275e12
+    assert bench._peak_flops("some new TPU kind") == 197e12  # conservative
+
+
+def test_roofline_context(bench):
+    # seist_l-ish numbers: 870 GFLOP/step, 30 GB accessed -> intensity 29
+    # vs v5e ridge 240 -> memory-bound, MFU ceiling ~12%.
+    r = bench._roofline(8.7e11, 3.0e10, "TPU v5 lite")
+    assert r["memory_bound"] is True
+    assert r["arithmetic_intensity"] == 29.0
+    assert 0.1 < r["mfu_bound"] < 0.15
+    # Compute-bound case caps at 1.0.
+    r = bench._roofline(1e12, 1e9, "TPU v5 lite")
+    assert r["memory_bound"] is False and r["mfu_bound"] == 1.0
+    # Unavailable inputs (CPU debug run, no cost analysis) -> None.
+    assert bench._roofline(0.0, 3e10, "TPU v5 lite") is None
+    assert bench._roofline(8.7e11, 3.0e10, "cpu") is None
+
+
+def test_vs_baseline_rejects_mismatched_length(bench, tmp_path, monkeypatch):
+    tools_dir = tmp_path / "tools"
+    tools_dir.mkdir()
+    (tools_dir / "reference_baseline.json").write_text(
+        json.dumps(
+            {
+                "per_model": {
+                    "m": {"waveforms_per_sec": 10.0, "in_samples": 8192}
+                }
+            }
+        )
+    )
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    # wf/s scales inversely with length: an 8192-sample baseline must not
+    # be compared against a 512-sample run.
+    assert bench._vs_baseline(100.0, "m", 8192) == 10.0
+    assert bench._vs_baseline(100.0, "m", 512) == 0.0

@@ -12,7 +12,6 @@
 # Skip r3 steps with R3_SKIP="tag1 tag2" as before.
 set -u
 LOG=/root/repo/tools/ab_r4.log
-R4_START="$(date -u +%FT%TZ)"  # freshness floor for the bench asserts
 cd /root/repo
 
 say() { echo "$*" >> "$LOG"; }
@@ -37,22 +36,30 @@ bash tools/r3_silicon.sh "$LOG"
 
 B="BENCH_STEPS=15 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=120"
 
-# 2. Kernel-status hard assert on the HEAD train bench (VERDICT r3 #4):
-#    the seist_l_dpk cache entry must have been measured DURING this
-#    script run (logs/last_bench.json only ever stores fresh successes,
-#    so recency — not a 'cached' flag — is the freshness test) and must
-#    report overall == "fused".
-run_step kernel_status_assert 60 R4_START="$R4_START" -- \
+# 2. Kernel-status hard assert (VERDICT r3 #4). The cache entry is keyed
+#    by metric only and the r3 sweeps (scale_b*, iso_*, matrix) all
+#    overwrite it, so FIRST land a fresh headline-config bench, THEN
+#    assert on a config-matched, this-run-fresh entry.
+HEADLINE_START="$(date -u +%FT%TZ)"
+run_step headline_for_assert 900 $B -- python bench.py
+run_step kernel_status_assert 60 R4_START="$HEADLINE_START" -- \
   python - <<'EOF'
 import json, os, sys
 d = json.load(open("logs/last_bench.json"))
 e = d.get("seist_l_dpk_train_throughput") or {}
-start = os.environ["R4_START"]  # captured at script start
+start = os.environ["R4_START"]  # captured just before the headline bench
 print("kernel_status:", json.dumps(e.get("kernel_status")),
-      "measured_at:", e.get("measured_at"), "run started:", start)
+      "measured_at:", e.get("measured_at"), "headline started:", start,
+      "config:", {k: e.get(k) for k in ("batch", "dtype", "in_samples",
+                                        "steps_per_call")})
+want = {"batch": 512, "dtype": "bf16", "in_samples": 8192,
+        "steps_per_call": 1}
+assert all(e.get(k) == v for k, v in want.items()), (
+    f"cache entry is not the headline config: {e}"
+)
 assert e.get("measured_at", "") >= start, (
-    "seist_l_dpk cache entry predates this run - the HEAD bench never "
-    "landed a fresh measurement"
+    "seist_l_dpk cache entry predates the headline bench - no fresh "
+    "measurement landed"
 )
 ks = e.get("kernel_status") or {}
 assert ks.get("overall") == "fused", f"fused kernel NOT used: {ks}"
@@ -66,6 +73,7 @@ run_step stream_phasenet 900 $B BENCH_MODE=stream BENCH_MODEL=phasenet -- python
 # 4. Steady-state profile of the flagship step for the MFU breakdown
 #    (stems <15% target; VERDICT r3 #2).
 run_step profile_flagship 1200 _=_ -- python tools/profile_step.py \
-  --model-name seist_l_dpk --batch 512 --steps 10 --out logs/r4_trace
+  --model-name seist_l_dpk --batch 512 --dtype bf16 --steps 10 \
+  --out logs/r4_trace
 
 say "R4 ALL DONE $(date -u +%FT%TZ)"
