@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-4 arm-and-fire watcher: block until the TPU tunnel answers, then
+# immediately run the full round-4 evidence sequence (tools/r4_silicon.sh,
+# which fronts the headline HEAD bench + fused-kernel assert so a short
+# tunnel window still yields the round's #1 deliverable).
+#
+#   nohup bash tools/r4_watch.sh > tools/r4_watch.log 2>&1 &
+#
+# Safe to leave running all round; it exits after one full r4 sequence.
+cd /root/repo
+bash tools/tpu_probe_loop.sh
+echo "tunnel up -> launching r4_silicon $(date -u +%FT%TZ)"
+bash tools/r4_silicon.sh
+echo "r4_watch done $(date -u +%FT%TZ)"
