@@ -299,14 +299,26 @@ def _probe_kernel(l, m, he, heads, rate, dtype) -> None:
 
 
 _TRANSIENT_ERROR_MARKERS = ("RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED", "UNAVAILABLE")
+# A deterministic kernel VMEM/scratch overflow ALSO surfaces as
+# RESOURCE_EXHAUSTED; unlike HBM pressure it never clears, so re-probing
+# it on every trace would cost a probe compile + warning forever.
+_PERMANENT_EXHAUSTION_MARKERS = ("vmem", "scratch", "smem")
+# Even genuinely-transient failures stop being worth re-probing after a
+# few traces in the same process — cap, then cache as unusable.
+_MAX_TRANSIENT_PROBES = 3
+_TRANSIENT_COUNTS: dict = {}
 
 
 def _is_transient(exc: Exception) -> bool:
     # A probe can fail for reasons that say nothing about Mosaic's ability to
     # compile the kernel — e.g. HBM already occupied by the train state, or a
     # flaky backend connection. Those must not poison the per-process cache.
+    # A VMEM/scratch exhaustion is the opposite: deterministic for the shape,
+    # so treat it as a permanent Mosaic rejection.
     msg = f"{type(exc).__name__}: {exc}"
-    return any(marker in msg for marker in _TRANSIENT_ERROR_MARKERS)
+    if not any(marker in msg for marker in _TRANSIENT_ERROR_MARKERS):
+        return False
+    return not any(m in msg.lower() for m in _PERMANENT_EXHAUSTION_MARKERS)
 
 
 def _kernel_usable(l, m, he, heads, rate, dtype) -> bool:
@@ -327,6 +339,22 @@ def _kernel_usable(l, m, he, heads, rate, dtype) -> bool:
     except Exception as exc:  # noqa: BLE001 - any compile/runtime rejection
         head = str(exc).splitlines()[0][:200] if str(exc) else ""
         if _is_transient(exc):
+            n = _TRANSIENT_COUNTS[key] = _TRANSIENT_COUNTS.get(key, 0) + 1
+            if n >= _MAX_TRANSIENT_PROBES:
+                # Enough: stop paying a probe compile per trace. Cache as
+                # unusable (the event log keeps the transient history).
+                _KERNEL_STATUS[key] = False
+                _KERNEL_EVENTS[key] = (
+                    f"einsum-fallback (transient x{n}, re-probe cap hit: "
+                    f"{head})"
+                )
+                _log.warning(
+                    "fused attention probe failed transiently %d times for "
+                    "shape L=%d M=%d HE=%d H=%d %s; caching einsum fallback "
+                    "for this process (%s)",
+                    n, l, m, he, heads, jnp.dtype(dtype).name, head,
+                )
+                return False
             # Fall back for THIS trace (the enclosing jit bakes einsum in
             # permanently for this program!) but leave the retry cache
             # empty so a LATER trace — a re-jit, another shape — re-probes

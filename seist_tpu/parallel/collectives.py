@@ -114,7 +114,20 @@ def _payload_bytes(lhs: str, kind: str = "", is_start: bool = False) -> int:
         return 0
     if is_start:
         if kind == "all-reduce":
-            return sum(sizes) // 2
+            # The SUM/2 rule assumes the TPU tuple form: the lhs aliases
+            # every transferred buffer as (inputs..., outputs...), so the
+            # second half of the shape list mirrors the first. Some XLA
+            # paths (observed on GPU) emit the start with the bare result
+            # only — single shape, or a combined non-aliased tuple — and
+            # halving those is a 2x undercount. Only halve when the
+            # aliasing structure is actually present. (A bare combined
+            # tuple of two identical-size buffers is indistinguishable
+            # from the aliased form and is halved; the TPU programs this
+            # parser targets always use the aliased form.)
+            k = len(sizes) // 2
+            if k and len(sizes) % 2 == 0 and sizes[:k] == sizes[k:]:
+                return sum(sizes) // 2
+            return sum(sizes)
         return max(sizes)
     return sum(sizes)
 

@@ -81,6 +81,19 @@ def test_combined_async_all_reduce_start_sums_half():
     }
 
 
+# Non-TPU XLA paths can emit all-reduce-start with the bare result shape
+# (no aliased-input tuple); the sum/2 rule would halve it (advisor r4).
+_BARE_ASYNC_HLO = """
+  %ars = f32[388778]{0} all-reduce-start(f32[388778]{0} %g0)
+  %ard = f32[388778]{0} all-reduce-done(%ars)
+"""
+
+
+def test_bare_async_all_reduce_start_not_halved():
+    stats = collective_stats(_BARE_ASYNC_HLO)
+    assert stats["all-reduce"] == {"count": 1, "bytes": 388778 * 4}
+
+
 def test_combined_tuple_all_reduce_sums_elements():
     # XLA's all-reduce combiner merges many gradient tensors into ONE
     # tuple-shaped sync op; every element is a distinct transferred buffer

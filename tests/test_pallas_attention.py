@@ -159,6 +159,7 @@ def test_transient_probe_error_not_cached(rng, monkeypatch):
 
     monkeypatch.setattr(pa, "_KERNEL_STATUS", {})
     monkeypatch.setattr(pa, "_KERNEL_EVENTS", {})
+    monkeypatch.setattr(pa, "_TRANSIENT_COUNTS", {})
     calls = {"n": 0}
 
     def flaky_probe(*a):
@@ -194,6 +195,54 @@ def test_transient_probe_error_not_cached(rng, monkeypatch):
     assert pa._kernel_usable(64, 16, 16, 2, 0.0, np.float32) is False
     assert list(pa._KERNEL_STATUS.values()) == [False]
     assert pa.kernel_status_summary()["overall"] == "einsum-fallback"
+
+
+def test_vmem_exhaustion_is_permanent(rng, monkeypatch):
+    # RESOURCE_EXHAUSTED from a VMEM/scratch overflow is deterministic for
+    # the shape — it must be cached as unusable, not re-probed forever
+    # (advisor r4).
+    from seist_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_KERNEL_STATUS", {})
+    monkeypatch.setattr(pa, "_KERNEL_EVENTS", {})
+    monkeypatch.setattr(pa, "_TRANSIENT_COUNTS", {})
+    monkeypatch.setattr(pa, "_FALLBACK_LOGGED", False)
+
+    def vmem_probe(*a):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Ran out of memory in memory space vmem "
+            "while allocating scratch"
+        )
+
+    monkeypatch.setattr(pa, "_probe_kernel", vmem_probe)
+    assert pa._kernel_usable(64, 16, 16, 2, 0.0, np.float32) is False
+    assert list(pa._KERNEL_STATUS.values()) == [False]  # cached, permanent
+
+
+def test_transient_probe_cap_caches_fallback(rng, monkeypatch):
+    # Genuinely-transient failures stop being re-probed after
+    # _MAX_TRANSIENT_PROBES traces: cached unusable, history kept.
+    from seist_tpu.ops import pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_KERNEL_STATUS", {})
+    monkeypatch.setattr(pa, "_KERNEL_EVENTS", {})
+    monkeypatch.setattr(pa, "_TRANSIENT_COUNTS", {})
+    calls = {"n": 0}
+
+    def always_oom(*a):
+        calls["n"] += 1
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory on device")
+
+    monkeypatch.setattr(pa, "_probe_kernel", always_oom)
+    for _ in range(pa._MAX_TRANSIENT_PROBES):
+        assert pa._kernel_usable(64, 16, 16, 2, 0.0, np.float32) is False
+    assert calls["n"] == pa._MAX_TRANSIENT_PROBES
+    assert list(pa._KERNEL_STATUS.values()) == [False]
+    # No further probe compiles once capped.
+    assert pa._kernel_usable(64, 16, 16, 2, 0.0, np.float32) is False
+    assert calls["n"] == pa._MAX_TRANSIENT_PROBES
+    sig = next(iter(pa.kernel_status_summary()["signatures"].values()))
+    assert "re-probe cap" in sig and "transient" in sig
 
 
 def test_kernel_status_summary(monkeypatch):

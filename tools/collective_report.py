@@ -120,16 +120,26 @@ def main() -> None:
         tuple(np.shape(x)) for x in jax.tree.leaves(state.params)
     }
     grad_bytes = grad_ops = act_bytes = act_ops = other_bytes = 0
+    unattr_bytes = unattr_ops = 0
     per_shard_batch = args.batch // n
     for op in ops:
         dims = op["shape_dims"]
-        if (
-            op["kind"] == "all-reduce"
-            and "transpose(jvp" in op["op_name"]
-            and any(tuple(d) in param_shapes for d in dims)
-        ):
+        is_param_shaped_ar = op["kind"] == "all-reduce" and any(
+            tuple(d) in param_shapes for d in dims
+        )
+        if is_param_shaped_ar and "transpose(jvp" in op["op_name"]:
             grad_bytes += op["bytes"]
             grad_ops += 1
+        elif is_param_shaped_ar:
+            # Param-shaped all-reduces that LACK the backward-pass op_name
+            # marker: XLA's combiner can drop/merge metadata, and silently
+            # filing gradient bytes under the activation or scalar buckets
+            # would make the report claim ~0 gradient traffic (advisor
+            # r4). Checked BEFORE the batch-leading-dim heuristic so a
+            # param with a batch-sized leading dim can't shadow it.
+            unattr_bytes += op["bytes"]
+            unattr_ops += 1
+            other_bytes += op["bytes"]
         elif any(
             d and d[0] in (args.batch, per_shard_batch) and len(d) >= 2
             for d in dims
@@ -138,6 +148,15 @@ def main() -> None:
             act_ops += 1
         else:
             other_bytes += op["bytes"]
+    if grad_ops == 0 and unattr_bytes:
+        print(
+            "WARNING: no all-reduce carries the transpose(jvp) gradient "
+            f"marker, but {unattr_ops} param-shaped all-reduce op(s) "
+            f"({unattr_bytes / 1e6:.3f} MB) exist — XLA likely dropped "
+            "op_name metadata when combining; treat unattributed_allreduce "
+            "as the gradient bucket.",
+            file=sys.stderr,
+        )
     print(
         json.dumps(
             {
@@ -170,6 +189,17 @@ def main() -> None:
                 "bn_stat_and_scalar_collectives_mb": round(
                     other_bytes / 1e6, 3
                 ),
+                "unattributed_allreduce": {
+                    "ops": unattr_ops,
+                    "mb": round(unattr_bytes / 1e6, 3),
+                    "note": (
+                        "param-shaped all-reduces WITHOUT the "
+                        "transpose(jvp) marker (also included in the "
+                        "bn_stat bucket); nonzero while gradient ops==0 "
+                        "means XLA dropped combiner metadata and these "
+                        "ARE the gradient bytes"
+                    ),
+                },
                 "ring_allreduce_link_traffic_mb": round(
                     total * 2 * (n - 1) / n / 1e6, 3
                 ),

@@ -72,8 +72,10 @@ EOF
 R3_SKIP="parity_tpu_lowerings matrix_bf16" bash tools/r3_silicon.sh "$LOG"
 
 # 3. Continuous-record serving throughput (VERDICT r3 #3, deployment half).
-run_step stream_seist_s 900 $B BENCH_MODE=stream BENCH_MODEL=seist_s_dpk -- python bench.py
-run_step stream_phasenet 900 $B BENCH_MODE=stream BENCH_MODEL=phasenet -- python bench.py
+#    BENCH_STEPS=3 (bench_stream's own default), not $B's 15: each step
+#    annotates a full 600 s record, so 15 would blow the 900 s timeout.
+run_step stream_seist_s 900 BENCH_STEPS=3 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=120 BENCH_MODE=stream BENCH_MODEL=seist_s_dpk -- python bench.py
+run_step stream_phasenet 900 BENCH_STEPS=3 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=120 BENCH_MODE=stream BENCH_MODEL=phasenet -- python bench.py
 
 # 4. Steady-state profile of the flagship step for the MFU breakdown
 #    (stems <15% target; VERDICT r3 #2). bf16: the program the MFU claim
