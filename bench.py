@@ -134,6 +134,72 @@ def stream_config() -> dict:
     }
 
 
+def _config_key(metric: str, config: dict) -> str:
+    """Cache key for one (metric, configuration) pair. Sweeps at other
+    batches/dtypes write under their own keys, so the headline config's
+    entry can never be overwritten by a later sweep (VERDICT r4 #5)."""
+    import hashlib
+
+    digest = hashlib.sha1(
+        json.dumps(config, sort_keys=True).encode()
+    ).hexdigest()[:10]
+    return f"{metric}@{digest}"
+
+
+def _rekey_cached(cached: dict) -> dict:
+    """Re-emit a cached payload under the CURRENT schema (VERDICT r4 #4):
+    a replay recorded before a schema change must not lead with a retired
+    ratio or silently lack the fields the judge reads. Recomputes
+    ``vs_baseline`` against the frozen A100 anchor from the cached wf/s,
+    refreshes ``vs_torch_cpu_1core``, attaches ``kernel_status`` ("unknown
+    (cached)" when the entry predates kernel-status recording) and a
+    ``stale_since``/``age_hours`` staleness marker."""
+    cached = dict(cached)
+    metric = cached.get("metric", "")
+    measured_at = cached.get("measured_at")
+    if measured_at:
+        cached["stale_since"] = measured_at
+        try:
+            cached["age_hours"] = round(
+                (time.time() - _utc_seconds(measured_at)) / 3600, 1
+            )
+        except ValueError:
+            pass
+    if metric.endswith("_train_throughput"):
+        flops_per_wf = cached.get("flops_per_waveform") or 0
+        wfs = cached.get("value") or 0
+        if flops_per_wf and wfs:
+            cached["vs_baseline"] = round(
+                wfs * flops_per_wf / _A100_ANCHOR_FLOPS, 3
+            )
+            cached["baseline"] = (
+                "one A100 at a frozen 3% MFU analytical anchor "
+                "(312 TFLOP/s bf16; BASELINE.md ~4k-7k wf/s band midpoint)"
+            )
+            mfu = cached.get("mfu")
+            cached["a100_analytical_wfs"] = (
+                round(mfu * 312e12 / flops_per_wf, 1) if mfu else None
+            )
+        else:
+            # Cannot recompute the anchor ratio — NEVER leave a
+            # possibly-retired ratio in the leading field.
+            cached["vs_baseline_legacy"] = cached.get("vs_baseline")
+            cached["vs_baseline"] = None
+        model = metric[: -len("_train_throughput")]
+        cached["vs_torch_cpu_1core"] = _vs_baseline(
+            wfs, model, cached.get("in_samples")
+        )
+    if "kernel_status" not in cached:
+        cached["kernel_status"] = "unknown(cached)"
+    return cached
+
+
+def _utc_seconds(stamp: str) -> float:
+    import calendar
+
+    return calendar.timegm(time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ"))
+
+
 def _fail(
     metric: str, unit: str, error: str, config: Optional[dict] = None
 ) -> None:
@@ -141,7 +207,8 @@ def _fail(
     metric AND configuration is cached, replay it clearly marked as
     cached: the TPU tunnel here goes down for long stretches (it cost
     round 1 its number), and a marked stale measurement is strictly more
-    informative than a 0."""
+    informative than a 0. Replays are re-emitted under the CURRENT schema
+    (see _rekey_cached)."""
     for path in _CACHE_READ:
         if not os.path.exists(path):
             continue
@@ -150,12 +217,18 @@ def _fail(
                 data = json.load(f)
         except Exception:  # noqa: BLE001 - unreadable cache, try next
             continue
-        # metric -> payload map, or a legacy single-payload file.
-        cached = data.get(metric) if "metric" not in data else data
+        # Exact (metric, config) key first; then the legacy metric key /
+        # single-payload layouts, config-match filtered.
+        cached = None
+        if config and "metric" not in data:
+            cached = data.get(_config_key(metric, config))
+        if cached is None:
+            cached = data.get(metric) if "metric" not in data else data
         if not cached or cached.get("metric") != metric:
             continue
         if config and any(cached.get(k) != v for k, v in config.items()):
             continue  # different dtype/batch/... — do not misattribute
+        cached = _rekey_cached(cached)
         cached["cached"] = True
         cached["error"] = error
         _emit(cached)
@@ -171,16 +244,67 @@ def _fail(
     )
 
 
-def probe_backend(
-    attempts: int = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 3)),
-    timeout: int = int(os.environ.get("BENCH_PROBE_TIMEOUT", 180)),
-):
+def _tunnel_known_down(max_age_s: int = 600) -> bool:
+    """True when a probe-loop/watcher log shows the tunnel failing
+    RECENTLY (last line is a ``probe N down`` within ``max_age_s``). The
+    probe loops write one line every ~4 min, so a fresh 'down' line is a
+    stronger signal than anything a 3x180 s probe ladder could add —
+    fail fast instead of spending 10+ min of the capture window
+    (VERDICT r4 #9)."""
+    import glob
+
+    import re
+
+    now = time.time()
+    for path in glob.glob(os.path.join(_REPO, "tools", "*watch*.log")) + glob.glob(
+        os.path.join(_REPO, "tools", "*probe*.log")
+    ):
+        try:
+            if now - os.path.getmtime(path) > max_age_s:
+                continue
+            with open(path) as f:
+                lines = [ln.strip() for ln in f if ln.strip()]
+        except OSError:
+            continue
+        if not (lines and " down " in f" {lines[-1]} " and "probe" in lines[-1]):
+            continue
+        # mtime alone is forgeable by a git checkout of the tracked log —
+        # require the line's OWN timestamp (HH:MM:SSZ, UTC) to be within
+        # the window too (modular seconds-of-day handles midnight wrap).
+        m = re.search(r"(\d{2}):(\d{2}):(\d{2})Z", lines[-1])
+        if not m:
+            continue
+        line_sod = int(m[1]) * 3600 + int(m[2]) * 60 + int(m[3])
+        now_sod = (
+            time.gmtime().tm_hour * 3600
+            + time.gmtime().tm_min * 60
+            + time.gmtime().tm_sec
+        )
+        if (now_sod - line_sod) % 86400 > max_age_s:
+            continue
+        _eprint(f"fresh 'tunnel down' signal in {path}: {lines[-1]!r}")
+        return True
+    return False
+
+
+def probe_backend(attempts: Optional[int] = None, timeout: Optional[int] = None):
     """Bring up the accelerator in a subprocess under a hard timeout.
 
     Returns device_kind on success, None after all retries. Round 1 lost its
     number to an in-process backend-init hang (BENCH_r01.json rc=1); a
-    subprocess can always be killed.
+    subprocess can always be killed. When a probe-loop log shows the tunnel
+    down within the last 10 min, the default ladder collapses to one 60 s
+    attempt (explicit BENCH_PROBE_* env always wins).
     """
+    env_attempts = os.environ.get("BENCH_PROBE_ATTEMPTS")
+    env_timeout = os.environ.get("BENCH_PROBE_TIMEOUT")
+    if attempts is None:
+        attempts = int(env_attempts) if env_attempts else 3
+    if timeout is None:
+        timeout = int(env_timeout) if env_timeout else 180
+    if not (env_attempts or env_timeout) and _tunnel_known_down():
+        attempts, timeout = 1, 60
+    probe_backend.last_attempts = attempts  # for main()'s failure message
     code = (
         # The sandbox sitecustomize registers the TPU backend at interpreter
         # start, so JAX_PLATFORMS in the env alone is not honored — force it
@@ -343,13 +467,16 @@ def _roofline(flops: float, bytes_accessed: float, device_kind: str):
     }
 
 
-def _emit_and_cache(payload: dict) -> None:
+def _emit_and_cache(payload: dict, config: Optional[dict] = None) -> None:
     """Emit the JSON line and persist it for _fail's marked cached replay
     (the metric+config keys in the payload make a replay attributable).
 
     The cache file maps metric -> payload so an eval-mode run cannot
     evict the train entry the driver's round-end bench.py relies on
-    (legacy single-payload files are upgraded in place)."""
+    (legacy single-payload files are upgraded in place). With ``config``
+    the payload is ALSO stored under the (metric, config-hash) key, which
+    a later sweep at a different batch/dtype can never overwrite — the
+    headline entry survives the sweeps (VERDICT r4 #5)."""
     entries = {}
     try:
         with open(_CACHE_WRITE) as f:
@@ -358,6 +485,8 @@ def _emit_and_cache(payload: dict) -> None:
     except (OSError, ValueError):
         pass
     entries[payload["metric"]] = payload
+    if config:
+        entries[_config_key(payload["metric"], config)] = payload
     try:
         os.makedirs(os.path.dirname(_CACHE_WRITE), exist_ok=True)
         with open(_CACHE_WRITE, "w") as f:
@@ -365,6 +494,32 @@ def _emit_and_cache(payload: dict) -> None:
     except OSError as e:
         _eprint(f"could not cache result: {e}")
     _emit(payload)
+
+
+def _degraded(device_kind: str, kernel_status: dict) -> bool:
+    """True when a TPU run fell back to the einsum attention path — the
+    fused-kernel guarantee the silicon runner used to assert out-of-band
+    (VERDICT r4 #5). ``unprobed`` is NOT degraded: attention-free models
+    (phasenet etc.) never probe."""
+    return (
+        "tpu" in device_kind.lower()
+        and kernel_status.get("overall") == "einsum-fallback"
+    )
+
+
+def _enforce_fused(payload: dict) -> None:
+    """Loud failure on a degraded TPU run: always a stderr banner; exit
+    non-zero under BENCH_REQUIRE_FUSED=1 (the silicon runner sets it for
+    the headline step, making its config-matching assert redundant)."""
+    if not payload.get("degraded"):
+        return
+    _eprint(
+        "ERROR: TPU run fell back to the einsum attention path "
+        f"(kernel_status={json.dumps(payload.get('kernel_status'))}); "
+        "the measurement is valid but NOT the fused-kernel configuration."
+    )
+    if os.environ.get("BENCH_REQUIRE_FUSED") == "1":
+        sys.exit(3)
 
 
 def _setup_model(cfg: dict, tx=None):
@@ -493,6 +648,7 @@ def bench_train(device_kind: str) -> None:
     )
     from seist_tpu.ops.pallas_attention import kernel_status_summary
 
+    ks = kernel_status_summary()
     payload = {
         "metric": metric,
         "value": round(wfs, 2),
@@ -509,7 +665,8 @@ def bench_train(device_kind: str) -> None:
         "mfu_note": "vs bf16 dense peak",
         "flops_per_waveform": round(flops_per_wf),
         "roofline": _roofline(flops_per_step, bytes_per_step, device_kind),
-        "kernel_status": kernel_status_summary(),
+        "kernel_status": ks,
+        "degraded": _degraded(device_kind, ks),
         "dtype": dtype,
         "device": device_kind,
         "batch": batch,
@@ -517,7 +674,10 @@ def bench_train(device_kind: str) -> None:
         "steps_per_call": spc,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
-    _emit_and_cache(payload)
+    _emit_and_cache(
+        payload, config={k: v for k, v in cfg.items() if k != "model"}
+    )
+    _enforce_fused(payload)
 
 
 def bench_eval(device_kind: str) -> None:
@@ -567,15 +727,16 @@ def bench_eval(device_kind: str) -> None:
     flops_per_wf = flops_per_step / batch if flops_per_step else 0.0
     from seist_tpu.ops.pallas_attention import kernel_status_summary
 
-    _emit_and_cache(
-        {
+    ks = kernel_status_summary()
+    payload = {
             "metric": f"{model_name}_eval_throughput",
             "value": round(wfs, 2),
             "unit": "waveforms/sec/chip",
             # No comparator: tools/reference_baseline.json records train
             # throughput only.
             "vs_baseline": None,
-            "kernel_status": kernel_status_summary(),
+            "kernel_status": ks,
+            "degraded": _degraded(device_kind, ks),
             "step_time_ms": round(dt / bench_steps * 1e3, 2),
             "mfu": round(wfs * flops_per_wf / _peak_flops(device_kind), 4)
             if flops_per_wf and _peak_flops(device_kind)
@@ -590,8 +751,16 @@ def bench_eval(device_kind: str) -> None:
             "batch": batch,
             "in_samples": in_samples,
             "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        }
+    }
+    _emit_and_cache(
+        payload,
+        config={
+            k: v
+            for k, v in cfg.items()
+            if k not in ("model", "steps_per_call")
+        },
     )
+    _enforce_fused(payload)
 
 
 def bench_stream(device_kind: str) -> None:
@@ -657,13 +826,14 @@ def bench_stream(device_kind: str) -> None:
     rss = rec_seconds * steps / dt
     from seist_tpu.ops.pallas_attention import kernel_status_summary
 
-    _emit_and_cache(
-        {
+    ks = kernel_status_summary()
+    payload = {
             "metric": f"{model_name}_stream_throughput",
             "value": round(rss, 2),
             "unit": "record-seconds/sec",
             "vs_baseline": None,  # the reference has no continuous path
-            "kernel_status": kernel_status_summary(),
+            "kernel_status": ks,
+            "degraded": _degraded(device_kind, ks),
             "record_seconds": rec_seconds,
             # cache-key field (_fail matches on it): the window IS the
             # model's in_samples.
@@ -676,8 +846,9 @@ def bench_stream(device_kind: str) -> None:
             "device": device_kind,
             "dtype": "fp32",
             "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        }
-    )
+    }
+    _emit_and_cache(payload, config=scfg)
+    _enforce_fused(payload)
 
 
 def bench_loader() -> None:
@@ -760,7 +931,7 @@ def main() -> None:
         config.pop("steps_per_call", None)
     kind = probe_backend()
     if kind is None:
-        n = os.environ.get("BENCH_PROBE_ATTEMPTS", "3")
+        n = getattr(probe_backend, "last_attempts", "?")
         _fail(
             metric,
             unit,

@@ -10,6 +10,7 @@ only the stdlib.
 import importlib
 import json
 import os
+import time
 
 import pytest
 
@@ -133,6 +134,164 @@ def test_roofline_context(bench):
     # Unavailable inputs (CPU debug run, no cost analysis) -> None.
     assert bench._roofline(0.0, 3e10, "TPU v5 lite") is None
     assert bench._roofline(8.7e11, 3.0e10, "cpu") is None
+
+
+def test_replay_rekeyed_to_current_schema(bench, capsys, monkeypatch):
+    # VERDICT r4 #4: a cached replay recorded under an OLD schema must be
+    # re-emitted under the current one — anchor-based vs_baseline, a
+    # kernel_status placeholder, and a staleness marker — never the
+    # retired torch-CPU ratio.
+    old_entry = {
+        "metric": "seist_l_dpk_train_throughput",
+        "value": 2799.32,
+        "unit": "waveforms/sec/chip",
+        "vs_baseline": 287.7,  # retired torch-CPU-1core ratio
+        "flops_per_waveform": 1698576640,
+        "mfu": 0.0241,
+        "dtype": "bf16",
+        "batch": 512,
+        "in_samples": 8192,
+        "steps_per_call": 1,
+        "measured_at": "2026-07-31T04:28:44Z",
+    }
+    bench._emit_and_cache(dict(old_entry))
+    capsys.readouterr()
+    bench._fail(
+        "seist_l_dpk_train_throughput",
+        "waveforms/sec/chip",
+        "backend unavailable",
+        config={"dtype": "bf16", "batch": 512, "in_samples": 8192,
+                "steps_per_call": 1},
+    )
+    out = _emitted(capsys)
+    assert out["cached"] is True and out["value"] == 2799.32
+    # Recomputed against the frozen A100 anchor: wfs*flops/anchor ~ 0.508.
+    want = round(2799.32 * 1698576640 / bench._A100_ANCHOR_FLOPS, 3)
+    assert out["vs_baseline"] == want and 0.4 < want < 0.6
+    assert out["kernel_status"] == "unknown(cached)"
+    assert out["stale_since"] == "2026-07-31T04:28:44Z"
+    assert out["age_hours"] > 0
+    assert out["a100_analytical_wfs"] is not None
+
+
+def test_replay_nulls_unrecomputable_ratio(bench, capsys):
+    # An old-schema entry with NO flops_per_waveform cannot be re-anchored;
+    # the retired ratio must be moved aside, never left leading.
+    bench._emit_and_cache(
+        {
+            "metric": "m_train_throughput",
+            "value": 100.0,
+            "unit": "waveforms/sec/chip",
+            "vs_baseline": 287.7,
+            "batch": 512,
+        }
+    )
+    capsys.readouterr()
+    bench._fail(
+        "m_train_throughput", "waveforms/sec/chip", "down",
+        config={"batch": 512},
+    )
+    out = _emitted(capsys)
+    assert out["vs_baseline"] is None
+    assert out["vs_baseline_legacy"] == 287.7
+
+
+def test_config_keyed_entry_survives_sweep_overwrite(bench, capsys):
+    # VERDICT r4 #5: a later sweep at another batch must not evict the
+    # headline entry — the (metric, config) key preserves it.
+    headline_cfg = {"dtype": "bf16", "batch": 512, "in_samples": 8192,
+                    "steps_per_call": 1}
+    sweep_cfg = dict(headline_cfg, batch=256)
+    bench._emit_and_cache(
+        {"metric": "m_train_throughput", "value": 100.0, "unit": "u",
+         **headline_cfg},
+        config=headline_cfg,
+    )
+    bench._emit_and_cache(
+        {"metric": "m_train_throughput", "value": 55.0, "unit": "u",
+         **sweep_cfg},
+        config=sweep_cfg,
+    )
+    capsys.readouterr()
+    bench._fail("m_train_throughput", "u", "down", config=headline_cfg)
+    out = _emitted(capsys)
+    assert out["value"] == 100.0 and out["batch"] == 512
+    bench._fail("m_train_throughput", "u", "down", config=sweep_cfg)
+    assert _emitted(capsys)["value"] == 55.0
+
+
+def test_degraded_flag_and_enforcement(bench, monkeypatch, capsys):
+    # VERDICT r4 #5: an einsum fallback on TPU must be loud, not a silent
+    # -105% in the number.
+    fused = {"overall": "fused", "signatures": {}}
+    fallen = {"overall": "einsum-fallback", "signatures": {}}
+    unprobed = {"overall": "unprobed", "signatures": {}}
+    assert bench._degraded("TPU v5 lite", fallen) is True
+    assert bench._degraded("TPU v5 lite", fused) is False
+    # attention-free models never probe; that is not a degradation
+    assert bench._degraded("TPU v5 lite", unprobed) is False
+    assert bench._degraded("cpu", fallen) is False
+
+    bench._enforce_fused({"degraded": False})  # no-op
+    monkeypatch.setenv("BENCH_REQUIRE_FUSED", "1")
+    with pytest.raises(SystemExit) as exc:
+        bench._enforce_fused({"degraded": True, "kernel_status": fallen})
+    assert exc.value.code == 3
+    monkeypatch.delenv("BENCH_REQUIRE_FUSED")
+    bench._enforce_fused({"degraded": True, "kernel_status": fallen})  # warns only
+
+
+def test_tunnel_known_down_collapses_probe_ladder(
+    bench, tmp_path, monkeypatch
+):
+    # VERDICT r4 #9: a fresh 'probe N down' line in a watcher log must
+    # collapse the 3x180s ladder to one fast attempt.
+    tools_dir = tmp_path / "tools"
+    tools_dir.mkdir(exist_ok=True)
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    assert bench._tunnel_known_down() is False  # no logs at all
+    now_z = time.strftime("%H:%M:%SZ", time.gmtime())
+    old_z = time.strftime("%H:%M:%SZ", time.gmtime(time.time() - 3600))
+    log = tools_dir / "r5_watch.log"
+    log.write_text(f"probe 1 down {old_z}\nprobe 2 down {now_z}\n")
+    assert bench._tunnel_known_down() is True
+    # A stale log (old mtime) is no signal.
+    old = time.time() - 3600
+    os.utime(log, (old, old))
+    assert bench._tunnel_known_down() is False
+    # Fresh mtime (e.g. a git checkout of the tracked log) but an OLD line
+    # timestamp is no signal either — the line's own clock must agree.
+    log.write_text(f"probe 1 down {old_z}\n")
+    assert bench._tunnel_known_down() is False
+    # A log whose last line is the probe loop's TUNNEL UP is no signal.
+    log.write_text(f"probe 1 down {old_z}\nTUNNEL UP {now_z}\n")
+    assert bench._tunnel_known_down() is False
+    # Probe honors the signal unless BENCH_PROBE_* is explicit.
+    log.write_text(f"probe 9 down {now_z}\n")
+    calls = {}
+
+    def fake_run(cmd, **kw):
+        calls["timeout"] = kw.get("timeout")
+        calls["n"] = calls.get("n", 0) + 1
+
+        class R:
+            returncode = 1
+            stdout = ""
+            stderr = "down"
+
+        return R()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("BENCH_PROBE_ATTEMPTS", raising=False)
+    monkeypatch.delenv("BENCH_PROBE_TIMEOUT", raising=False)
+    assert bench.probe_backend() is None
+    assert calls == {"timeout": 60, "n": 1}
+    monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "2")
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "5")
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    calls.clear()
+    assert bench.probe_backend() is None
+    assert calls == {"timeout": 5, "n": 2}
 
 
 def test_vs_baseline_rejects_mismatched_length(bench, tmp_path, monkeypatch):

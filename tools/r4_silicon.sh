@@ -39,7 +39,7 @@ B="BENCH_STEPS=15 BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT=120"
 #    The assert is config-matched because the cache is metric-keyed and
 #    later sweeps (scale_b*, iso_*, matrix) overwrite the entry.
 HEADLINE_START="$(date -u +%FT%TZ)"
-run_step headline_for_assert 1200 $B -- python bench.py
+run_step headline_for_assert 1200 $B BENCH_REQUIRE_FUSED=1 -- python bench.py
 run_step kernel_status_assert 60 R4_START="$HEADLINE_START" -- \
   python - <<'EOF'
 import json, os, sys
