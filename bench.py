@@ -269,18 +269,17 @@ def _tunnel_known_down(max_age_s: int = 600) -> bool:
         if not (lines and " down " in f" {lines[-1]} " and "probe" in lines[-1]):
             continue
         # mtime alone is forgeable by a git checkout of the tracked log —
-        # require the line's OWN timestamp (HH:MM:SSZ, UTC) to be within
-        # the window too (modular seconds-of-day handles midnight wrap).
-        m = re.search(r"(\d{2}):(\d{2}):(\d{2})Z", lines[-1])
+        # require the line's OWN timestamp to be within the window, and
+        # only trust FULL-date stamps (tools/tpu_probe_loop.sh emits
+        # %FT%TZ; an HH:MM:SS-only line from an old log would match the
+        # same wall-clock window on any later day).
+        m = re.search(r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z", lines[-1])
         if not m:
             continue
-        line_sod = int(m[1]) * 3600 + int(m[2]) * 60 + int(m[3])
-        now_sod = (
-            time.gmtime().tm_hour * 3600
-            + time.gmtime().tm_min * 60
-            + time.gmtime().tm_sec
-        )
-        if (now_sod - line_sod) % 86400 > max_age_s:
+        try:
+            if now - _utc_seconds(m.group(0)) > max_age_s:
+                continue
+        except ValueError:
             continue
         _eprint(f"fresh 'tunnel down' signal in {path}: {lines[-1]!r}")
         return True

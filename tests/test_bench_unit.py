@@ -250,8 +250,9 @@ def test_tunnel_known_down_collapses_probe_ladder(
     tools_dir.mkdir(exist_ok=True)
     monkeypatch.setattr(bench, "_REPO", str(tmp_path))
     assert bench._tunnel_known_down() is False  # no logs at all
-    now_z = time.strftime("%H:%M:%SZ", time.gmtime())
-    old_z = time.strftime("%H:%M:%SZ", time.gmtime(time.time() - 3600))
+    iso = "%Y-%m-%dT%H:%M:%SZ"
+    now_z = time.strftime(iso, time.gmtime())
+    old_z = time.strftime(iso, time.gmtime(time.time() - 3600))
     log = tools_dir / "r5_watch.log"
     log.write_text(f"probe 1 down {old_z}\nprobe 2 down {now_z}\n")
     assert bench._tunnel_known_down() is True
@@ -262,6 +263,10 @@ def test_tunnel_known_down_collapses_probe_ladder(
     # Fresh mtime (e.g. a git checkout of the tracked log) but an OLD line
     # timestamp is no signal either — the line's own clock must agree.
     log.write_text(f"probe 1 down {old_z}\n")
+    assert bench._tunnel_known_down() is False
+    # Legacy HH:MM:SS-only stamps are never trusted: the same wall-clock
+    # window recurs every day, so they cannot prove freshness.
+    log.write_text("probe 1 down " + time.strftime("%H:%M:%SZ", time.gmtime()))
     assert bench._tunnel_known_down() is False
     # A log whose last line is the probe loop's TUNNEL UP is no signal.
     log.write_text(f"probe 1 down {old_z}\nTUNNEL UP {now_z}\n")

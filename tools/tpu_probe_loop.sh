@@ -1,6 +1,9 @@
 #!/bin/bash
 # Round-3: block until the TPU tunnel answers, then exit 0.
 # Driven interactively by the session (no fire-and-forget work here).
+# Lines carry FULL ISO dates: bench.py's fail-fast path only trusts a
+# 'down' line whose own timestamp is fresh (HH:MM:SS alone would match
+# the same wall-clock window on any later day).
 probe() {
   timeout 70 python -c "
 import jax, jax.numpy as jnp
@@ -10,7 +13,7 @@ r.block_until_ready(); print('UP')" 2>/dev/null | grep -q UP
 n=0
 until probe; do
   n=$((n+1))
-  echo "probe $n down $(date -u +%H:%M:%SZ)"
+  echo "probe $n down $(date -u +%FT%TZ)"
   sleep 180
 done
-echo "TUNNEL UP $(date -u +%H:%M:%SZ)"
+echo "TUNNEL UP $(date -u +%FT%TZ)"
