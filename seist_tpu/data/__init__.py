@@ -2,7 +2,7 @@
 
 from seist_tpu.data.preprocess import DataPreprocessor, pad_array, pad_phases  # noqa: F401
 from seist_tpu.data.base import DatasetBase  # noqa: F401
-from seist_tpu.data import diting, pnw, sos, synthetic  # noqa: F401  (registration)
+from seist_tpu.data import diting, packed, pnw, sos, synthetic  # noqa: F401  (registration)
 from seist_tpu.data.pipeline import (  # noqa: F401
     Batch,
     Loader,

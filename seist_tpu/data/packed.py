@@ -1,0 +1,222 @@
+"""Packed-shard dataset: offline HDF5 -> contiguous binary shards.
+
+SURVEY.md §7's input-pipeline mitigation (the ArrayRecord-style offline
+repack), built for the measured bottleneck: the r3 loader stage budget
+put ~30% of per-sample cost in the read stage — h5py's per-sample
+group/dataset lookup and decode — before any augmentation runs
+(ref datasets/diting.py:139-142 does one ``grp.get(key)`` per sample;
+our reader mirrors it in data/diting.py:103-146).
+
+The repack trades that per-sample API cost for ONE seek-free slice:
+
+* ``shard_XXXXX.bin`` — raw float32 C-order ``(C, L)`` waveforms,
+  concatenated. Served through a per-process ``np.memmap`` (page-cache
+  backed, zero-copy until the training-path ``.astype`` copy).
+* ``index.npz`` — columnar metadata: per-sample shard id, byte offset,
+  shape, and every Event label field (NaN = absent), loaded once into
+  the pandas frame that :class:`~seist_tpu.data.base.DatasetBase`'s
+  seeded shuffle-then-contiguous-split already operates on.
+* ``meta.json`` — source dataset name, channels, sampling rate, count.
+
+``pack_dataset`` converts ANY registered dataset (constructed with
+``data_split=False, shuffle=False`` so the pack order is the source
+metadata order); :class:`PackedDataset` (registered as ``packed``) then
+serves the identical Event dicts through the standard reader contract —
+same seed => same split as any other dataset.
+
+Label encoding: every current dataset emits 0-or-1-element lists for
+ppks/spks/emg/smg/pmp/clr/baz/dis (one event per window — ref
+datasets/*.py); the packer asserts that and stores scalar-or-NaN.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+from seist_tpu.data.base import DatasetBase, Event
+from seist_tpu.registry import register_dataset
+from seist_tpu.utils.logger import logger
+
+_INDEX = "index.npz"
+_META = "meta.json"
+
+# Event fields packed as scalar-or-NaN columns, in a fixed order.
+# ppks/spks are sample indices (int at heart, float for the NaN), the
+# rest are the label scalars the TaskSpec io catalog consumes.
+_SCALAR_FIELDS = ("ppks", "spks", "emg", "smg", "pmp", "clr", "baz", "dis")
+_INT_FIELDS = frozenset({"ppks", "spks", "pmp", "clr"})
+
+
+def pack_dataset(
+    src,
+    out_dir: str,
+    *,
+    shard_mb: float = 512,
+    log_every: int = 20_000,
+) -> str:
+    """Repack ``src`` (any DatasetBase, pre-split disabled) into packed
+    shards under ``out_dir``. Returns ``out_dir``."""
+    os.makedirs(out_dir, exist_ok=True)
+    shard_bytes_max = int(shard_mb * 1_000_000)
+    n = len(src)
+    cols: Dict[str, list] = {
+        **{f: [] for f in _SCALAR_FIELDS},
+        "snr_0": [],
+        "snr_1": [],
+        "snr_2": [],
+        "shard": [],
+        "offset": [],
+        "n_ch": [],
+        "n_samp": [],
+        "key": [],
+    }
+    shard_id = 0
+    shard_off = 0
+    shard_f = open(os.path.join(out_dir, f"shard_{shard_id:05d}.bin"), "wb")
+    try:
+        for i in range(n):
+            event, row = src[i]
+            data = np.ascontiguousarray(event["data"], dtype=np.float32)
+            if data.ndim != 2:
+                raise ValueError(f"event {i}: data must be (C, L), got {data.shape}")
+            if shard_off + data.nbytes > shard_bytes_max and shard_off:
+                shard_f.close()
+                shard_id += 1
+                shard_off = 0
+                shard_f = open(
+                    os.path.join(out_dir, f"shard_{shard_id:05d}.bin"), "wb"
+                )
+            shard_f.write(data.tobytes())
+            for f in _SCALAR_FIELDS:
+                v = event.get(f, [])
+                if len(v) > 1:
+                    raise ValueError(
+                        f"event {i}: field {f} has {len(v)} values; the "
+                        "packed format stores one event per window"
+                    )
+                cols[f].append(float(v[0]) if len(v) else np.nan)
+            snr = np.asarray(event.get("snr", []), dtype=np.float64).ravel()
+            for c in range(3):
+                cols[f"snr_{c}"].append(
+                    float(snr[c]) if c < snr.size else np.nan
+                )
+            cols["shard"].append(shard_id)
+            cols["offset"].append(shard_off)
+            cols["n_ch"].append(data.shape[0])
+            cols["n_samp"].append(data.shape[1])
+            cols["key"].append(str(row.get("key", i)) if isinstance(row, dict) else str(i))
+            shard_off += data.nbytes
+            if log_every and (i + 1) % log_every == 0:
+                logger.info(f"packed {i + 1}/{n} events ({shard_id + 1} shards)")
+    finally:
+        shard_f.close()
+
+    np.savez(
+        os.path.join(out_dir, _INDEX),
+        **{
+            k: np.asarray(
+                v,
+                dtype=(
+                    np.int64
+                    if k in ("shard", "offset", "n_ch", "n_samp")
+                    else (str if k == "key" else np.float64)
+                ),
+            )
+            for k, v in cols.items()
+        },
+    )
+    with open(os.path.join(out_dir, _META), "w") as f:
+        json.dump(
+            {
+                "source": src.name(),
+                "channels": src.channels(),
+                "sampling_rate": src.sampling_rate(),
+                "n_events": n,
+                "n_shards": shard_id + 1,
+                "format_version": 1,
+            },
+            f,
+        )
+    logger.info(f"packed {n} events into {shard_id + 1} shard(s) at {out_dir}")
+    return out_dir
+
+
+class PackedDataset(DatasetBase):
+    """Reader for :func:`pack_dataset` output (registered as ``packed``).
+
+    Same metadata/split/Event contract as every other dataset; the
+    waveform read is a single memmap slice + one ``.astype`` copy
+    instead of h5py's per-sample group walk."""
+
+    _name = "packed"
+
+    def __init__(self, **kwargs):
+        data_dir = kwargs.get("data_dir", "")
+        with open(os.path.join(data_dir, _META)) as f:
+            self._meta = json.load(f)
+        self._mmaps: Dict[int, np.memmap] = {}
+        super().__init__(**kwargs)
+
+    # Instance-level overrides of the classmethod accessors: the values
+    # come from meta.json, not the class.
+    def name(self):  # type: ignore[override]
+        return self._name
+
+    def channels(self):  # type: ignore[override]
+        return list(self._meta["channels"])
+
+    def sampling_rate(self):  # type: ignore[override]
+        return int(self._meta["sampling_rate"])
+
+    def _load_meta_data(self) -> pd.DataFrame:
+        with np.load(
+            os.path.join(self._data_dir, _INDEX), allow_pickle=False
+        ) as z:
+            frame = pd.DataFrame({k: z[k] for k in z.files})
+        if len(frame) != self._meta["n_events"]:
+            raise ValueError(
+                f"index has {len(frame)} rows, meta.json says "
+                f"{self._meta['n_events']}"
+            )
+        return self._shuffle_and_split(frame)
+
+    def _mmap(self, shard: int) -> np.memmap:
+        mm = self._mmaps.get(shard)
+        if mm is None:
+            mm = self._mmaps[shard] = np.memmap(
+                os.path.join(self._data_dir, f"shard_{shard:05d}.bin"),
+                dtype=np.uint8,
+                mode="r",
+            )
+        return mm
+
+    def _load_event_data(self, idx: int) -> Tuple[Event, dict]:
+        row = self._row_dict(idx)
+        c, length = int(row["n_ch"]), int(row["n_samp"])
+        off = int(row["offset"])
+        raw = self._mmap(int(row["shard"]))[off : off + c * length * 4]
+        data = np.frombuffer(raw, dtype=np.float32).reshape(c, length).copy()
+
+        def scalar(field):
+            v = row[field]
+            if v != v:  # NaN
+                return []
+            return [int(v)] if field in _INT_FIELDS else [np.float32(v)]
+
+        event: Event = {"data": data}
+        for f in _SCALAR_FIELDS:
+            event[f] = scalar(f)
+        event["snr"] = np.array(
+            [row["snr_0"], row["snr_1"], row["snr_2"]]
+        )
+        return event, row
+
+
+@register_dataset
+def packed(**kwargs):
+    return PackedDataset(**kwargs)

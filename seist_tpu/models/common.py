@@ -13,6 +13,7 @@ All arrays are ``(N, L, C)``. All modules take ``train: bool`` and use the
 
 from __future__ import annotations
 
+import contextlib
 import math
 import os
 from typing import Any, Callable, Optional, Sequence, Tuple
@@ -396,6 +397,34 @@ class GroupedConv1D(nn.Module):
         )
 
 
+# Cross-framework mask injection for DropPath (training-dynamics parity,
+# tools/train_dynamics.py): when active, every train-mode DropPath call
+# consumes the next row of a shared (max_calls, batch) uniform array
+# instead of drawing from the flax 'dropout' stream, in call order — the
+# torch reference's stubbed timm DropPath consumes the SAME rows in the
+# same order, so both frameworks drop identical residual paths. The rows
+# are uniforms (not thresholded masks) so each instance applies its OWN
+# keep probability. The context is read at trace time; pass the uniforms
+# as an argument of the jitted step so the compiled program threads them.
+_DROPPATH_INJECT: Optional[dict] = None
+
+
+@contextlib.contextmanager
+def droppath_mask_injection(uniforms):
+    """Route DropPath randomness to shared ``uniforms`` rows for the
+    duration of the context (trace-time). Yields the injection record;
+    after the traced/eager call its ``"i"`` holds the number of
+    DropPath calls that consumed a row."""
+    global _DROPPATH_INJECT
+    prev = _DROPPATH_INJECT
+    record = {"uniforms": uniforms, "i": 0}
+    _DROPPATH_INJECT = record
+    try:
+        yield record
+    finally:
+        _DROPPATH_INJECT = prev
+
+
 class DropPath(nn.Module):
     """Per-sample stochastic depth (timm DropPath parity, scale_by_keep)."""
 
@@ -406,9 +435,15 @@ class DropPath(nn.Module):
         if not train or self.rate <= 0.0:
             return x
         keep = 1.0 - self.rate
-        rng = self.make_rng("dropout")
         shape = (x.shape[0],) + (1,) * (x.ndim - 1)
-        mask = jax.random.bernoulli(rng, keep, shape)
+        if _DROPPATH_INJECT is not None:
+            inj = _DROPPATH_INJECT
+            u = inj["uniforms"][inj["i"]]
+            inj["i"] += 1
+            mask = (u < keep).reshape(shape)
+        else:
+            rng = self.make_rng("dropout")
+            mask = jax.random.bernoulli(rng, keep, shape)
         return jnp.where(mask, x / keep, 0.0)
 
 

@@ -1,0 +1,150 @@
+"""Packed-shard dataset (seist_tpu/data/packed.py): conversion fidelity,
+split contract, and pipeline integration.
+
+SURVEY §7's offline input-pipeline mitigation: tools/pack_dataset.py
+repacks an HDF5 dataset into contiguous binary shards + columnar index;
+the ``packed`` dataset then serves the identical Event dicts through a
+memmap slice instead of h5py's per-sample group walk (the measured ~30%
+read tax, BASELINE.md §Input pipeline).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import seist_tpu
+from seist_tpu.data.packed import PackedDataset, pack_dataset
+from seist_tpu.registry import DATASETS
+
+seist_tpu.load_all()
+
+N_EVENTS = 24
+L_TRACE = 1024
+
+
+@pytest.fixture(scope="module")
+def packed_pair(tmp_path_factory):
+    """(source diting_light dataset, packed dir) over the same fixture."""
+    from tools.fixtures import write_diting_light_fixture
+
+    src_dir = str(tmp_path_factory.mktemp("dl_src"))
+    write_diting_light_fixture(
+        src_dir, n_events=N_EVENTS, trace_samples=L_TRACE, n_parts=2
+    )
+    src = DATASETS.create(
+        "diting_light",
+        seed=0,
+        mode="train",
+        data_dir=src_dir,
+        shuffle=False,
+        data_split=False,
+    )
+    out = str(tmp_path_factory.mktemp("dl_packed"))
+    # Tiny shard budget forces multiple shards (multi-shard indexing
+    # covered, not just the single-file happy path).
+    pack_dataset(src, out, shard_mb=0.05)
+    return src, out
+
+
+def test_pack_roundtrip_events_identical(packed_pair):
+    src, out = packed_pair
+    dst = PackedDataset(
+        seed=0, mode="train", data_dir=out, shuffle=False, data_split=False
+    )
+    assert len(dst) == len(src) == N_EVENTS
+    n_shards = len(
+        [f for f in os.listdir(out) if f.startswith("shard_")]
+    )
+    assert n_shards > 1  # shard_mb=1 must have rolled over
+    for i in range(len(src)):
+        ev_s, _ = src[i]
+        ev_p, row_p = dst[i]
+        np.testing.assert_array_equal(ev_p["data"], ev_s["data"])
+        assert ev_p["data"].dtype == np.float32
+        for f in ("ppks", "spks", "emg", "smg", "pmp", "clr", "baz", "dis"):
+            got, want = ev_p[f], ev_s[f]
+            assert len(got) == len(want), (i, f, got, want)
+            if want:
+                np.testing.assert_allclose(got[0], want[0], rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(ev_p["snr"], float),
+            np.asarray(ev_s["snr"], float),
+            rtol=1e-6,
+        )
+        assert "key" in row_p  # ResultSaver metadata passthrough
+
+
+def test_packed_split_matches_source_split(packed_pair):
+    # Pack order == source metadata order, and both readers apply the
+    # SAME seeded shuffle-then-contiguous-split (data/base.py) — so for
+    # a given seed the packed train split serves the same events as the
+    # source train split, event for event.
+    src_dir = packed_pair[0]._data_dir
+    _, out = packed_pair
+    for mode in ("train", "val", "test"):
+        a = DATASETS.create(
+            "diting_light", seed=11, mode=mode, data_dir=src_dir
+        )
+        b = DATASETS.create("packed", seed=11, mode=mode, data_dir=out)
+        assert len(a) == len(b) > 0
+        ev_a, _ = a[0]
+        ev_b, _ = b[0]
+        np.testing.assert_array_equal(ev_b["data"], ev_a["data"])
+
+
+def test_packed_through_pipeline(packed_pair):
+    from seist_tpu import taskspec
+    from seist_tpu.data import pipeline
+
+    _, out = packed_pair
+    spec = taskspec.get_task_spec("seist_s_dpk")
+    ds = pipeline.from_task_spec(
+        spec,
+        "packed",
+        "train",
+        seed=0,
+        in_samples=512,
+        augmentation=True,
+        data_dir=out,
+    )
+    assert ds.sampling_rate() == 50
+    loader = pipeline.Loader(ds, batch_size=8, shuffle=True, num_workers=2)
+    try:
+        batch = next(iter(loader))
+    finally:
+        loader.close()
+    assert batch.inputs.shape == (8, 512, 3)
+    assert np.isfinite(batch.inputs).all()
+
+
+def test_pack_rejects_multi_event_windows(tmp_path):
+    class TwoPick:
+        def __init__(self):
+            self._rows = [0]
+
+        def __len__(self):
+            return 1
+
+        def __getitem__(self, i):
+            return (
+                {
+                    "data": np.zeros((3, 64), np.float32),
+                    "ppks": [1, 2],  # two picks: not representable
+                    "spks": [],
+                    "snr": np.zeros(3),
+                },
+                {"key": "k"},
+            )
+
+        def name(self):
+            return "twopick"
+
+        def channels(self):
+            return ["z", "n", "e"]
+
+        def sampling_rate(self):
+            return 50
+
+    with pytest.raises(ValueError, match="one event per window"):
+        pack_dataset(TwoPick(), str(tmp_path / "out"))

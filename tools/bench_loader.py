@@ -13,9 +13,10 @@ matters; >= 2.0 means the pipeline can feed the chip with headroom.
 
 Env knobs: BENCH_BATCH (500), BENCH_SAMPLES (8192), BENCH_BATCHES (8),
 BENCH_WORKERS (os.cpu_count), DEVICE_WFS, BENCH_DATASET
-(synthetic | diting_light — the latter writes a DiTing-light-format
-CSV+HDF5 fixture once under logs/ and measures the real h5py/pandas
-reader path end to end).
+(synthetic | diting_light | packed — diting_light writes a
+DiTing-light-format CSV+HDF5 fixture once under logs/ and measures the
+real h5py/pandas reader path end to end; packed measures the
+packed-shard repack of that same fixture, tools/pack_dataset.py).
 """
 
 from __future__ import annotations
@@ -55,6 +56,11 @@ def run() -> None:
     data_dir = ""
     if dataset_name == "synthetic":
         ds_kw = {"num_events": batch * 4}
+    elif dataset_name == "packed":
+        # Packed-shard repack of the diting_light fixture (VERDICT r4 #8).
+        from tools.fixtures import ensure_packed_fixture
+
+        data_dir = ensure_packed_fixture(max(batch * 2, 512), in_samples)
     elif dataset_name == "diting_light":
         # Real-format reader path: write the fixture once (keyed by shape)
         # and reuse it across runs.

@@ -32,7 +32,17 @@ def _install_timm_stub() -> None:
 
     class DropPath(nn.Module):
         """Stochastic depth (per-sample residual drop), the standard
-        implementation every library ships."""
+        implementation every library ships.
+
+        ``DropPath.inject`` (class attribute) mirrors seist_tpu's
+        droppath_mask_injection (models/common.py): when set to
+        ``{"uniforms": (max_calls, batch) tensor, "i": 0}``, each
+        train-mode call consumes the next row as its uniform draws —
+        identical rows in identical call order on both frameworks make
+        the dropped residual paths identical (tools/train_dynamics.py
+        dropout-on lane)."""
+
+        inject = None  # class-level: one shared stream per forward
 
         def __init__(self, drop_prob: float = 0.0):
             super().__init__()
@@ -43,7 +53,13 @@ def _install_timm_stub() -> None:
                 return x
             keep = 1.0 - self.drop_prob
             shape = (x.shape[0],) + (1,) * (x.ndim - 1)
-            mask = x.new_empty(shape).bernoulli_(keep)
+            if DropPath.inject is not None:
+                inj = DropPath.inject
+                u = inj["uniforms"][inj["i"]]
+                inj["i"] += 1
+                mask = (u < keep).to(x.dtype).view(shape)
+            else:
+                mask = x.new_empty(shape).bernoulli_(keep)
             return x * mask / keep
 
     timm = types.ModuleType("timm")

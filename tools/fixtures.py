@@ -20,6 +20,7 @@ seist_tpu/data/synthetic.py, independent of any reference code).
 from __future__ import annotations
 
 import os
+import sys
 
 import h5py
 import numpy as np
@@ -135,3 +136,42 @@ def ensure_loader_fixture(n_events: int, in_samples: int) -> str:
             file=sys.stderr,
         )
     return root
+
+
+def ensure_packed_fixture(n_events: int, in_samples: int) -> str:
+    """The packed-shard conversion of :func:`ensure_loader_fixture`'s
+    DiTing-light fixture (marker-cached): builds the HDF5 fixture, then
+    repacks it with seist_tpu.data.packed.pack_dataset. Returns the
+    packed data_dir — train on it with dataset ``packed``."""
+    import sys
+    import time
+
+    src_dir = ensure_loader_fixture(n_events, in_samples)
+    out = os.path.join(src_dir, "packed")
+    marker = os.path.join(out, ".complete")
+    if not os.path.exists(marker):
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        import seist_tpu
+        from seist_tpu.data.packed import pack_dataset
+        from seist_tpu.registry import DATASETS
+
+        seist_tpu.load_all()
+        src = DATASETS.create(
+            "diting_light",
+            seed=0,
+            mode="train",
+            data_dir=src_dir,
+            shuffle=False,
+            data_split=False,
+        )
+        t0 = time.perf_counter()
+        pack_dataset(src, out)
+        with open(marker, "w") as f:
+            f.write("ok\n")
+        print(
+            f"packed fixture written in {time.perf_counter() - t0:.1f}s: {out}",
+            file=sys.stderr,
+        )
+    return out

@@ -14,7 +14,10 @@ Prints one JSON line with ms/wf per stage and the implied serial wf/s.
 
     python tools/loader_stage_budget.py [n_samples] [batch]
 
-Env: BENCH_DATASET (diting_light | synthetic), BENCH_SAMPLES (8192).
+Env: BENCH_DATASET (diting_light | synthetic | packed), BENCH_SAMPLES (8192).
+``packed`` measures the packed-shard repack of the SAME diting_light
+fixture (tools/pack_dataset.py): the read-stage delta vs diting_light is
+the h5py per-sample API tax the offline repack removes.
 """
 
 from __future__ import annotations
@@ -47,6 +50,12 @@ def main() -> None:
     data_dir = ""
     if dataset_name == "synthetic":
         ds_kw = {"num_events": max(512, n)}
+    elif dataset_name == "packed":
+        # The packed-shard repack of the SAME fixture (VERDICT r4 #8):
+        # read-stage delta vs diting_light is the measured h5py tax.
+        from tools.fixtures import ensure_packed_fixture
+
+        data_dir = ensure_packed_fixture(max(1000, n), in_samples)
     else:
         from tools.fixtures import write_diting_light_fixture
 

@@ -13,12 +13,17 @@ trajectories:
     eval-mode forward, which runs on BN *running* stats — the only place a
     BN-momentum drift can show up)
 
-Models (--model): phasenet (plain conv/BN/softmax/CE) and seist_s_dpk
-(the flagship family: multi-path stems, grouped convs, pooled attention,
-DropPath residuals, BCE) — each with every drop rate zeroed, because
-dropout masks are framework-RNG-specific and must be excluded from a
-trajectory comparison; everything else under the reference's CyclicLR
-(train.py:343-354) is deterministic and directly comparable.
+Models (--model): phasenet (plain conv/BN/softmax/CE), seist_s_dpk (the
+flagship family: multi-path stems, grouped convs, pooled attention,
+DropPath residuals, BCE), seist_s_pmp (classification head, CE, with the
+accuracy metric), and seist_s_dpk_droppath (stochastic depth ON with the
+per-sample DropPath uniforms injected identically on both sides). The
+zero-drop lanes zero every drop rate because free-running dropout masks
+are framework-RNG-specific; the droppath lane instead shares the masks,
+closing that excluded axis (VERDICT r4 #6). Everything else under the
+reference's CyclicLR (train.py:343-354) is deterministic and directly
+comparable. Each epoch also records per-epoch val metrics through ONE
+shared numpy scorer (P/S pick F1, or accuracy for pmp).
 
 Usage (each side prints one JSON line and optionally writes it to --out):
     python tools/train_dynamics.py --side torch --out /tmp/torch.json
@@ -83,7 +88,86 @@ MODELS = {
         "labels": "det_ppk_spk",
         "ref_loss": "bce_dpk",
     },
+    # Classification lane (VERDICT r4 #6, metric half): first-motion
+    # polarity, CE over a (N, 2) softmax — the accuracy-metric dynamics.
+    # The synthetic data encodes the class as the SIGN of the P wavelet
+    # (make_data), so polarity is learnable from the waveform.
+    "seist_s_pmp": {
+        "zero_drop_kwargs": {
+            "path_drop_rate": 0.0,
+            "attn_drop_rate": 0.0,
+            "key_drop_rate": 0.0,
+            "mlp_drop_rate": 0.0,
+            "other_drop_rate": 0.0,
+        },
+        "labels": "pmp_onehot",
+        "ref_loss": "ce_pmp",
+    },
+    # Dropout-ON lane (VERDICT r4 #6): stochastic depth active, with the
+    # per-sample DropPath uniforms INJECTED identically on both sides
+    # (torch: the timm-stub's DropPath.inject; jax: models/common.py
+    # droppath_mask_injection) — the technique ring attention's
+    # dropout-parity test already uses, applied cross-framework. Element
+    # dropouts stay 0: their masks live in layout-specific activations
+    # and (for attention probs) inside the fused kernel's counter PRNG.
+    "seist_s_dpk_droppath": {
+        "factory": "seist_s_dpk",
+        "zero_drop_kwargs": {
+            "path_drop_rate": 0.2,
+            "attn_drop_rate": 0.0,
+            "key_drop_rate": 0.0,
+            "mlp_drop_rate": 0.0,
+            "other_drop_rate": 0.0,
+        },
+        "labels": "det_ppk_spk",
+        "ref_loss": "bce_dpk",
+        "inject_droppath": True,
+    },
 }
+
+# Rows available per forward for injected DropPath uniforms; each call
+# consumes one row, both sides in call order. Far above seist_s's actual
+# call count (asserted equal across sides by the test).
+MAX_DROPPATH_CALLS = 64
+
+
+def droppath_uniforms(cfg: dict, global_step: int) -> np.ndarray:
+    """The SHARED per-step uniform draws for injected DropPath — both
+    sides regenerate this exact array from the config seed."""
+    rng = np.random.default_rng([cfg["data_seed"], 777, global_step])
+    return rng.random((MAX_DROPPATH_CALLS, cfg["batch"]), dtype=np.float32)
+
+
+def class_accuracy(probs_nc, true_cls):
+    """argmax accuracy on (N, num_classes) eval-mode probabilities — the
+    shared scorer for the pmp lane (both sides run this exact code)."""
+    return round(
+        float((np.argmax(probs_nc, axis=1) == np.asarray(true_cls)).mean()), 4
+    )
+
+
+def pick_f1(probs_nlc, true_p, true_s, thresh=0.3, tol=25):
+    """P/S pick F1 on eval-mode probabilities — the ONE scorer both sides
+    run, so the metric trajectories are comparable by construction.
+    ``probs_nlc``: (N, L, 3) channels-last with (det|non, ppk, spk);
+    per trace: the argmax of a phase curve is the pick when it clears
+    ``thresh``, a hit when within ``tol`` samples of the true arrival
+    (ref utils/metrics.py's greedy match at its default tolerance)."""
+    out = {}
+    for name, ch, true in (("p", 1, true_p), ("s", 2, true_s)):
+        tp = fp = fn = 0
+        for i in range(probs_nlc.shape[0]):
+            curve = probs_nlc[i, :, ch]
+            j = int(np.argmax(curve))
+            if curve[j] < thresh:
+                fn += 1
+            elif abs(j - int(true[i])) <= tol:
+                tp += 1
+            else:
+                fp += 1
+                fn += 1
+        out[name] = round(2 * tp / max(2 * tp + fp + fn, 1), 4)
+    return out
 
 
 def make_data(cfg=CFG):
@@ -100,17 +184,32 @@ def make_data(cfg=CFG):
     x = rng.standard_normal((n, 3, L)).astype(np.float32) * 0.1
     tp = rng.integers(L // 8, L // 2, size=n)
     ts = tp + rng.integers(L // 16, L // 4, size=n)
+    labels_kind = MODELS[cfg["model"]]["labels"]
+    is_pmp = labels_kind == "pmp_onehot"
+    n_train = cfg["batch"] * cfg["steps_per_epoch"]
+    # pmp lane: the class IS the P-wavelet polarity, so accuracy is
+    # learnable from the waveform (class 1 flips the P onset sign).
+    cls = rng.integers(0, 2, size=n)
+    pol = (1.0 - 2.0 * cls) if is_pmp else np.ones(n)
     y = np.zeros((n, 3, L), np.float32)
     for i in range(n):
         env_p = np.where(t >= tp[i], np.exp(-(t - tp[i]) / (L / 8)), 0.0)
         env_s = np.where(t >= ts[i], np.exp(-(t - ts[i]) / (L / 8)), 0.0)
-        x[i] += np.sin(2 * np.pi * t / 11.0) * env_p
+        x[i] += pol[i] * np.sin(2 * np.pi * t / 11.0) * env_p
         x[i, 1:] += 1.5 * np.sin(2 * np.pi * t / 17.0) * env_s
-        y[i, 1] = np.exp(-((t - tp[i]) ** 2) / (2 * 10.0**2))
-        y[i, 2] = np.exp(-((t - ts[i]) ** 2) / (2 * 10.0**2))
+        if not is_pmp:
+            y[i, 1] = np.exp(-((t - tp[i]) ** 2) / (2 * 10.0**2))
+            y[i, 2] = np.exp(-((t - ts[i]) ** 2) / (2 * 10.0**2))
     # Per-sample std normalization (norm_mode="std", ref preprocess.py):
     x /= x.std(axis=(1, 2), keepdims=True) + 1e-12
-    if MODELS[cfg["model"]]["labels"] == "det_ppk_spk":
+    if is_pmp:
+        y = np.eye(2, dtype=np.float32)[cls]  # (n, 2) one-hot
+        return (
+            (x[:n_train], y[:n_train]),
+            (x[n_train:], y[n_train:]),
+            cls[n_train:],  # true val classes for the accuracy scorer
+        )
+    if labels_kind == "det_ppk_spk":
         # det: 1 over [tp, ts + 0.4*(ts-tp)] (the reference's coda-scaled
         # detection span; exact shape is irrelevant here — both sides
         # train on the identical bytes).
@@ -119,8 +218,11 @@ def make_data(cfg=CFG):
             y[i, 0] = ((t >= tp[i]) & (t <= end)).astype(np.float32)
     else:
         y[:, 0] = np.clip(1.0 - y[:, 1] - y[:, 2], 0.0, 1.0)
-    n_train = cfg["batch"] * cfg["steps_per_epoch"]
-    return (x[:n_train], y[:n_train]), (x[n_train:], y[n_train:])
+    return (
+        (x[:n_train], y[:n_train]),
+        (x[n_train:], y[n_train:]),
+        (tp[n_train:], ts[n_train:]),  # true val picks for the F1 scorer
+    )
 
 
 def run_torch(init_path: str, cfg=CFG) -> dict:
@@ -135,12 +237,33 @@ def run_torch(init_path: str, cfg=CFG) -> dict:
 
     spec = MODELS[cfg["model"]]
     torch.manual_seed(cfg["init_seed"])
-    model = create_model(
-        cfg["model"],
-        in_channels=3,
-        in_samples=cfg["in_samples"],
-        **spec["zero_drop_kwargs"],
-    )
+    if spec["ref_loss"] == "ce_pmp":
+        # The reference's seist_*_pmp factories hard-code their drop
+        # rates (ref seist.py:987-1000), so passing zeroed rates through
+        # create_model raises "multiple values". Build the same model
+        # directly: the factory body with the rates zeroed.
+        from functools import partial
+
+        import torch.nn as nn
+        from models.seist import HeadClassification, SeismogramTransformer_S
+
+        model = SeismogramTransformer_S(
+            in_channels=3,
+            in_samples=cfg["in_samples"],
+            output_head=partial(
+                HeadClassification,
+                out_act_layer=partial(nn.Softmax, dim=-1),
+                num_classes=2,
+            ),
+            **spec["zero_drop_kwargs"],
+        )
+    else:
+        model = create_model(
+            spec.get("factory", cfg["model"]),
+            in_channels=3,
+            in_samples=cfg["in_samples"],
+            **spec["zero_drop_kwargs"],
+        )
     # Persist the initial weights for the jax side (npz of numpy arrays).
     np.savez(
         init_path,
@@ -149,6 +272,8 @@ def run_torch(init_path: str, cfg=CFG) -> dict:
 
     if spec["ref_loss"] == "bce_dpk":
         loss_fn = BCELoss(weight=[[0.5], [1], [1]])  # ref config.py:138
+    elif spec["ref_loss"] == "ce_pmp":
+        loss_fn = CELoss(weight=[1, 1])  # ref config.py:147-148 (flat)
     else:
         loss_fn = CELoss(weight=[[1], [1], [1]])
     opt = torch.optim.Adam(model.parameters(), lr=cfg["base_lr"])
@@ -164,31 +289,62 @@ def run_torch(init_path: str, cfg=CFG) -> dict:
         cycle_momentum=False,
     )
 
-    (xt, yt), (xv, yv) = make_data(cfg)
+    is_pmp = spec["labels"] == "pmp_onehot"
+    (xt, yt), (xv, yv), val_truth = make_data(cfg)
     xt, yt = torch.from_numpy(xt), torch.from_numpy(yt)
     xv, yv = torch.from_numpy(xv), torch.from_numpy(yv)
     b = cfg["batch"]
+    inject = spec.get("inject_droppath", False)
+    StubDropPath = sys.modules["timm.models.layers"].DropPath
+    dp_calls = 0
 
     train_losses, val_losses = [], []
-    for _epoch in range(cfg["epochs"]):
+    f1_p, f1_s = [], []
+    for epoch in range(cfg["epochs"]):
         model.train()
         for s in range(cfg["steps_per_epoch"]):
             xb, yb = xt[s * b : (s + 1) * b], yt[s * b : (s + 1) * b]
+            if inject:
+                gstep = epoch * cfg["steps_per_epoch"] + s
+                StubDropPath.inject = {
+                    "uniforms": torch.from_numpy(droppath_uniforms(cfg, gstep)),
+                    "i": 0,
+                }
             opt.zero_grad()
             loss = loss_fn(model(xb), yb)
+            if inject:
+                dp_calls = StubDropPath.inject["i"]
+                StubDropPath.inject = None
             loss.backward()
             opt.step()
             sched.step()  # per optimizer step, ref train.py:115
             train_losses.append(float(loss.item()))
         model.eval()
         with torch.no_grad():
-            val_losses.append(float(loss_fn(model(xv), yv).item()))
-    return {
+            val_out = model(xv)
+            val_losses.append(float(loss_fn(val_out, yv).item()))
+        if is_pmp:
+            f1_p.append(class_accuracy(val_out.detach().numpy(), val_truth))
+        else:
+            # channels-last for the shared scorer
+            f1 = pick_f1(
+                val_out.detach().numpy().transpose(0, 2, 1), *val_truth
+            )
+            f1_p.append(f1["p"])
+            f1_s.append(f1["s"])
+    result = {
         "side": "torch",
         "train_loss_per_step": train_losses,
         "val_loss_per_epoch": val_losses,
+        "droppath_calls_per_forward": dp_calls,
         "config": cfg,
     }
+    if is_pmp:
+        result["val_acc_per_epoch"] = f1_p
+    else:
+        result["val_f1_p_per_epoch"] = f1_p
+        result["val_f1_s_per_epoch"] = f1_s
+    return result
 
 
 def run_jax(init_path: str, cfg=CFG) -> dict:
@@ -210,10 +366,11 @@ def run_jax(init_path: str, cfg=CFG) -> dict:
     from tools.parity import convert_state_dict
 
     seist_tpu.load_all()
+    mspec = MODELS[cfg["model"]]
     model = api.create_model(
-        cfg["model"],
+        mspec.get("factory", cfg["model"]),
         in_samples=cfg["in_samples"],
-        **MODELS[cfg["model"]]["zero_drop_kwargs"],
+        **mspec["zero_drop_kwargs"],
     )
     variables = api.init_variables(
         model, in_samples=cfg["in_samples"], batch_size=cfg["batch"]
@@ -231,33 +388,92 @@ def run_jax(init_path: str, cfg=CFG) -> dict:
     )
     state = create_train_state(model, variables, build_optimizer("adam", sched))
 
-    spec = taskspec.get_task_spec(cfg["model"])
-    loss_fn = taskspec.make_loss(cfg["model"])
-    train_step = jax.jit(make_train_step(spec, loss_fn))
+    task = mspec.get("factory", cfg["model"])
+    spec = taskspec.get_task_spec(task)
+    loss_fn = taskspec.make_loss(task)
+    inject = mspec.get("inject_droppath", False)
+    dp_probe = {}
+    if inject:
+        # Same semantics as make_train_step (shared _forward_loss body:
+        # BN mutation, task transforms, fp32 compute) with the per-step
+        # DropPath uniforms threaded through as a traced argument and
+        # routed to every DropPath call via the injection context
+        # (models/common.py). The rng arg is unused: element dropouts
+        # are all 0 and DropPath reads the injected rows.
+        from seist_tpu.models.common import droppath_mask_injection
+        from seist_tpu.train.precision import cast_to_float32
+        from seist_tpu.train.step import _forward_loss
+
+        def train_step_inj(state, x, y, uniforms):
+            def apply_fn(variables, inputs, **kw):
+                with droppath_mask_injection(uniforms) as rec:
+                    out = model.apply(variables, inputs, **kw)
+                dp_probe["calls"] = rec["i"]  # trace-time capture
+                return out
+
+            fwd = _forward_loss(spec, loss_fn, jnp.float32, apply_fn)
+            (loss, (_outputs, new_stats)), grads = jax.value_and_grad(
+                fwd, has_aux=True
+            )(state.params, state.batch_stats, x, y, jax.random.PRNGKey(0))
+            state = state.apply_gradients(grads=grads)
+            if new_stats is not None:
+                state = state.replace(batch_stats=cast_to_float32(new_stats))
+            return state, loss
+
+        train_step = jax.jit(train_step_inj)
+    else:
+        train_step = jax.jit(make_train_step(spec, loss_fn))
     eval_step = jax.jit(make_eval_step(spec, loss_fn))
 
-    (xt, yt), (xv, yv) = make_data(cfg)
-    # channels-last for this framework
-    xt, yt = xt.transpose(0, 2, 1), yt.transpose(0, 2, 1)
-    xv, yv = xv.transpose(0, 2, 1), yv.transpose(0, 2, 1)
+    is_pmp = mspec["labels"] == "pmp_onehot"
+    (xt, yt), (xv, yv), val_truth = make_data(cfg)
+    # channels-last for this framework (pmp labels are (N, 2) — no L axis)
+    xt, xv = xt.transpose(0, 2, 1), xv.transpose(0, 2, 1)
+    if not is_pmp:
+        yt, yv = yt.transpose(0, 2, 1), yv.transpose(0, 2, 1)
     b = cfg["batch"]
     rng = jax.random.PRNGKey(0)  # drop_rate=0: stream is never consumed
     vmask = jnp.ones((xv.shape[0],), jnp.float32)
 
     train_losses, val_losses = [], []
-    for _epoch in range(cfg["epochs"]):
+    f1_p, f1_s = [], []
+    for epoch in range(cfg["epochs"]):
         for s in range(cfg["steps_per_epoch"]):
             xb, yb = xt[s * b : (s + 1) * b], yt[s * b : (s + 1) * b]
-            state, loss, _ = train_step(state, jnp.asarray(xb), jnp.asarray(yb), rng)
+            if inject:
+                gstep = epoch * cfg["steps_per_epoch"] + s
+                state, loss = train_step(
+                    state,
+                    jnp.asarray(xb),
+                    jnp.asarray(yb),
+                    jnp.asarray(droppath_uniforms(cfg, gstep)),
+                )
+            else:
+                state, loss, _ = train_step(
+                    state, jnp.asarray(xb), jnp.asarray(yb), rng
+                )
             train_losses.append(float(loss))
-        vloss, _ = eval_step(state, jnp.asarray(xv), jnp.asarray(yv), vmask)
+        vloss, vout = eval_step(state, jnp.asarray(xv), jnp.asarray(yv), vmask)
         val_losses.append(float(vloss))
-    return {
+        if is_pmp:
+            f1_p.append(class_accuracy(np.asarray(vout), val_truth))
+        else:
+            f1 = pick_f1(np.asarray(vout), *val_truth)
+            f1_p.append(f1["p"])
+            f1_s.append(f1["s"])
+    result = {
         "side": "jax",
         "train_loss_per_step": train_losses,
         "val_loss_per_epoch": val_losses,
+        "droppath_calls_per_forward": dp_probe.get("calls", 0),
         "config": cfg,
     }
+    if is_pmp:
+        result["val_acc_per_epoch"] = f1_p
+    else:
+        result["val_f1_p_per_epoch"] = f1_p
+        result["val_f1_s_per_epoch"] = f1_s
+    return result
 
 
 def main() -> None:
