@@ -72,6 +72,31 @@ def causal_pad_1d(x: Array, kernel_size: int, dilation: int = 1) -> Array:
     return jnp.pad(x, pads)
 
 
+def channel_pad_multiple() -> int:
+    """``SEIST_CHANNEL_PAD``: round conv OUT-channel axes up to this
+    multiple in the composed/fused dense-conv lowerings (0 = off,
+    default). Candidate MFU lowering for the tiny-channel stems
+    (out_dim 8-24 vs the TPU's 128-lane registers; VERDICT r4 #2
+    escalation step 1): zero-padded out-channels compute zeros that are
+    sliced away before BN, so values and the checkpoint tree are
+    untouched — only XLA's layout/tiling choice changes. Promote or
+    revert ON THE MEASURED A/B (tools/r4_silicon.sh iso_channel_pad);
+    until then it is off everywhere."""
+    return int(os.environ.get("SEIST_CHANNEL_PAD", "0"))
+
+
+def pad_kernel_out_channels(kernel: Array) -> Tuple[Array, int]:
+    """Zero-pad a conv kernel's trailing (out-channel) axis up to the
+    SEIST_CHANNEL_PAD multiple. Returns (kernel, true_out_channels);
+    slice the conv result back to ``true_out_channels`` channels."""
+    out = kernel.shape[-1]
+    mult = channel_pad_multiple()
+    if mult <= 0 or out % mult == 0:
+        return kernel, out
+    pads = [(0, 0)] * (kernel.ndim - 1) + [(0, mult - out % mult)]
+    return jnp.pad(kernel, pads), out
+
+
 # --------------------------------------------------------------------- pooling
 def ceil_len(length: int, stride: int) -> int:
     return -(-length // stride)

@@ -169,14 +169,18 @@ class DSConvNormAct(nn.Module):
         w_d = _Kernel((self.kernel_size, 1, self.in_dim), name="dconv")()
         w_p = _Kernel((self.in_dim, self.out_dim), name="pconv")()
         kernel = _triple_product_kernel(w_in, w_d[:, 0, :], w_p).astype(x.dtype)
+        # SEIST_CHANNEL_PAD (off by default): lane-multiple out channels,
+        # zeros sliced away — values identical (common.py docstring).
+        kernel, out = common.pad_kernel_out_channels(kernel)
         xp = common.auto_pad_1d(x, self.kernel_size, self.stride)
-        return jax.lax.conv_general_dilated(
+        h = jax.lax.conv_general_dilated(
             xp,
             kernel,
             window_strides=(self.stride,),
             padding="VALID",
             dimension_numbers=("NWC", "WIO", "NWC"),
         )
+        return h[..., :out]
 
 
 class _Kernel(nn.Module):
@@ -400,13 +404,16 @@ class StemBlock(nn.Module):
             off = (K - k_i) // 2
             kern = kern.at[off : off + k_i, :, i * O : (i + 1) * O].set(a)
         xp = common.auto_pad_1d(x, K, self.stride)
+        # SEIST_CHANNEL_PAD (off by default): lane-multiple out channels,
+        # zeros sliced away — values identical (common.py docstring).
+        kern_p, out = common.pad_kernel_out_channels(kern.astype(x.dtype))
         h = jax.lax.conv_general_dilated(
             xp,
-            kern.astype(x.dtype),
+            kern_p,
             window_strides=(self.stride,),
             padding="VALID",
             dimension_numbers=("NWC", "WIO", "NWC"),
-        )
+        )[..., :out]
         return self._merged_bn_act(h, leaves, train, x.dtype)
 
 

@@ -394,3 +394,54 @@ class TestMergedStem:
             else:
                 os.environ["SEIST_STEM_IMPL"] = prev
         np.testing.assert_allclose(y_paths, y_merged, rtol=1e-5, atol=1e-5)
+
+
+class TestChannelPad:
+    """SEIST_CHANNEL_PAD (off by default) pads composed/fused dense-conv
+    out-channels to a lane multiple and slices the zeros away — values,
+    grads, and the checkpoint tree must be IDENTICAL to the unpadded
+    lowering (models/common.py pad_kernel_out_channels)."""
+
+    @pytest.mark.parametrize("mult", ["8", "128"])
+    def test_full_model_forward_identical(self, mult, monkeypatch):
+        from seist_tpu.models import api
+
+        x = jax.random.normal(jax.random.PRNGKey(2), (1, 512, 3))
+        model = api.create_model("seist_s_dpk", in_samples=512)
+        variables = model.init(jax.random.PRNGKey(0), x, False)
+        monkeypatch.setenv("SEIST_DSCONV_IMPL", "composed")
+        monkeypatch.setenv("SEIST_STEM_IMPL", "fused")
+        monkeypatch.delenv("SEIST_CHANNEL_PAD", raising=False)
+        y_base = model.apply(variables, x, False)
+        monkeypatch.setenv("SEIST_CHANNEL_PAD", mult)
+        y_pad = model.apply(variables, x, False)
+        # The padded columns are zeros, but a different backend tiling
+        # may reorder the real columns' accumulations — tight allclose,
+        # not bitwise (the whole point of the flag is to change tiling).
+        np.testing.assert_allclose(
+            np.asarray(y_base), np.asarray(y_pad), rtol=1e-6, atol=1e-7
+        )
+
+    def test_train_step_gradients_identical(self, monkeypatch):
+        from seist_tpu.models.seist import DSConvNormAct
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 3))
+        m = DSConvNormAct(16, 24, 7, 2, impl="composed")
+        variables = m.init(jax.random.PRNGKey(0), x, True)
+
+        def loss(params):
+            y, _ = m.apply(
+                {**variables, "params": params}, x, True,
+                mutable=["batch_stats"],
+            )
+            return jnp.sum(y * jnp.cos(y))
+
+        monkeypatch.delenv("SEIST_CHANNEL_PAD", raising=False)
+        g_base = jax.grad(loss)(variables["params"])
+        monkeypatch.setenv("SEIST_CHANNEL_PAD", "128")
+        g_pad = jax.grad(loss)(variables["params"])
+        fa = jax.tree_util.tree_flatten_with_path(g_base)[0]
+        fb = jax.tree_util.tree_flatten_with_path(g_pad)[0]
+        assert [p for p, _ in fa] == [p for p, _ in fb]
+        for (p, a), (_, b) in zip(fa, fb):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7, err_msg=str(p))

@@ -64,6 +64,15 @@ assert ks.get("overall") == "fused", f"fused kernel NOT used: {ks}"
 sys.exit(0)
 EOF
 
+# 1b. Channel-pad candidate (VERDICT r4 #2 escalation step 1; round 5):
+#     lane-multiple out-channels in the composed/fused dense convs,
+#     value-identical (tests/test_models.py::TestChannelPad). Runs
+#     ADJACENT to the headline it is compared against, before the long
+#     r3 sweep — the tunnel can die any minute. Promote the default
+#     only on a measured win.
+run_step iso_chanpad_128 1200 $B SEIST_CHANNEL_PAD=128 -- python bench.py
+run_step iso_chanpad_8 1200 $B SEIST_CHANNEL_PAD=8 -- python bench.py
+
 # 2. The QUICK round-3 evidence at today's HEAD (Mosaic attn check,
 #    bracketed HEAD-vs-old A/B, lowering isolation, batch scaling, eval
 #    matrix) — the two multi-hour tails (on-chip golden parity, full
