@@ -167,6 +167,16 @@ class PackedDataset(DatasetBase):
     def name(self):  # type: ignore[override]
         return self._name
 
+    def __repr__(self) -> str:
+        return (
+            f"Dataset(name:packed, source:{self._meta['source']}, "
+            f"channels:{self._meta['channels']}, "
+            f"sampling_rate:{self._meta['sampling_rate']}, "
+            f"n_events:{self._meta['n_events']}, "
+            f"n_shards:{self._meta['n_shards']}, "
+            f"data_dir:{self._data_dir}, mode:{self._mode})"
+        )
+
     def channels(self):  # type: ignore[override]
         return list(self._meta["channels"])
 

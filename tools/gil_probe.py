@@ -70,28 +70,23 @@ def main() -> None:
     import seist_tpu
     from seist_tpu import taskspec
     from seist_tpu.data import pipeline
-    from tools.fixtures import write_diting_light_fixture
+    from tools.fixtures import ensure_loader_fixture, ensure_packed_fixture
 
     seist_tpu.load_all()
-    n_events = 1000
-    data_dir = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        os.pardir,
-        "logs",
-        f"loader_fixture_{n_events}x{in_samples}",
-    )
-    marker = os.path.join(data_dir, ".complete")
-    if not os.path.exists(marker):
-        write_diting_light_fixture(
-            data_dir, n_events=n_events, trace_samples=in_samples
-        )
-        with open(marker, "w") as f:
-            f.write("ok\n")
+    # BENCH_DATASET: diting_light (default) or packed — the packed-shard
+    # repack of the same fixture (GIL profile of the memmap read path).
+    dataset_name = os.environ.get("BENCH_DATASET", "diting_light")
+    if dataset_name == "packed":
+        data_dir = ensure_packed_fixture(1000, in_samples)
+    elif dataset_name == "diting_light":
+        data_dir = ensure_loader_fixture(1000, in_samples)
+    else:
+        raise SystemExit(f"unknown BENCH_DATASET {dataset_name!r}")
 
     spec = taskspec.get_task_spec("seist_l_dpk")
     ds = pipeline.from_task_spec(
         spec,
-        "diting_light",
+        dataset_name,
         "train",
         seed=0,
         in_samples=in_samples,
@@ -156,6 +151,7 @@ def main() -> None:
                 "metric": "loader_gil_held_fraction",
                 "value": round(held, 3),
                 "unit": "fraction (calibrated)",
+                "dataset": dataset_name,
                 "probe_idle_rate": round(idle_rate),
                 "probe_loaded_rate": round(loaded_rate),
                 "probe_gil_bound_control_rate": round(control_rate),
