@@ -316,3 +316,54 @@ def test_vs_baseline_rejects_mismatched_length(bench, tmp_path, monkeypatch):
     # be compared against a 512-sample run.
     assert bench._vs_baseline(100.0, "m", 8192) == 10.0
     assert bench._vs_baseline(100.0, "m", 512) == 0.0
+
+
+def test_ab_summary_parses_runner_log(tmp_path):
+    # tools/ab_summary.py: the promote-or-revert view of a silicon log.
+    sys_path_hack = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    import sys
+
+    if sys_path_hack not in sys.path:
+        sys.path.insert(0, sys_path_hack)
+    from tools.ab_summary import summarize
+
+    log = tmp_path / "ab.log"
+    log.write_text(
+        "r4_silicon start 2026-08-01T10:00:00Z HEAD=abc\n"
+        "=== headline 2026-08-01T10:00:01Z\n"
+        '{"metric": "m", "value": 3100.5, "unit": "wf/s", '
+        '"kernel_status": {"overall": "fused"}, "batch": 512}\n'
+        "STATUS ok headline\n"
+        "STATUS skip iso_y\n"
+        "=== iso_x 2026-08-01T10:08:21Z\n"
+        '{"metric": "m", "value": 10.0, "unit": "wf/s", "cached": true, '
+        '"degraded": true}\n'
+        "STATUS fail iso_x rc=3\n"
+        "=== matrix 2026-08-01T10:10:21Z\n"
+        '{"metric": "a", "value": 1.0, "unit": "wf/s"}\n'
+        '{"metric": "b", "value": 2.0, "unit": "wf/s"}\n'
+        "STATUS ok matrix\n"
+        "R4 ALL DONE 2026-08-01T10:30:00Z\n"
+        # A later append-mode run must not inherit durations from run 1.
+        "r4_silicon start 2026-08-02T09:00:00Z HEAD=def\n"
+        "=== headline 2026-08-02T09:00:05Z\n"
+        "STATUS ok headline\n"
+    )
+    rows = summarize(str(log))
+    assert [r["tag"] for r in rows] == [
+        "headline", "iso_y", "iso_x", "matrix", "headline"
+    ]
+    head, skip, iso, matrix, head2 = rows
+    assert head["status"] == "ok" and head["value"] == 3100.5
+    assert head["kernel"] == "fused" and head["seconds"] == 500
+    # Skipped steps are VISIBLE (distinguishable from never-reached).
+    assert skip["status"] == "skip" and skip["value"] is None
+    assert iso["status"] == "fail"
+    assert iso["cached"] is True and iso["degraded"] is True
+    # Multi-JSON (matrix) sections surface the count, show the last.
+    assert matrix["json_count"] == 2 and matrix["value"] == 2.0
+    # Duration bounded by the ALL DONE boundary, not the next run.
+    assert matrix["seconds"] == (30 - 10) * 60 - 21
+    # Final step of the log: no end marker -> honest blank, never the
+    # next day's run.
+    assert head2["seconds"] is None

@@ -1,0 +1,166 @@
+"""Summarize a silicon-runner log (tools/ab_r4.log) into one table.
+
+The runner (tools/r4_silicon.sh / r3_silicon.sh) appends per-step
+sections delimited by ``=== <tag> <iso-time>`` and terminated by
+``STATUS ok|fail|skip <tag>``; bench steps print their one-line JSONs
+into the same log (matrix steps print SEVERAL — the row notes the count
+and shows the last). This tool recovers, per step: status, wall
+seconds (bounded by the next section OR a run boundary line, so an
+append-mode log with multiple runs never bleeds durations across runs),
+and the bench metric/value/kernel-status — the promote-or-revert view
+of the A/B evidence without scrolling a multi-MB log.
+
+    python tools/ab_summary.py [tools/ab_r4.log]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+
+_ISO = r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z"
+_SECTION = re.compile(rf"^=== (\S+) ({_ISO})$")
+_STATUS = re.compile(r"^STATUS (ok|fail|skip) (\S+)(?: rc=(\d+))?$")
+# Run boundaries the runners write outside any section: "r4_silicon
+# start <ts>", "ALL DONE <ts>", "R4 ALL DONE <ts>", "REFRESH DONE <ts>".
+_BOUNDARY = re.compile(rf"^.*(?:\bstart\b|\bDONE\b).* ({_ISO})$")
+
+
+def _parse_ts(stamp: str) -> float:
+    import calendar
+
+    return calendar.timegm(time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ"))
+
+
+def summarize(path: str):
+    """Returns [{tag, status, seconds, value, unit, cached, degraded,
+    kernel, device, json_count}] in log order. Skipped steps (R3_SKIP)
+    appear as rows with status 'skip' so 'deliberately skipped' is
+    distinguishable from 'never reached before the tunnel died'."""
+    steps = []
+    current = None
+    for raw in open(path, errors="replace"):
+        line = raw.rstrip("\n")
+        m = _SECTION.match(line)
+        if m:
+            current = {
+                "tag": m.group(1),
+                "start": _parse_ts(m.group(2)),
+                "end": None,
+                "status": "running",
+                "jsons": [],
+            }
+            steps.append(current)
+            continue
+        m = _STATUS.match(line)
+        if m:
+            if m.group(1) == "skip":
+                # Written WITHOUT a section header; standalone row.
+                steps.append(
+                    {
+                        "tag": m.group(2),
+                        "start": None,
+                        "end": None,
+                        "status": "skip",
+                        "jsons": [],
+                    }
+                )
+            elif current is not None and m.group(2) == current["tag"]:
+                current["status"] = m.group(1)
+            continue
+        m = _BOUNDARY.match(line)
+        if m and not line.startswith("{"):
+            # Run boundary: terminates the open section's duration so a
+            # later append-mode run cannot bleed into it.
+            if current is not None and current["end"] is None:
+                current["end"] = _parse_ts(m.group(1))
+            current = None
+            continue
+        if (
+            current is not None
+            and line.startswith("{")
+            and '"metric"' in line
+        ):
+            try:
+                current["jsons"].append(json.loads(line))
+            except ValueError:
+                pass
+    # Close each section at the next section's start when no boundary did.
+    timed = [s for s in steps if s["start"] is not None]
+    for i, s in enumerate(timed):
+        if s["end"] is None and i + 1 < len(timed):
+            s["end"] = timed[i + 1]["start"]
+    out = []
+    for s in steps:
+        j = s["jsons"][-1] if s["jsons"] else {}
+        ks = j.get("kernel_status")
+        out.append(
+            {
+                "tag": s["tag"],
+                "status": s["status"],
+                "seconds": (
+                    round(s["end"] - s["start"])
+                    if s["start"] is not None and s["end"] is not None
+                    else None
+                ),
+                "metric": j.get("metric"),
+                "value": j.get("value"),
+                "unit": j.get("unit"),
+                "cached": j.get("cached", False),
+                "degraded": j.get("degraded", False),
+                "kernel": ks.get("overall") if isinstance(ks, dict) else ks,
+                "device": j.get("device"),
+                "json_count": len(s["jsons"]),
+                "config": {
+                    k: j[k]
+                    for k in ("batch", "dtype", "steps_per_call")
+                    if k in j
+                },
+            }
+        )
+    return out
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "ab_r4.log"
+    )
+    if not os.path.exists(path):
+        raise SystemExit(f"no log at {path}")
+    rows = summarize(path)
+    if not rows:
+        print("no runner sections found")
+        return
+    w = max(len(r["tag"]) for r in rows) + 1
+    for r in rows:
+        val = (
+            f"{r['value']:>10.1f} {r['unit'] or '':<18}"
+            if r["value"] is not None
+            else " " * 29
+        )
+        flags = "".join(
+            [
+                "C" if r["cached"] else "-",
+                "D" if r["degraded"] else "-",
+            ]
+        )
+        more = (
+            f" (last of {r['json_count']} JSONs)"
+            if r["json_count"] > 1
+            else ""
+        )
+        kern = r["kernel"] or ""
+        secs = f"{r['seconds']}s" if r["seconds"] is not None else ""
+        print(
+            f"{r['tag']:<{w}} {r['status']:<8} {secs:>7} {val} "
+            f"{flags} {kern} {r['config'] or ''}{more}"
+        )
+    print("\nflags: C=cached replay (NOT a fresh measurement), D=degraded"
+          " (einsum fallback on TPU)")
+
+
+if __name__ == "__main__":
+    main()
