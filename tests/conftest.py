@@ -66,3 +66,36 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if os.path.basename(str(item.fspath)) in _SMOKE_FILES:
             item.add_marker(pytest.mark.smoke)
+
+
+def make_packed_dir(tmp_path_factory, n_events=24, trace_samples=1024,
+                    n_parts=2, shard_mb=512):
+    """Shared recipe: write a DiTing-light fixture, repack it with
+    pack_dataset. Returns (source_dataset, packed_dir). Used by
+    tests/test_packed.py and the packed worker-e2e lane."""
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.fixtures import write_diting_light_fixture
+
+    from seist_tpu.data.packed import pack_dataset
+    from seist_tpu.registry import DATASETS
+
+    src_dir = str(tmp_path_factory.mktemp("packed_src"))
+    write_diting_light_fixture(
+        src_dir, n_events=n_events, trace_samples=trace_samples,
+        n_parts=n_parts,
+    )
+    src = DATASETS.create(
+        "diting_light",
+        seed=0,
+        mode="train",
+        data_dir=src_dir,
+        shuffle=False,
+        data_split=False,
+    )
+    out = str(tmp_path_factory.mktemp("packed_out"))
+    pack_dataset(src, out, shard_mb=shard_mb)
+    return src, out

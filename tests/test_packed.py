@@ -25,26 +25,17 @@ L_TRACE = 1024
 
 @pytest.fixture(scope="module")
 def packed_pair(tmp_path_factory):
-    """(source diting_light dataset, packed dir) over the same fixture."""
-    from tools.fixtures import write_diting_light_fixture
+    """(source diting_light dataset, packed dir) over the same fixture.
+    Tiny shard budget forces multiple shards (multi-shard indexing
+    covered, not just the single-file happy path)."""
+    from tests.conftest import make_packed_dir
 
-    src_dir = str(tmp_path_factory.mktemp("dl_src"))
-    write_diting_light_fixture(
-        src_dir, n_events=N_EVENTS, trace_samples=L_TRACE, n_parts=2
+    return make_packed_dir(
+        tmp_path_factory,
+        n_events=N_EVENTS,
+        trace_samples=L_TRACE,
+        shard_mb=0.05,
     )
-    src = DATASETS.create(
-        "diting_light",
-        seed=0,
-        mode="train",
-        data_dir=src_dir,
-        shuffle=False,
-        data_split=False,
-    )
-    out = str(tmp_path_factory.mktemp("dl_packed"))
-    # Tiny shard budget forces multiple shards (multi-shard indexing
-    # covered, not just the single-file happy path).
-    pack_dataset(src, out, shard_mb=0.05)
-    return src, out
 
 
 def test_pack_roundtrip_events_identical(packed_pair):

@@ -202,3 +202,30 @@ def test_train_with_grad_accum(tmp_path):
     args.checkpoint = ckpt
     loss = test_worker(args)
     assert np.isfinite(loss)
+
+
+def test_train_then_test_on_packed_dataset(tmp_path_factory):
+    """The packed-shard dataset through the FULL worker path (train ->
+    checkpoint -> test -> metrics), the integration a reference user
+    hits with `--dataset-name packed` (docs/MIGRATING.md)."""
+    from tests.conftest import make_packed_dir
+
+    from seist_tpu.train.worker import test_worker, train_worker
+
+    _, packed_dir = make_packed_dir(
+        tmp_path_factory, n_events=40, trace_samples=4096, n_parts=1
+    )
+
+    logdir = str(tmp_path_factory.mktemp("e2e_packed_logs"))
+    logger.set_logdir(logdir)
+    args = make_args(
+        dataset_name="packed", data=packed_dir, dataset_kwargs={}
+    )
+    ckpt = train_worker(args)
+    assert ckpt and os.path.exists(ckpt)
+    args.checkpoint = ckpt
+    loss = test_worker(args)
+    assert np.isfinite(loss)
+    assert os.path.exists(
+        os.path.join(logdir, "test_metrics_packed.json")
+    )
