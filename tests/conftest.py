@@ -59,6 +59,7 @@ _SMOKE_FILES = {
     "test_supervise.py",
     "test_native.py",
     "test_bench_unit.py",
+    "test_packed.py",
 }
 
 
