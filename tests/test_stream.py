@@ -192,3 +192,44 @@ class TestDetChannelSemantics:
         )
         assert wrong["det"].shape[0] >= 1
         assert tuple(wrong["det"][0]) != (20, 29)
+
+
+@pytest.mark.slow  # real-model compile (~1-2 min on 1 core)
+def test_annotate_with_real_eqtransformer():
+    """The continuous-record path serves the EQTransformer family too:
+    its output contract is the same (N, L, 3) (det, ppk, spk)
+    probability stack as the seist dpk family (ref eqtransformer.py's 3
+    decoders), so `BENCH_MODE=stream BENCH_MODEL=eqtransformer` and
+    tools/predict.py work unchanged."""
+    import jax
+
+    import seist_tpu
+    from seist_tpu import taskspec
+    from seist_tpu.models import api
+
+    seist_tpu.load_all()
+    window, fs = 512, 100
+    spec = taskspec.get_task_spec("eqtransformer")
+    assert spec.labels[0][0] == "det"
+    model = api.create_model("eqtransformer", in_samples=window)
+    variables = api.init_variables(model, in_samples=window, batch_size=4)
+
+    def apply_fn(x):
+        return model.apply(variables, x, train=False)
+
+    rng = np.random.default_rng(0)
+    record = rng.standard_normal((30 * fs, 3)).astype(np.float32)
+    out = annotate(
+        apply_fn,
+        record,
+        window=window,
+        stride=window // 2,
+        batch_size=4,
+        sampling_rate=fs,
+        channel0="det",
+    )
+    # Untrained net: no pick-quality claim, just the full contract —
+    # finite prob curves over the whole record and well-formed outputs.
+    assert out["prob"].shape[0] == record.shape[0]
+    assert np.isfinite(out["prob"]).all()
+    assert 0 <= out["ppk"].size and 0 <= out["spk"].size
