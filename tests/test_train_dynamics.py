@@ -76,6 +76,7 @@ def _run_side(side: str, model: str, tmp: str) -> dict:
         "seist_s_dpk",
         "seist_s_dpk_droppath",
         "seist_s_pmp",
+        "eqtransformer",
     ],
 )
 def trajectories(request, tmp_path_factory):
@@ -93,6 +94,11 @@ _TOL = {
     "seist_s_dpk": (1e-3, 5e-3, 5e-3),
     "seist_s_dpk_droppath": (1e-3, 5e-3, 5e-3),
     "seist_s_pmp": (5e-3, 1.5e-1, 5e-2),
+    # scan-BiLSTM recurrence accumulates fp drift ~20x faster than the
+    # pure-conv lanes (measured 2026-08-01: first-quarter 1.1e-4, full
+    # 2.0e-3, val 2.8e-3); its band keeps the file's ~10x-over-measured
+    # margin so host/XLA variation cannot flake the slow lane.
+    "eqtransformer": (1e-3, 2e-2, 3e-2),
 }
 
 
