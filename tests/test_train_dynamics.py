@@ -77,6 +77,7 @@ def _run_side(side: str, model: str, tmp: str) -> dict:
         "seist_s_dpk_droppath",
         "seist_s_pmp",
         "eqtransformer",
+        "magnet",
     ],
 )
 def trajectories(request, tmp_path_factory):
@@ -99,6 +100,12 @@ _TOL = {
     # 2.0e-3, val 2.8e-3); its band keeps the file's ~10x-over-measured
     # margin so host/XLA variation cannot flake the slow lane.
     "eqtransformer": (1e-3, 2e-2, 3e-2),
+    # MagNet's sum-reduced scalar objective feels Adam's sign-flips at
+    # near-zero gradient coordinates immediately (init grads agree to
+    # 1.2e-6 — see the MODELS['magnet'] comment in the harness);
+    # measured at the lane's max_lr=3e-4: first-quarter 8.9e-3, full
+    # 6.6e-2, val 6.1e-2. Band ~5x over measured.
+    "magnet": (5e-2, 3e-1, 3e-1),
 }
 
 
@@ -159,21 +166,27 @@ def test_val_metric_trajectory_matches(trajectories):
     # phasenet lane here and tests/test_worker_e2e.py's learning
     # regression.
     torch_run, jax_run = trajectories
-    keys = (
-        ("val_acc_per_epoch",)
-        if "val_acc_per_epoch" in torch_run
-        else ("val_f1_p_per_epoch", "val_f1_s_per_epoch")
-    )
+    if "val_acc_per_epoch" in torch_run:
+        keys = ("val_acc_per_epoch",)
+    elif "val_mae_per_epoch" in torch_run:
+        # MAE in magnitude units on the volatile magnet lane (measured
+        # max per-epoch diff 0.026): wider band than the [0,1] scores.
+        keys = ("val_mae_per_epoch",)
+    else:
+        keys = ("val_f1_p_per_epoch", "val_f1_s_per_epoch")
+    metric_tol = 0.1 if keys == ("val_mae_per_epoch",) else 0.05
     for key in keys:
         t = np.asarray(torch_run[key])
         j = np.asarray(jax_run[key])
         assert t.shape == j.shape and t.size >= 4
         diff = np.abs(j - t)
-        assert diff.max() <= 0.05, (
+        assert diff.max() <= metric_tol, (
             f"{key} trajectories diverge: {diff.max():.3f} (torch {t}, jax {j})"
         )
         # End-metric agreement (the r3 ask's second half).
-        assert diff[-1] <= 0.05, f"end {key}: torch {t[-1]} vs jax {j[-1]}"
+        assert diff[-1] <= metric_tol, (
+            f"end {key}: torch {t[-1]} vs jax {j[-1]}"
+        )
     # The phasenet lane must actually move the metric (non-vacuous check
     # that the scorer sees learning; measured: P-F1 0.03 -> 0.47).
     if torch_run["config"]["model"] == "phasenet":
