@@ -864,7 +864,7 @@ def _warn_stale_watcher_queues() -> None:
     import glob
     import re
 
-    terminal_re = re.compile(r"ALL DONE|REFRESH DONE|DONE \(")
+    terminal_re = re.compile(r"ALL DONE|REFRESH DONE|DONE \(|ABANDONED")
     for path in glob.glob(os.path.join(_REPO, "tools", "ab_*.log")):
         try:
             # A watcher mid-run legitimately has no terminal marker yet —
