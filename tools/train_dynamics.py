@@ -18,6 +18,8 @@ flagship family: multi-path stems, grouped convs, pooled attention,
 DropPath residuals, BCE), eqtransformer (scan-BiLSTM + banded additive
 attention — the recurrent dynamics), magnet (conv+BiLSTM regression
 under the sum-reduced MousaviLoss, with the val-MAE metric),
+ditingmotion ((z, dz) input into dual softmax heads under
+CombinationLoss of two FocalLosses — the multi-head focal family),
 seist_s_pmp (classification head, CE, with the accuracy metric), and
 seist_s_dpk_droppath (stochastic depth ON with the per-sample DropPath
 uniforms injected identically on both sides). The
@@ -26,8 +28,8 @@ are framework-RNG-specific; the droppath lane instead shares the masks,
 closing that excluded axis (VERDICT r4 #6). Everything else under the
 reference's CyclicLR (train.py:343-354) is deterministic and directly
 comparable. Each epoch also records per-epoch val metrics through ONE
-shared numpy scorer (P/S pick F1; accuracy for pmp; magnitude-head MAE
-for the magnet regression lane).
+shared numpy scorer (P/S pick F1; accuracy for pmp and the motion
+polarity head; magnitude-head MAE for the magnet regression lane).
 
 Usage (each side prints one JSON line and optionally writes it to --out):
     python tools/train_dynamics.py --side torch --out /tmp/torch.json
@@ -100,6 +102,18 @@ MODELS = {
         "zero_drop_kwargs": {"drop_rate": 0.0},
         "labels": "det_ppk_spk",
         "ref_loss": "bce_dpk",
+    },
+    # Multi-head focal lane: DiTingMotion — (z, dz) 2-channel input into
+    # two softmax heads (clarity, polarity) under CombinationLoss of two
+    # FocalLosses (ref config.py:127-135) — the last loss family. The
+    # polarity class is the P-wavelet sign (learnable); clarity is an
+    # independent random class (no signal by construction — its loss
+    # floors, which both sides must agree on too).
+    "ditingmotion": {
+        "zero_drop_kwargs": {"drop_rate": 0.0},
+        "labels": "clr_pmp_onehot",
+        "ref_loss": "focal_combo",
+        "in_channels": 2,
     },
     # Regression lane: MagNet — conv+BiLSTM into (mag, log-var) under the
     # sum-reduced MousaviLoss (ref loss.py:193-210), the remaining loss
@@ -244,6 +258,7 @@ def make_data(cfg=CFG):
     labels_kind = MODELS[cfg["model"]]["labels"]
     is_pmp = labels_kind == "pmp_onehot"
     is_emg = labels_kind == "emg_value"
+    is_motion = labels_kind == "clr_pmp_onehot"
     n_train = cfg["batch"] * cfg["steps_per_epoch"]
     # pmp lane: the class IS the P-wavelet polarity, so accuracy is
     # learnable from the waveform (class 1 flips the P onset sign).
@@ -254,7 +269,8 @@ def make_data(cfg=CFG):
     # byte-stability check in this file's history).
     cls = rng.integers(0, 2, size=n)
     amp = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
-    pol = (1.0 - 2.0 * cls) if is_pmp else np.ones(n)
+    clr = rng.integers(0, 2, size=n)  # motion lane only; drawn last
+    pol = (1.0 - 2.0 * cls) if (is_pmp or is_motion) else np.ones(n)
     scale = amp if is_emg else np.ones(n, np.float32)
     y = np.zeros((n, 3, L), np.float32)
     for i in range(n):
@@ -262,11 +278,25 @@ def make_data(cfg=CFG):
         env_s = np.where(t >= ts[i], np.exp(-(t - ts[i]) / (L / 8)), 0.0)
         x[i] += scale[i] * pol[i] * np.sin(2 * np.pi * t / 11.0) * env_p
         x[i, 1:] += 1.5 * np.sin(2 * np.pi * t / 17.0) * env_s
-        if not (is_pmp or is_emg):
+        if not (is_pmp or is_emg or is_motion):
             y[i, 1] = np.exp(-((t - tp[i]) ** 2) / (2 * 10.0**2))
             y[i, 2] = np.exp(-((t - ts[i]) ** 2) / (2 * 10.0**2))
     # Per-sample std normalization (norm_mode="std", ref preprocess.py):
     x /= x.std(axis=(1, 2), keepdims=True) + 1e-12
+    if is_motion:
+        # (z, dz): the vertical component and its sample derivative —
+        # DiTingMotion's 2-channel input contract (ref config.py:129).
+        z = x[:, 0]
+        dz = np.gradient(z, axis=-1).astype(np.float32)
+        x = np.stack([z, dz], axis=1)  # (n, 2, L)
+        # y: (n, 2 heads, 2 classes) — [clarity, polarity] one-hots.
+        eye = np.eye(2, dtype=np.float32)
+        y = np.stack([eye[clr], eye[cls]], axis=1)
+        return (
+            (x[:n_train], y[:n_train]),
+            (x[n_train:], y[n_train:]),
+            cls[n_train:],  # true val polarity for the accuracy scorer
+        )
     if is_pmp:
         y = np.eye(2, dtype=np.float32)[cls]  # (n, 2) one-hot
         return (
@@ -333,7 +363,7 @@ def run_torch(init_path: str, cfg=CFG) -> dict:
     else:
         model = create_model(
             spec.get("factory", cfg["model"]),
-            in_channels=3,
+            in_channels=spec.get("in_channels", 3),
             in_samples=cfg["in_samples"],
             **spec["zero_drop_kwargs"],
         )
@@ -351,6 +381,10 @@ def run_torch(init_path: str, cfg=CFG) -> dict:
         from models.loss import MousaviLoss  # ref loss.py:193-210
 
         loss_fn = MousaviLoss()
+    elif spec["ref_loss"] == "focal_combo":
+        from models.loss import CombinationLoss, FocalLoss  # ref config.py:128
+
+        loss_fn = CombinationLoss(losses=[FocalLoss, FocalLoss])
     else:
         loss_fn = CELoss(weight=[[1], [1], [1]])
     opt = torch.optim.Adam(model.parameters(), lr=cfg["base_lr"])
@@ -368,10 +402,16 @@ def run_torch(init_path: str, cfg=CFG) -> dict:
 
     is_pmp = spec["labels"] == "pmp_onehot"
     is_emg = spec["labels"] == "emg_value"
+    is_motion = spec["labels"] == "clr_pmp_onehot"
     (xt, yt), (xv, yv), val_truth = make_data(cfg)
     xt, yt = torch.from_numpy(xt), torch.from_numpy(yt)
     xv, yv = torch.from_numpy(xv), torch.from_numpy(yv)
     b = cfg["batch"]
+
+    def to_targets(yb):
+        # motion: per-head list [clarity, polarity] (ref CombinationLoss)
+        return [yb[:, 0], yb[:, 1]] if is_motion else yb
+
     inject = spec.get("inject_droppath", False)
     StubDropPath = sys.modules["timm.models.layers"].DropPath
     dp_calls = 0
@@ -389,7 +429,7 @@ def run_torch(init_path: str, cfg=CFG) -> dict:
                     "i": 0,
                 }
             opt.zero_grad()
-            loss = loss_fn(model(xb), yb)
+            loss = loss_fn(model(xb), to_targets(yb))
             if inject:
                 dp_calls = StubDropPath.inject["i"]
                 StubDropPath.inject = None
@@ -400,9 +440,14 @@ def run_torch(init_path: str, cfg=CFG) -> dict:
         model.eval()
         with torch.no_grad():
             val_out = model(xv)
-            val_losses.append(float(loss_fn(val_out, yv).item()))
+            val_losses.append(float(loss_fn(val_out, to_targets(yv)).item()))
         if is_pmp:
             f1_p.append(class_accuracy(val_out.detach().numpy(), val_truth))
+        elif is_motion:
+            # polarity head (index 1 of [clarity, polarity])
+            f1_p.append(
+                class_accuracy(val_out[1].detach().numpy(), val_truth)
+            )
         elif is_emg:
             f1_p.append(value_mae(val_out.detach().numpy(), val_truth))
         else:
@@ -419,7 +464,7 @@ def run_torch(init_path: str, cfg=CFG) -> dict:
         "droppath_calls_per_forward": dp_calls,
         "config": cfg,
     }
-    if is_pmp:
+    if is_pmp or is_motion:
         result["val_acc_per_epoch"] = f1_p
     elif is_emg:
         result["val_mae_per_epoch"] = f1_p
@@ -452,11 +497,15 @@ def run_jax(init_path: str, cfg=CFG) -> dict:
     mspec = MODELS[cfg["model"]]
     model = api.create_model(
         mspec.get("factory", cfg["model"]),
+        in_channels=mspec.get("in_channels", 3),
         in_samples=cfg["in_samples"],
         **mspec["zero_drop_kwargs"],
     )
     variables = api.init_variables(
-        model, in_samples=cfg["in_samples"], batch_size=cfg["batch"]
+        model,
+        in_samples=cfg["in_samples"],
+        in_channels=mspec.get("in_channels", 3),
+        batch_size=cfg["batch"],
     )
     sd = dict(np.load(init_path))
     variables = convert_state_dict(sd, variables)
@@ -510,13 +559,22 @@ def run_jax(init_path: str, cfg=CFG) -> dict:
 
     is_pmp = mspec["labels"] == "pmp_onehot"
     is_emg = mspec["labels"] == "emg_value"
+    is_motion = mspec["labels"] == "clr_pmp_onehot"
     (xt, yt), (xv, yv), val_truth = make_data(cfg)
-    # channels-last for this framework (pmp (N,2) / emg (N,1) labels
-    # have no L axis)
+    # channels-last for this framework (pmp (N,2) / emg (N,1) / motion
+    # (N,2,2) labels have no L axis)
     xt, xv = xt.transpose(0, 2, 1), xv.transpose(0, 2, 1)
-    if not (is_pmp or is_emg):
+    if not (is_pmp or is_emg or is_motion):
         yt, yv = yt.transpose(0, 2, 1), yv.transpose(0, 2, 1)
     b = cfg["batch"]
+
+    def to_targets(yb):
+        # motion: per-head tuple (clarity, polarity) — a jax pytree the
+        # jitted step threads like any other target structure.
+        if is_motion:
+            a = jnp.asarray(yb)
+            return (a[:, 0], a[:, 1])
+        return jnp.asarray(yb)
     rng = jax.random.PRNGKey(0)  # drop_rate=0: stream is never consumed
     vmask = jnp.ones((xv.shape[0],), jnp.float32)
 
@@ -535,13 +593,16 @@ def run_jax(init_path: str, cfg=CFG) -> dict:
                 )
             else:
                 state, loss, _ = train_step(
-                    state, jnp.asarray(xb), jnp.asarray(yb), rng
+                    state, jnp.asarray(xb), to_targets(yb), rng
                 )
             train_losses.append(float(loss))
-        vloss, vout = eval_step(state, jnp.asarray(xv), jnp.asarray(yv), vmask)
+        vloss, vout = eval_step(state, jnp.asarray(xv), to_targets(yv), vmask)
         val_losses.append(float(vloss))
         if is_pmp:
             f1_p.append(class_accuracy(np.asarray(vout), val_truth))
+        elif is_motion:
+            # polarity head (index 1 of (clarity, polarity))
+            f1_p.append(class_accuracy(np.asarray(vout[1]), val_truth))
         elif is_emg:
             f1_p.append(value_mae(np.asarray(vout), val_truth))
         else:
@@ -555,7 +616,7 @@ def run_jax(init_path: str, cfg=CFG) -> dict:
         "droppath_calls_per_forward": dp_probe.get("calls", 0),
         "config": cfg,
     }
-    if is_pmp:
+    if is_pmp or is_motion:
         result["val_acc_per_epoch"] = f1_p
     elif is_emg:
         result["val_mae_per_epoch"] = f1_p
