@@ -47,14 +47,21 @@ from jax import lax
 _log = logging.getLogger("seist_tpu.pallas_attention")
 
 
-def _wrap_i32(v: int) -> jnp.ndarray:
-    """Python int -> int32 constant with explicit two's-complement wrap.
+def _wrap_i32(v: int) -> np.int32:
+    """Python int -> int32 scalar with explicit two's-complement wrap.
 
     ``jnp.int32(big)`` raises under numpy>=2; the counter math here wraps
     mod 2^32 by design (long-context L*M can exceed 2^31 — the hash mixes
     the wrapped bits the same way on every path).
+
+    Returns a NUMPY scalar, not a jnp array: numpy scalars trace as inline
+    jaxpr literals, while jnp arrays become captured constants — which
+    Mosaic's pallas_call rejects outright ("captures constants ... pass
+    them as inputs", observed live on TPU 2026-08-02). The arithmetic is
+    identical either way, so the kernel, the interpreter, and the XLA
+    einsum fallback keep bit-identical mask math.
     """
-    return jnp.int32(np.uint32(int(v) & 0xFFFFFFFF).astype(np.int32))
+    return np.int32(np.uint32(int(v) & 0xFFFFFFFF))
 
 
 def _mix_to_uniform(x, seed) -> jnp.ndarray:
@@ -65,10 +72,12 @@ def _mix_to_uniform(x, seed) -> jnp.ndarray:
     and shifts are explicit logical shifts.
     """
 
-    def c(u):  # uint32 constant as wrapped int32
-        return jnp.int32(np.uint32(u).astype(np.int32))
+    def c(u):  # uint32 constant as wrapped int32 (numpy scalar: traces as
+        # an inline literal — a jnp constant would be a captured const,
+        # which pallas_call rejects; see _wrap_i32)
+        return np.int32(np.uint32(u))
 
-    shr = lambda x, n: lax.shift_right_logical(x, jnp.int32(n))
+    shr = lambda x, n: lax.shift_right_logical(x, np.int32(n))
     x = x ^ (seed.astype(jnp.int32) * c(0x9E3779B9))
     x = x ^ shr(x, 16)
     x = x * c(0x85EBCA6B)
@@ -97,7 +106,7 @@ def _apply_dropout(p, seed, pid, rate: float):
     """Zero entries where u < rate; scale survivors by 1/(1-rate)."""
     l, m = p.shape[-2], p.shape[-1]
     u = _uniform01(seed, pid, l, m)
-    keep = u >= jnp.float32(rate)
+    keep = u >= np.float32(rate)
     return jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
 
 
@@ -269,11 +278,12 @@ _fused.defvjp(_fused_fwd, _fused_bwd)
 # layout writes E-wide feature slices that are not 128-lane aligned). That
 # failure would surface only when the *enclosing* train-step jit compiles —
 # taking down the default train path. Instead, the first TPU-backend call per
-# (L, M, H*E, dropout?, dtype) signature eagerly compiles+runs the kernel
-# fwd+bwd on a batch-1 slice of the real shape (the grid is over batch, so
-# batch-1 exercises the exact per-step block shapes). On failure we log once
-# and route that signature to the identical-math einsum path. Explicit
-# requests (interpret/force/SEIST_ATTN_IMPL=fused) bypass the probe so parity
+# (L, M, H*E, dropout?, dtype) signature AOT-compiles the kernel fwd+bwd on a
+# batch-1 slice of the real shape (the grid is over batch, so batch-1
+# exercises the exact per-step block shapes) and executes the compiled
+# program once on zero buffers. On failure we log once and route that
+# signature to the identical-math einsum path. Explicit requests
+# (interpret/force/SEIST_ATTN_IMPL=fused) bypass the probe so parity
 # tooling still sees the raw error.
 
 _KERNEL_STATUS: dict = {}
@@ -287,15 +297,35 @@ _FALLBACK_LOGGED = False
 
 
 def _probe_kernel(l, m, he, heads, rate, dtype) -> None:
-    q = jnp.zeros((1, l, he), dtype)
-    k = jnp.zeros((1, m, he), dtype)
-    seed = jnp.zeros((1,), jnp.int32)
+    # AOT lower+compile, then one real execution. Unlike a traced call,
+    # .lower() never binds into an ambient trace, so this is safe to run
+    # while the enclosing train step is being traced (the previous
+    # ensure_compile_time_eval escape broke outright when JAX moved to the
+    # eager-trace-stack internals — observed live 2026-08-02: constants
+    # created under the eval trace were hoisted out of the kernel trace as
+    # captured consts, then pl.program_id had no eval rule). Mosaic
+    # rejections and VMEM/scratch exhaustion surface at compile; the
+    # execution step keeps runtime-only faults (HBM-full OOM, DMA errors)
+    # routing to the einsum fallback too — the compiled executable takes
+    # concrete (numpy) buffers, so it runs eagerly under any trace.
+    qs = jax.ShapeDtypeStruct((1, l, he), dtype)
+    ks = jax.ShapeDtypeStruct((1, m, he), dtype)
+    ss = jax.ShapeDtypeStruct((1,), jnp.int32)
 
-    def f(q, k, v):
+    def f(q, k, v, seed):
         return _fused(q, k, v, seed, 1.0, rate, heads, False).sum()
 
-    g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, k)
-    g[0].block_until_ready()
+    compiled = jax.jit(jax.grad(f, argnums=(0, 1, 2))).lower(
+        qs, ks, ks, ss
+    ).compile()
+    npdt = np.dtype(dtype)  # ml_dtypes covers bf16 for numpy zeros
+    g = compiled(
+        np.zeros((1, l, he), npdt),
+        np.zeros((1, m, he), npdt),
+        np.zeros((1, m, he), npdt),
+        np.zeros((1,), np.int32),
+    )
+    jax.block_until_ready(g)
 
 
 _TRANSIENT_ERROR_MARKERS = ("RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED", "UNAVAILABLE")
@@ -327,14 +357,13 @@ def _kernel_usable(l, m, he, heads, rate, dtype) -> bool:
     if hit is not None:
         return hit
     try:
-        # ensure_compile_time_eval: the call site usually sits under the train
-        # step's jit trace — without escaping it, jnp.zeros would be tracers,
-        # the nested jit would inline instead of compile, and the probe would
-        # "fail" on a perfectly good kernel (permanently einsum-ing the
-        # default path). Opening the context here (not inside _probe_kernel)
-        # guarantees the eager escape for ANY probe implementation.
-        with jax.ensure_compile_time_eval():
-            _probe_kernel(l, m, he, heads, float(rate), dtype)
+        # The call site usually sits under the train step's jit trace; the
+        # probe must not be traced into it (a nested traced call would
+        # inline instead of compile, and the probe would "fail" on a
+        # perfectly good kernel, permanently einsum-ing the default path).
+        # _probe_kernel uses AOT .lower().compile(), which opens its own
+        # trace context regardless of the ambient one.
+        _probe_kernel(l, m, he, heads, float(rate), dtype)
         ok = True
     except Exception as exc:  # noqa: BLE001 - any compile/runtime rejection
         head = str(exc).splitlines()[0][:200] if str(exc) else ""

@@ -65,19 +65,30 @@ def main() -> int:
             fwd_e = jax.jit(
                 lambda q, k, v: _einsum_attention(q, k, v, scale)
             )(q, k, v)
+            # Tolerances are sized for TPU fp32-via-MXU numerics (measured
+            # 2026-08-02): both the kernel's dots and XLA's default-precision
+            # einsum multiply bf16-rounded inputs with f32 accumulation, so
+            # they track each other to ~3e-4 fwd / ~2e-2 on the
+            # cancellation-heavy dk — while a logic bug (e.g. a dropout-mask
+            # divergence) shifts elements by O(1). Bit-level parity of the
+            # mask math is asserted by the CPU interpret-mode unit tests.
             np.testing.assert_allclose(
-                np.asarray(fwd_k), np.asarray(fwd_e), rtol=2e-4, atol=2e-4
+                np.asarray(fwd_k), np.asarray(fwd_e), rtol=1e-3, atol=1e-3
             )
             gk_f = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2)))
             ge_f = jax.jit(jax.grad(loss_einsum, argnums=(0, 1, 2)))
             gk = gk_f(q, k, v)
             ge = ge_f(q, k, v)
+            # dq/dv track within ~1e-3 (measured 2026-08-02); only dk is
+            # cancellation-heavy (softmax-vjp ds.T @ q summed over L) and
+            # needs the wide band. Keep detection power where numerics allow.
+            grad_tol = {"q": 5e-3, "k": 5e-2, "v": 5e-3}
             for a, b, nm in zip(gk, ge, "qkv"):
                 np.testing.assert_allclose(
                     np.asarray(a),
                     np.asarray(b),
-                    rtol=2e-3,
-                    atol=2e-3,
+                    rtol=grad_tol[nm],
+                    atol=grad_tol[nm],
                     err_msg=f"d{nm}",
                 )
 
