@@ -117,6 +117,22 @@ def env_config() -> dict:
         # Micro-steps scanned inside one jitted call (amortizes
         # per-dispatch cost; see train/step.py make_multi_train_step).
         "steps_per_call": int(os.environ.get("BENCH_STEPS_PER_CALL", 1)),
+        # Active kernel-lowering overrides (SEIST_GCONV_IMPL,
+        # SEIST_CHANNEL_PAD, ...). Part of the cache key: an A/B sweep
+        # that forces a non-default lowering must never overwrite — nor
+        # later replay as — the default-lowering headline entry
+        # (observed 2026-08-02: iso_chanpad_128 landed under the
+        # headline's key). Empty dict for a plain default run.
+        "lowering_overrides": _lowering_overrides(),
+    }
+
+
+def _lowering_overrides() -> dict:
+    """Every SEIST_* env knob that changes the compiled program."""
+    return {
+        k: os.environ[k]
+        for k in sorted(os.environ)
+        if k.startswith("SEIST_") and os.environ[k] != ""
     }
 
 
@@ -131,6 +147,7 @@ def stream_config() -> dict:
         "in_samples": window,
         "stride": int(os.environ.get("BENCH_STRIDE", window // 2)),
         "record_seconds": int(os.environ.get("BENCH_RECORD_SECONDS", 600)),
+        "lowering_overrides": _lowering_overrides(),
     }
 
 

@@ -220,6 +220,25 @@ def test_config_keyed_entry_survives_sweep_overwrite(bench, capsys):
     assert _emitted(capsys)["value"] == 55.0
 
 
+def test_lowering_override_gets_own_cache_key(bench, monkeypatch):
+    # A sweep that forces a non-default lowering (SEIST_CHANNEL_PAD,
+    # SEIST_GCONV_IMPL, ...) compiles a DIFFERENT program; it must write
+    # under its own cache key, never the default-lowering headline's
+    # (observed live 2026-08-02: iso_chanpad_128 overwrote the headline).
+    monkeypatch.delenv("SEIST_CHANNEL_PAD", raising=False)
+    plain = bench.env_config()
+    assert plain["lowering_overrides"] == {}
+    monkeypatch.setenv("SEIST_CHANNEL_PAD", "128")
+    padded = bench.env_config()
+    assert padded["lowering_overrides"] == {"SEIST_CHANNEL_PAD": "128"}
+    key = bench._config_key
+    assert key("m", plain) != key("m", padded)
+    # stream-mode config carries the overrides too
+    assert bench.stream_config()["lowering_overrides"] == {
+        "SEIST_CHANNEL_PAD": "128"
+    }
+
+
 def test_degraded_flag_and_enforcement(bench, monkeypatch, capsys):
     # VERDICT r4 #5: an einsum fallback on TPU must be loud, not a silent
     # -105% in the number.
