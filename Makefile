@@ -4,7 +4,7 @@ NATIVE_DIR := seist_tpu/native
 CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
-.PHONY: native test clean
+.PHONY: native test serve-smoke clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -13,6 +13,12 @@ $(NATIVE_DIR)/libwavekit.so: $(NATIVE_DIR)/wavekit.cpp
 
 test:
 	python -m pytest tests/ -x -q
+
+# Checkpoint-free serving smoke: warm-compile, micro-batch 24 requests,
+# print a BENCH-style latency/throughput/fill-ratio JSON line.
+serve-smoke:
+	JAX_PLATFORMS=cpu python tools/bench_serve.py --model-name phasenet \
+		--window 256 --requests 24 --concurrency 6 --max-batch 4
 
 clean:
 	rm -f $(NATIVE_DIR)/libwavekit.so

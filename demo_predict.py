@@ -19,15 +19,13 @@ import numpy as np
 
 
 def normalize(data: np.ndarray, mode: str = "std") -> np.ndarray:
-    """Per-channel demean + scale (ref demo_predict.py:8-23)."""
-    data = data - np.mean(data, axis=-1, keepdims=True)
-    if mode == "max":
-        mx = np.max(np.abs(data), axis=-1, keepdims=True)
-        mx[mx == 0] = 1
-        return data / mx
-    std = np.std(data, axis=-1, keepdims=True)
-    std[std == 0] = 1
-    return data / std
+    """Per-channel demean + scale (ref demo_predict.py:8-23) — delegates to
+    the canonical seist_tpu.data.preprocess.normalize. The demo's 'max'
+    historically meant abs-max (unlike the training pipeline's signed max),
+    preserved via mode 'absmax'."""
+    from seist_tpu.data.preprocess import normalize as _norm
+
+    return _norm(data, "absmax" if mode == "max" else "std", axis=-1)
 
 
 def load_data(path: str, in_samples: int) -> np.ndarray:

@@ -9,6 +9,7 @@ Layout:
     seist_tpu.ops        on-device postprocess (picking/trigger) + metrics
     seist_tpu.parallel   mesh construction, sharding, multi-host init
     seist_tpu.train      jitted train/eval loops, LR schedules
+    seist_tpu.serve      online inference service (micro-batching + HTTP)
     seist_tpu.utils      logger, meters, misc
 """
 

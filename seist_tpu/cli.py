@@ -244,9 +244,18 @@ def main_worker(args: argparse.Namespace) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> None:
+    import sys
+
     import seist_tpu
     from seist_tpu.parallel.dist import init_distributed_mode
 
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "serve":
+        # Online inference service (seist_tpu/serve/): own flag namespace,
+        # no train/test machinery — dispatch before the big parser.
+        from seist_tpu.serve.server import main as serve_main
+
+        return serve_main(argv[1:])
     args = get_args(argv)
     args.distributed = init_distributed_mode()
     seist_tpu.load_all()

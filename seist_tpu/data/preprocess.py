@@ -30,6 +30,60 @@ from seist_tpu.utils.logger import logger
 Event = Dict[str, Any]
 
 
+def normalize(
+    data: np.ndarray, mode: str = "std", axis: int = -1
+) -> np.ndarray:
+    """Demean + scale along ``axis`` — THE normalization every inference
+    and training path shares (was copied in demo_predict.py and inlined in
+    ops/stream.annotate; deduplicated here).
+
+    Modes (named after their reference origins):
+
+    * ``'std'``    — z-score (ref preprocess.py:224-242, std branch).
+    * ``'max'``    — divide by the SIGNED per-channel max after demeaning
+      (ref preprocess.py:228 uses ``np.max``, not abs-max — the training
+      pipeline's quirk, preserved bit-for-bit; also the native kernel's
+      contract, wavekit.cpp znorm mode 1).
+    * ``'absmax'`` — divide by the abs max (ref demo_predict.py:8-23 —
+      the demo's variant of 'max').
+    * ``''``       — demean only.
+
+    Zero scales divide by 1. Uses the native wavekit kernel when built for
+    the hot 2-D (C, L) float32 case (one C call instead of several numpy
+    passes per sample); the numpy path never mutates the input.
+    """
+    data = np.asarray(data)
+    from seist_tpu import native
+
+    if (
+        native.available()
+        and mode in ("std", "max", "")
+        and data.ndim == 2
+        and axis in (1, -1)
+    ):
+        # Explicit copy: ascontiguousarray returns the caller's array
+        # unchanged when it is already float32 C-contiguous, and the
+        # in-place native kernel would then mutate the caller's data.
+        buf = np.array(data, dtype=np.float32, copy=True, order="C")
+        if native.znorm(buf, mode):
+            return buf
+    data = data - np.mean(data, axis=axis, keepdims=True)
+    if mode == "max":
+        scale = np.max(data, axis=axis, keepdims=True)
+    elif mode == "absmax":
+        scale = np.max(np.abs(data), axis=axis, keepdims=True)
+    elif mode == "std":
+        scale = np.std(data, axis=axis, keepdims=True)
+    elif mode == "":
+        return data
+    else:
+        raise ValueError(
+            f"Supported modes: 'max', 'absmax', 'std', '', got '{mode}'"
+        )
+    scale[scale == 0] = 1
+    return data / scale
+
+
 def pad_phases(
     ppks: list, spks: list, padding_idx: int, num_samples: int
 ) -> Tuple[list, list]:
@@ -224,32 +278,12 @@ class DataPreprocessor:
     def _normalize(self, data: np.ndarray, mode: str) -> np.ndarray:
         """Per-channel demean + max/std normalize (ref: preprocess.py:224-242).
 
-        Uses the native wavekit kernel when built (make native) — one C call
-        instead of several numpy passes per sample."""
-        from seist_tpu import native
-
-        if native.available() and mode in ("std", "max", "") and data.ndim == 2:
-            # Explicit copy: ascontiguousarray returns the caller's array
-            # unchanged when it is already float32 C-contiguous, and the
-            # in-place native kernel would then mutate the caller's data —
-            # the numpy fallback below never does.
-            buf = np.array(data, dtype=np.float32, copy=True, order="C")
-            if native.znorm(buf, mode):
-                return buf
-        data = data - np.mean(data, axis=1, keepdims=True)
-        if mode == "max":
-            max_data = np.max(data, axis=1, keepdims=True)
-            max_data[max_data == 0] = 1
-            data = data / max_data
-        elif mode == "std":
-            std_data = np.std(data, axis=1, keepdims=True)
-            std_data[std_data == 0] = 1
-            data = data / std_data
-        elif mode == "":
-            pass
-        else:
+        Thin wrapper over the canonical module-level :func:`normalize`
+        (signed-max semantics); kept as a method because subclass hooks and
+        tests target it."""
+        if mode not in ("max", "std", ""):
             raise ValueError(f"Supported mode: 'max','std', got '{mode}'")
-        return data
+        return normalize(data, mode, axis=1)
 
     # ----------------------------------------------------------- augmentation
     def _generate_noise_data(self, data, ppks, spks, rng):

@@ -103,6 +103,7 @@ def annotate(
     max_events: Optional[int] = None,
     combine: str = "mean",
     channel0: str,
+    jitted: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Pick P/S phases + detection intervals over a continuous record.
 
@@ -132,6 +133,11 @@ def annotate(
     "prob": (L, 3) stitched curve} with absolute sample positions;
     pick/interval arrays are unpadded. Peak host memory is O(batch_size),
     not O(record).
+
+    ``jitted=True`` declares ``apply_fn`` already compiled (e.g. the serve
+    model-pool's warm per-bucket forward) and skips the ``jax.jit`` wrap
+    here — wrapping a fresh ``jax.jit`` per call would recompile the whole
+    forward every time, which an online service cannot afford.
     """
     if channel0 not in ("non", "det"):
         raise ValueError(f"channel0 must be 'non' or 'det', got {channel0!r}")
@@ -139,19 +145,27 @@ def annotate(
     stride = stride or window // 2
     offsets = window_offsets(record.shape[0], window, stride)
     if max_events is None:
-        max_events = max(32, 4 * len(offsets))
+        # Rounded up to a power of two: pick_peaks/detect_events jit on
+        # static topk, so a raw 4*len(offsets) would compile a fresh
+        # program per distinct record length; quantizing keeps it to
+        # log-many programs. Extra capacity only adds padding slots,
+        # which are stripped below.
+        max_events = 1 << (max(32, 4 * len(offsets)) - 1).bit_length()
 
-    jit_apply = jax.jit(apply_fn)
+    # Function-level: importing data.preprocess executes the whole data
+    # package (pandas, dataset registrations) — too heavy for a module
+    # that otherwise needs only jax/numpy/postprocess.
+    from seist_tpu.data.preprocess import normalize
+
+    jit_apply = apply_fn if jitted else jax.jit(apply_fn)
     n = len(offsets)
     probs = []
     for i in range(0, n, batch_size):
         offs = offsets[i : i + batch_size]
         chunk = np.stack([record[o : o + window] for o in offs], axis=0)
-        # Per-window z-normalization (ref preprocess.py:224-242, std mode).
-        mean = chunk.mean(axis=1, keepdims=True)
-        std = chunk.std(axis=1, keepdims=True)
-        std[std == 0] = 1.0
-        chunk = (chunk - mean) / std
+        # Per-window z-normalization (ref preprocess.py:224-242, std mode);
+        # time axis is 1 in the (N, window, C) chunk.
+        chunk = normalize(chunk, "std", axis=1)
         pad = batch_size - chunk.shape[0]
         if pad:  # keep ONE compiled shape
             chunk = np.concatenate([chunk, chunk[-1:].repeat(pad, 0)], axis=0)

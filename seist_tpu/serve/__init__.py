@@ -1,0 +1,17 @@
+"""Online inference service: micro-batching, bucketed warm compiles,
+stdlib HTTP front-end. See docs/SERVING.md.
+
+    seist_tpu.serve.protocol   wire format + error taxonomy (HTTP statuses)
+    seist_tpu.serve.batcher    request coalescing, backpressure, deadlines
+    seist_tpu.serve.pool       model loading + per-bucket warm-up + decode
+    seist_tpu.serve.server     ServeService core + HTTP shim + `serve` CLI
+"""
+
+from seist_tpu.serve.batcher import BatcherConfig, MicroBatcher  # noqa: F401
+from seist_tpu.serve.pool import ModelPool, load_model_entry  # noqa: F401
+from seist_tpu.serve.protocol import PredictOptions, ServeError  # noqa: F401
+from seist_tpu.serve.server import (  # noqa: F401
+    ServeHTTPServer,
+    ServeService,
+    start_http_server,
+)

@@ -1,0 +1,297 @@
+"""Micro-batcher: coalesce concurrent single-trace requests into bucketed
+fixed-shape forwards.
+
+The serving problem this solves (t5x/seqio-style compiled-program reuse +
+pjit-paper device saturation, see ISSUE/PAPERS): many independent clients
+each send one ``(window, C)`` trace; running one forward per request wastes
+the accelerator (batch-1 forwards) and any fresh shape triggers an XLA
+compile measured in seconds. So requests queue, and a single batcher
+thread flushes when either
+
+* ``max_batch`` requests are waiting (full batch), or
+* the oldest request has waited ``max_delay_ms`` (latency bound), or
+* the batcher is draining for shutdown.
+
+Every flush pads the n collected traces up to the smallest *bucket*
+``>= n`` (default: powers of two up to ``max_batch``) by repeating the
+last trace, so every forward hits one of a handful of shapes that were
+all compiled at warm-up — steady-state serving never compiles.
+
+Backpressure: the queue is bounded (``max_queue``); a full queue rejects
+immediately with :class:`~seist_tpu.serve.protocol.QueueFull` (the HTTP
+layer's 429) rather than building an unbounded latency backlog. Each
+request carries a deadline; requests that expire while queued are dropped
+before the forward (no wasted compute) and raise
+:class:`~seist_tpu.serve.protocol.DeadlineExceeded` in their caller.
+
+Thread model: callers (HTTP handler threads) block in :meth:`submit`;
+one daemon worker owns the device. This is deliberate — JAX dispatch is
+not free-threaded, and a single submission thread also serializes bucket
+warm-up state. All metrics live behind the same lock as the queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from seist_tpu.serve.protocol import (
+    DeadlineExceeded,
+    QueueFull,
+    ServeError,
+    ShuttingDown,
+)
+from seist_tpu.utils.meters import LatencyHistogram
+
+
+def default_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Powers of two up to ``max_batch`` (always including it): the
+    classic shape-bucket ladder — at most ~2x padding waste, and only
+    ``log2(max_batch)+1`` programs to compile at warm-up."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    buckets = []
+    b = 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    return tuple(buckets)
+
+
+@dataclass
+class BatcherConfig:
+    max_batch: int = 8
+    max_delay_ms: float = 10.0
+    max_queue: int = 64
+    buckets: Optional[Sequence[int]] = None  # None = default_buckets
+
+    def resolved_buckets(self) -> Tuple[int, ...]:
+        if self.buckets is None:
+            return default_buckets(self.max_batch)
+        buckets = tuple(sorted(int(b) for b in self.buckets))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"bad buckets {self.buckets}")
+        if buckets[-1] != self.max_batch:
+            raise ValueError(
+                f"largest bucket {buckets[-1]} != max_batch {self.max_batch}"
+            )
+        return buckets
+
+
+class _Pending:
+    __slots__ = ("x", "enqueued_at", "deadline", "event", "result", "error",
+                 "abandoned")
+
+    def __init__(self, x: np.ndarray, deadline: float):
+        self.x = x
+        self.enqueued_at = time.monotonic()
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.abandoned = False  # caller gave up; skip at flush time
+
+
+class MicroBatcher:
+    """See module docstring. ``forward`` maps a ``(B, ...)`` stacked batch
+    (B always one of the buckets) to an array — or tuple of arrays — with
+    leading dimension B; :meth:`submit` returns the caller's slice with a
+    leading dimension of 1 (tuple outputs stay tuples)."""
+
+    def __init__(
+        self,
+        forward: Callable[[np.ndarray], Any],
+        config: Optional[BatcherConfig] = None,
+        name: str = "default",
+    ):
+        self._forward = forward
+        self.config = config or BatcherConfig()
+        self.buckets = self.config.resolved_buckets()
+        self.name = name
+        self._queue: List[_Pending] = []
+        self._cond = threading.Condition()
+        self._stopping = False
+        # Counters (guarded by self._cond's lock):
+        self._submitted = 0
+        self._rejected = 0
+        self._expired = 0
+        self._completed = 0
+        self._failed = 0
+        self._forwards = 0
+        self._batch_items = 0  # real traces forwarded
+        self._batch_slots = 0  # bucket slots forwarded (incl. padding)
+        self.latency_ms = LatencyHistogram()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"batcher-{name}", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, x: np.ndarray, timeout_ms: float = 5000.0) -> Any:
+        """Block until the trace's batch is served; returns the per-item
+        output slice. Raises QueueFull / DeadlineExceeded / ShuttingDown."""
+        t0 = time.monotonic()
+        item = _Pending(np.asarray(x), deadline=t0 + timeout_ms / 1000.0)
+        with self._cond:
+            if self._stopping:
+                raise ShuttingDown(f"batcher {self.name} is draining")
+            if len(self._queue) >= self.config.max_queue:
+                self._rejected += 1
+                raise QueueFull(
+                    f"batcher {self.name} queue full "
+                    f"({self.config.max_queue} waiting)"
+                )
+            self._submitted += 1
+            self._queue.append(item)
+            self._cond.notify_all()
+        if not item.event.wait(timeout=timeout_ms / 1000.0 + 0.05):
+            # Decide success-vs-expired once, under the lock the worker
+            # also counts under: either the result already landed (use it,
+            # never counted expired) or we mark ourselves abandoned AND
+            # expired atomically — the worker then skips the completed
+            # credit, so every request lands in exactly one stats bucket.
+            with self._cond:
+                expired = not item.event.is_set()
+                if expired:
+                    item.abandoned = True
+                    self._expired += 1
+            if expired:
+                raise DeadlineExceeded(
+                    f"request not served within {timeout_ms:.0f} ms"
+                )
+        if item.error is not None:
+            raise item.error
+        self.latency_ms.observe((time.monotonic() - t0) * 1000.0)
+        return item.result
+
+    # ---------------------------------------------------------- worker
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    if self._queue:
+                        n = len(self._queue)
+                        age = time.monotonic() - self._queue[0].enqueued_at
+                        budget = self.config.max_delay_ms / 1000.0
+                        if (
+                            n >= self.config.max_batch
+                            or age >= budget
+                            or self._stopping
+                        ):
+                            break
+                        self._cond.wait(budget - age)
+                    elif self._stopping:
+                        return
+                    else:
+                        self._cond.wait()
+                take = min(len(self._queue), self.config.max_batch)
+                pending = self._queue[:take]
+                del self._queue[:take]
+            self._run_batch(pending)
+
+    def _run_batch(self, pending: List[_Pending]) -> None:
+        now = time.monotonic()
+        live: List[_Pending] = []
+        with self._cond:
+            for item in pending:
+                if item.abandoned:
+                    continue  # caller already raised DeadlineExceeded
+                if item.deadline < now:
+                    self._expired += 1
+                    item.error = DeadlineExceeded(
+                        "expired while queued (server overloaded?)"
+                    )
+                    item.event.set()
+                    continue
+                live.append(item)
+        if not live:
+            return
+        n = len(live)
+        bucket = next(b for b in self.buckets if b >= n)
+        batch = np.stack([item.x for item in live], axis=0)
+        if bucket > n:  # pad by repeating the last trace: same warm shape
+            batch = np.concatenate(
+                [batch, np.repeat(batch[-1:], bucket - n, axis=0)], axis=0
+            )
+        try:
+            out = self._forward(batch)
+        except Exception as e:  # noqa: BLE001 — must not kill the worker
+            err = e if isinstance(e, ServeError) else ServeError(
+                f"forward failed: {e!r}"
+            )
+            with self._cond:  # same atomicity argument as the success path
+                for item in live:
+                    item.error = err
+                    if not item.abandoned:
+                        self._failed += 1
+                    item.event.set()
+            return
+        # Materialize device output ONCE per flush; per-item slicing below
+        # then works on host arrays (np.asarray on ndarray is a no-op) —
+        # without this, every item would pull the full batch across the
+        # device boundary again.
+        if isinstance(out, (tuple, list)):
+            out = type(out)(np.asarray(o) for o in out)
+        else:
+            out = np.asarray(out)
+        with self._cond:
+            self._forwards += 1
+            self._batch_items += n
+            self._batch_slots += bucket
+            # Count + event.set under the lock so each request is credited
+            # exactly once: a caller timing out DURING the forward holds
+            # this lock to mark itself abandoned/expired, and its lost-race
+            # check reads the event under it too. Without the atomicity a
+            # request could be counted both expired and completed,
+            # breaking submitted == completed+expired+rejected+failed.
+            for i, item in enumerate(live):
+                item.result = _slice_outputs(out, i)
+                if not item.abandoned:
+                    self._completed += 1
+                item.event.set()
+
+    # ---------------------------------------------------------- control
+    def shutdown(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop accepting work; with ``drain`` the already-queued requests
+        are still served (graceful), otherwise they fail ShuttingDown."""
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                for item in self._queue:
+                    item.error = ShuttingDown("batcher shut down")
+                    item.event.set()
+                self._queue.clear()
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout_s)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            slots = self._batch_slots
+            return {
+                "queue_depth": len(self._queue),
+                "submitted": self._submitted,
+                "completed": self._completed,
+                "rejected": self._rejected,
+                "expired": self._expired,
+                "failed": self._failed,
+                "forwards": self._forwards,
+                "batch_fill_ratio": (
+                    self._batch_items / slots if slots else 0.0
+                ),
+                "buckets": list(self.buckets),
+                "latency_ms": self.latency_ms.summary(),
+            }
+
+
+def _slice_outputs(out: Any, i: int) -> Any:
+    """Per-item slice (keeping a leading dim of 1) of an array or a
+    tuple/list of arrays — mirrors model outputs: dpk heads return one
+    (B, L, 3) array, ditingmotion returns a tuple of two (B, classes)."""
+    if isinstance(out, (tuple, list)):
+        return type(out)(np.asarray(o)[i : i + 1] for o in out)
+    return np.asarray(out)[i : i + 1]

@@ -1,0 +1,256 @@
+"""Model pool: load ``(model_name, checkpoint)`` pairs, pre-compile every
+serving shape, decode per-task outputs.
+
+The pool owns exactly one jitted forward per model — a closure over the
+restored variables, so jax's compile cache keys only on the input shape.
+``warmup()`` runs that forward once per batch bucket (and once through the
+default postprocess) before the server accepts traffic: the t5x/seqio
+lesson (PAPERS.md) that a service must pay all its XLA compiles at
+startup, never on a customer request.
+
+``load_model_entry`` is also the single checkpoint-loading path for
+offline tools (tools/predict.py) — loader logic lives here exactly once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from seist_tpu.serve.batcher import _slice_outputs
+from seist_tpu.serve.protocol import (
+    BadRequest,
+    PredictOptions,
+    ServeError,
+    UnknownModel,
+)
+from seist_tpu.utils.logger import logger
+
+
+@dataclass
+class ModelEntry:
+    """One servable model: everything needed to forward + decode."""
+
+    name: str
+    model: Any
+    variables: Dict[str, Any]
+    spec: Any  # taskspec.TaskSpec
+    window: int
+    in_channels: int
+    channel0: Optional[str]  # 'non'/'det' for picking heads, else None
+    forward: Callable[[Any], Any]  # jitted, (B, window, C) -> outputs
+    apply: Callable[[Any], Any]  # same, unjitted (for jax.jit composition)
+
+    @property
+    def is_picker(self) -> bool:
+        return self.channel0 is not None
+
+
+def load_model_entry(
+    model_name: str,
+    checkpoint: str = "",
+    *,
+    window: int = 8192,
+    seed: int = 0,
+) -> ModelEntry:
+    """Create + restore one model for inference.
+
+    Without ``checkpoint`` the model serves freshly-initialized weights
+    (tests / smoke runs); with one, params (+ BN stats when present) are
+    restored the same way demo_predict.py and tools/predict.py always did
+    — that logic now lives only here.
+    """
+    import seist_tpu
+    from seist_tpu import taskspec
+    from seist_tpu.models import api
+
+    seist_tpu.load_all()
+    spec = taskspec.get_task_spec(model_name)
+    in_channels = taskspec.get_num_inchannels(model_name)
+    model = api.create_model(
+        model_name, in_channels=in_channels, in_samples=window
+    )
+    if checkpoint:
+        from seist_tpu.train.checkpoint import load_checkpoint
+
+        restored = load_checkpoint(checkpoint)
+        variables = {"params": restored["params"]}
+        if restored.get("batch_stats"):  # omit entirely for BN-less models
+            variables["batch_stats"] = restored["batch_stats"]
+    else:
+        variables = api.init_variables(
+            model, seed=seed, in_samples=window, in_channels=in_channels
+        )
+
+    first = spec.labels[0]
+    channel0 = (
+        tuple(first)[0]
+        if isinstance(first, (tuple, list))
+        and len(first) == 3
+        and tuple(first)[0] in ("non", "det")
+        else None
+    )
+
+    def apply_fn(x):
+        return model.apply(variables, x, train=False)
+
+    import jax
+
+    return ModelEntry(
+        name=model_name,
+        model=model,
+        variables=variables,
+        spec=spec,
+        window=window,
+        in_channels=in_channels,
+        channel0=channel0,
+        forward=jax.jit(apply_fn),
+        apply=apply_fn,
+    )
+
+
+class ModelPool:
+    """Loaded entries keyed by model name + the warm-up that compiles all
+    serving shapes up front."""
+
+    def __init__(
+        self,
+        entries: Sequence[Tuple[str, str]],
+        *,
+        window: int = 8192,
+        seed: int = 0,
+    ):
+        if not entries:
+            raise ValueError("ModelPool needs at least one (name, checkpoint)")
+        self._entries: Dict[str, ModelEntry] = {}
+        for name, ckpt in entries:
+            if name in self._entries:
+                raise ValueError(f"duplicate model '{name}' in pool")
+            self._entries[name] = load_model_entry(
+                name, ckpt, window=window, seed=seed
+            )
+        self.warmup_report: List[Dict[str, Any]] = []
+
+    def names(self) -> List[str]:
+        return list(self._entries)
+
+    def get(self, name: Optional[str]) -> ModelEntry:
+        if name is None:
+            if len(self._entries) == 1:
+                return next(iter(self._entries.values()))
+            raise BadRequest(
+                f"'model' is required when several are loaded: {self.names()}"
+            )
+        try:
+            return self._entries[name]
+        except KeyError:
+            raise UnknownModel(
+                f"model '{name}' not loaded; available: {self.names()}"
+            ) from None
+
+    def warmup(self, buckets: Sequence[int]) -> List[Dict[str, Any]]:
+        """Compile every (bucket, window, C) forward + the default decode
+        for every entry; returns per-shape compile timings (also kept on
+        ``self.warmup_report`` for /healthz)."""
+        from seist_tpu.utils.profiling import stopwatch
+
+        report = []
+        for entry in self._entries.values():
+            for b in sorted(set(int(b) for b in buckets)):
+                x = np.zeros((b, entry.window, entry.in_channels), np.float32)
+                with stopwatch() as elapsed:
+                    out = entry.forward(x)
+                    _block(out)
+                report.append(
+                    {"model": entry.name, "batch": b, "seconds": elapsed()}
+                )
+                logger.info(
+                    f"[serve] warm {entry.name} batch={b} "
+                    f"({elapsed()*1000:.0f} ms)"
+                )
+            # Warm the postprocess programs too (pick_peaks/detect_events
+            # jit on static topk/min_peak_dist — defaults compiled here).
+            with stopwatch() as elapsed:
+                decode_outputs(
+                    entry, _slice_outputs(out, 0), PredictOptions()
+                )
+            report.append(
+                {"model": entry.name, "batch": "decode", "seconds": elapsed()}
+            )
+        self.warmup_report = report
+        return report
+
+
+def decode_outputs(
+    entry: ModelEntry, outputs: Any, opts: PredictOptions
+) -> Dict[str, Any]:
+    """One request's raw model outputs (leading dim 1) -> JSON-able result.
+
+    Picking heads route through ops/postprocess (same programs the eval
+    loop uses); VALUE heads go through the task spec's results transform
+    (e.g. magnet's mean-only, baz's (cos,sin)->degrees decode); ONEHOT
+    heads report argmax class + raw scores.
+    """
+    from seist_tpu import taskspec
+    from seist_tpu.ops.postprocess import process_outputs
+
+    spec = entry.spec
+    if entry.is_picker:
+        res = process_outputs(
+            outputs,
+            spec.labels,
+            opts.sampling_rate,
+            ppk_threshold=opts.ppk_threshold,
+            spk_threshold=opts.spk_threshold,
+            det_threshold=opts.det_threshold,
+            min_peak_dist=opts.min_peak_dist,
+            max_detect_event_num=opts.max_events,
+        )
+        fs = float(opts.sampling_rate)
+        out: Dict[str, Any] = {"task": "picking"}
+        for kind in ("ppk", "spk"):
+            idxs = np.asarray(res[kind])[0]
+            idxs = idxs[idxs >= 0]
+            out[kind] = [
+                {"sample": int(i), "time_s": round(i / fs, 6)} for i in idxs
+            ]
+        if "det" in res:
+            pairs = np.asarray(res["det"])[0].reshape(-1, 2)
+            pairs = pairs[pairs[:, 1] >= pairs[:, 0]]
+            out["det"] = [
+                {"onset": int(a), "offset": int(b),
+                 "onset_s": round(a / fs, 6), "offset_s": round(b / fs, 6)}
+                for a, b in pairs
+            ]
+        return out
+
+    transform = spec.outputs_transform_for_results
+    outs = transform(outputs) if transform else outputs
+    outs_list = outs if isinstance(outs, (tuple, list)) else [outs]
+    if len(outs_list) != len(spec.labels):
+        # Server-side model/spec mismatch, not a client error — 500.
+        raise ServeError(
+            f"model '{entry.name}' produced {len(outs_list)} outputs for "
+            f"{len(spec.labels)} labels"
+        )
+    out = {"task": "regression"}
+    for name, arr in zip(spec.labels, outs_list):
+        arr = np.asarray(arr)
+        if name in taskspec.IO_ITEMS and taskspec.get_kind(name) == taskspec.ONEHOT:
+            out["task"] = "classification"
+            scores = arr.reshape(-1)
+            out[name] = {
+                "class": int(np.argmax(scores)),
+                "scores": [float(s) for s in scores],
+            }
+        else:
+            out[name] = float(arr.reshape(-1)[0])
+    return out
+
+
+def _block(out: Any) -> None:
+    """Wait for device completion so warm-up timings mean something."""
+    for o in out if isinstance(out, (tuple, list)) else [out]:
+        getattr(o, "block_until_ready", lambda: None)()

@@ -1,0 +1,188 @@
+"""Wire protocol for the serve subsystem: request parsing, response
+shaping, and the error taxonomy the HTTP front-end maps to status codes.
+
+Everything is plain JSON over stdlib types — no new dependencies. A
+predict request body is::
+
+    {"model": "seist_s_dpk",              # optional when one model loaded
+     "data": [[...], ...],                # (C, L) or (L, C) floats
+     "options": {"ppk_threshold": 0.3, "spk_threshold": 0.3,
+                 "det_threshold": 0.5, "min_peak_dist": 1.0,
+                 "sampling_rate": 50, "norm_mode": "std",
+                 "timeout_ms": 2000}}
+
+``data`` orientation is resolved against the model's channel count (the
+same (C, L)/(L, C) tolerance as tools/predict.py); windows shorter than
+the model's compiled window are right-padded with zeros AFTER
+normalization (so padding never shifts the z-score), longer ones are
+rejected toward ``POST /annotate`` which exists precisely for long
+records.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class ServeError(Exception):
+    """Base service error; ``status`` is the HTTP status it maps to."""
+
+    status = 500
+    code = "internal"
+
+    def payload(self) -> Dict[str, Any]:
+        return {"error": self.code, "message": str(self)}
+
+
+class BadRequest(ServeError):
+    status = 400
+    code = "bad_request"
+
+
+class UnknownModel(ServeError):
+    status = 404
+    code = "unknown_model"
+
+
+class QueueFull(ServeError):
+    """Bounded-queue backpressure — the 429 the ISSUE's '429-style
+    rejection' refers to. Clients should retry with backoff."""
+
+    status = 429
+    code = "queue_full"
+
+
+class DeadlineExceeded(ServeError):
+    status = 504
+    code = "deadline_exceeded"
+
+
+class ShuttingDown(ServeError):
+    status = 503
+    code = "shutting_down"
+
+
+@dataclass
+class PredictOptions:
+    """Per-request knobs; defaults mirror cli.py's eval flags."""
+
+    ppk_threshold: float = 0.3
+    spk_threshold: float = 0.3
+    det_threshold: float = 0.5
+    min_peak_dist: float = 1.0  # seconds
+    sampling_rate: int = 50
+    norm_mode: str = "std"
+    max_events: int = 8
+    timeout_ms: float = 5000.0
+    # /annotate only:
+    stride: int = 0  # 0 = window // 2
+    combine: str = "max"
+    record_max_events: int = 0  # 0 = scale with record length
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "PredictOptions":
+        d = dict(d or {})
+        unknown = set(d) - set(cls.__dataclass_fields__)
+        if unknown:
+            raise BadRequest(f"unknown options: {sorted(unknown)}")
+        int_fields = ("sampling_rate", "max_events", "stride",
+                      "record_max_events")
+        for key, value in d.items():
+            if key in ("norm_mode", "combine"):
+                if not isinstance(value, str):
+                    raise BadRequest(f"option '{key}' must be a string")
+                continue
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                # bool is an int subclass; a JSON true/false here is a
+                # client bug, not a number.
+                raise BadRequest(
+                    f"option '{key}' must be a number, "
+                    f"got {type(value).__name__}"
+                )
+            if not math.isfinite(value):
+                # json.loads accepts NaN/Infinity; NaN would sail through
+                # every range check below (all comparisons are False).
+                raise BadRequest(f"option '{key}' must be finite")
+            if key in int_fields:
+                if float(value) != int(value):
+                    raise BadRequest(
+                        f"option '{key}' must be an integer, got {value}"
+                    )
+                d[key] = int(value)
+        try:
+            opts = cls(**d)
+        except (TypeError, ValueError) as e:
+            raise BadRequest(f"bad options: {e}") from None
+        # Range checks: a negative timeout_ms would otherwise turn
+        # lock.acquire()/Event.wait() timeouts into unbounded waits or
+        # ValueErrors deep in the service (500s instead of 400s).
+        if opts.timeout_ms <= 0:
+            raise BadRequest(f"timeout_ms must be > 0, got {opts.timeout_ms}")
+        if opts.sampling_rate <= 0:
+            raise BadRequest(
+                f"sampling_rate must be > 0, got {opts.sampling_rate}"
+            )
+        if opts.min_peak_dist < 0:
+            raise BadRequest(
+                f"min_peak_dist must be >= 0, got {opts.min_peak_dist}"
+            )
+        if opts.max_events < 1:
+            raise BadRequest(f"max_events must be >= 1, got {opts.max_events}")
+        if opts.stride < 0 or opts.record_max_events < 0:
+            raise BadRequest("stride and record_max_events must be >= 0")
+        if opts.combine not in ("max", "mean"):
+            raise BadRequest(
+                f"combine must be 'max' or 'mean', got '{opts.combine}'"
+            )
+        return opts
+
+
+def parse_body(raw: bytes) -> Dict[str, Any]:
+    try:
+        body = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise BadRequest(f"body is not valid JSON: {e}") from None
+    if not isinstance(body, dict):
+        raise BadRequest(f"body must be a JSON object, got {type(body).__name__}")
+    return body
+
+
+def parse_waveform(obj: Any, in_channels: int) -> np.ndarray:
+    """JSON nested lists -> (L, C) float32, resolving (C, L) vs (L, C) by
+    the model's channel count (ambiguous square inputs read as (L, C))."""
+    try:
+        arr = np.asarray(obj, dtype=np.float32)
+    except (ValueError, TypeError) as e:
+        raise BadRequest(f"'data' is not a numeric array: {e}") from None
+    if arr.ndim != 2:
+        raise BadRequest(f"'data' must be 2-D, got shape {arr.shape}")
+    if arr.shape[1] == in_channels:
+        pass  # already (L, C)
+    elif arr.shape[0] == in_channels:
+        arr = arr.T
+    else:
+        raise BadRequest(
+            f"'data' shape {arr.shape} has no axis of {in_channels} channels"
+        )
+    if not np.all(np.isfinite(arr)):
+        raise BadRequest("'data' contains non-finite values")
+    return arr
+
+
+def json_bytes(payload: Dict[str, Any]) -> bytes:
+    return json.dumps(payload, default=_jsonable).encode("utf-8")
+
+
+def _jsonable(x: Any):
+    if isinstance(x, (np.integer,)):
+        return int(x)
+    if isinstance(x, (np.floating,)):
+        return float(x)
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    raise TypeError(f"not JSON-serializable: {type(x)}")

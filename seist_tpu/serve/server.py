@@ -1,0 +1,457 @@
+"""Online inference service: micro-batched /predict, streaming /annotate,
+health + metrics — stdlib HTTP only (http.server), no new dependencies.
+
+Layering:
+
+* :class:`ServeService` — transport-free core (also the in-process test
+  client): model pool + one MicroBatcher per model + counters. Single
+  fixed-window traces go through the batcher; long records go through
+  ``ops/stream.annotate`` driving the SAME warm per-bucket forward
+  (``jitted=True``, ``batch_size=largest bucket``), so the expensive
+  model forward never compiles after warm-up. (The lightweight
+  stitch/pick programs in /annotate still compile once per new record
+  length — small, host-bound, and amortized across same-length records.)
+* :class:`ServeHTTPServer` + handler — a thin JSON shim: ServeError
+  subclasses carry their own HTTP status (429 queue-full backpressure,
+  504 deadline, 503 draining, 400/404 client errors).
+
+Endpoints::
+
+    POST /predict   one (window, C) trace   -> picks / regression / class
+    POST /annotate  one (L >= window, C) record -> picks over the record
+    GET  /healthz   liveness + model list + warm-up state
+    GET  /metrics   queue depth, batch-fill ratio, latency histograms
+
+CLI: ``python main.py serve --model seist_s_dpk=CKPT --port 8080 ...``
+(see ``main()``); ``make serve-smoke`` runs the no-checkpoint smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from seist_tpu.serve.batcher import BatcherConfig, MicroBatcher
+from seist_tpu.serve.pool import ModelPool, decode_outputs
+from seist_tpu.serve.protocol import (
+    BadRequest,
+    DeadlineExceeded,
+    PredictOptions,
+    ServeError,
+    ShuttingDown,
+    json_bytes,
+    parse_body,
+    parse_waveform,
+)
+from seist_tpu.utils.logger import logger
+from seist_tpu.utils.meters import LatencyHistogram
+
+MAX_BODY_BYTES = 64 * 1024 * 1024  # one hours-long fp32 record is ~tens of MB
+
+_NORM_MODES = ("std", "max", "absmax", "")
+
+
+class ServeService:
+    """Transport-free serving core; every public method raises ServeError
+    subclasses on failure and returns JSON-able dicts on success."""
+
+    def __init__(
+        self,
+        pool: ModelPool,
+        batcher_config: Optional[BatcherConfig] = None,
+    ):
+        self.pool = pool
+        self.config = batcher_config or BatcherConfig()
+        self.buckets = self.config.resolved_buckets()
+        pool.warmup(self.buckets)
+        self._batchers: Dict[str, MicroBatcher] = {}
+        for name in pool.names():
+            entry = pool.get(name)
+            import jax.numpy as jnp
+
+            fwd = entry.forward
+            self._batchers[name] = MicroBatcher(
+                lambda batch, _f=fwd: _f(jnp.asarray(batch)),
+                self.config,
+                name=name,
+            )
+        self._annotate_locks = {n: threading.Lock() for n in pool.names()}
+        self.annotate_latency_ms = LatencyHistogram()
+        self._lock = threading.Lock()
+        self._requests = {"predict": 0, "annotate": 0}
+        self._annotate_windows = 0
+        self._started_at = time.time()
+        self._draining = False
+
+    # ----------------------------------------------------------- predict
+    def predict(
+        self,
+        data: Any,
+        model: Optional[str] = None,
+        options: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """One fixed-window trace through the micro-batcher."""
+        if self._draining:
+            raise ShuttingDown("service is draining")
+        entry = self.pool.get(model)
+        opts = PredictOptions.from_dict(options)
+        x = parse_waveform(data, entry.in_channels)
+        if x.shape[0] > entry.window:
+            raise BadRequest(
+                f"trace length {x.shape[0]} > window {entry.window}; "
+                "use POST /annotate for long records"
+            )
+        x = _normalize_trace(x, opts.norm_mode)
+        n_real = x.shape[0]
+        if n_real < entry.window:  # pad AFTER normalize: zeros stay zero
+            pad = np.zeros((entry.window - n_real, x.shape[1]), dtype=x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        with self._lock:
+            self._requests["predict"] += 1
+        raw = self._batchers[entry.name].submit(x, timeout_ms=opts.timeout_ms)
+        result = decode_outputs(entry, raw, opts)
+        if n_real < entry.window:
+            # The signal->zeros step at the padding boundary can fabricate
+            # picks/detections inside samples the client never sent.
+            _clip_picks(result, n_real, float(opts.sampling_rate))
+        result["model"] = entry.name
+        return result
+
+    # ---------------------------------------------------------- annotate
+    def annotate(
+        self,
+        data: Any,
+        model: Optional[str] = None,
+        options: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """A long (L >= window) record via sliding windows + stitching,
+        reusing the pool's warm largest-bucket forward."""
+        if self._draining:
+            raise ShuttingDown("service is draining")
+        entry = self.pool.get(model)
+        if not entry.is_picker:
+            raise BadRequest(
+                f"model '{entry.name}' is not a picking model; /annotate "
+                "needs (non|det, ppk, spk) outputs"
+            )
+        opts = PredictOptions.from_dict(options)
+        record = parse_waveform(data, entry.in_channels)
+        if record.shape[0] < entry.window:
+            raise BadRequest(
+                f"record length {record.shape[0]} < window {entry.window}; "
+                "use POST /predict for single windows"
+            )
+        from seist_tpu.ops.stream import annotate as stream_annotate
+
+        t0 = time.monotonic()
+        lock = self._annotate_locks[entry.name]
+        # One record at a time per model: annotate saturates the device by
+        # itself; interleaving two would only thrash. The wait counts
+        # against the request's own deadline.
+        if not lock.acquire(timeout=opts.timeout_ms / 1000.0):
+            raise DeadlineExceeded(
+                f"/annotate queue wait exceeded {opts.timeout_ms:.0f} ms"
+            )
+        try:
+            with self._lock:
+                self._requests["annotate"] += 1
+            picks = stream_annotate(
+                entry.forward,
+                record,
+                window=entry.window,
+                stride=opts.stride or None,
+                batch_size=self.buckets[-1],
+                sampling_rate=opts.sampling_rate,
+                ppk_threshold=opts.ppk_threshold,
+                spk_threshold=opts.spk_threshold,
+                det_threshold=opts.det_threshold,
+                min_peak_dist=opts.min_peak_dist,
+                combine=opts.combine,
+                max_events=opts.record_max_events or None,
+                channel0=entry.channel0,
+                jitted=True,
+            )
+        finally:
+            lock.release()
+        self.annotate_latency_ms.observe((time.monotonic() - t0) * 1000.0)
+        fs = float(opts.sampling_rate)
+        from seist_tpu.ops.stream import window_offsets
+
+        n_windows = len(
+            window_offsets(
+                record.shape[0], entry.window, opts.stride or entry.window // 2
+            )
+        )
+        with self._lock:
+            self._annotate_windows += n_windows
+        return {
+            "model": entry.name,
+            "task": "picking",
+            "record_samples": int(record.shape[0]),
+            "windows": int(n_windows),
+            "ppk": [
+                {"sample": int(i), "time_s": round(int(i) / fs, 6)}
+                for i in picks["ppk"]
+            ],
+            "spk": [
+                {"sample": int(i), "time_s": round(int(i) / fs, 6)}
+                for i in picks["spk"]
+            ],
+            "det": [
+                {"onset": int(a), "offset": int(b),
+                 "onset_s": round(int(a) / fs, 6),
+                 "offset_s": round(int(b) / fs, 6)}
+                for a, b in picks["det"]
+            ],
+        }
+
+    # ------------------------------------------------------ health/metrics
+    def healthz(self) -> Dict[str, Any]:
+        return {
+            "status": "draining" if self._draining else "ok",
+            "models": self.pool.names(),
+            "buckets": list(self.buckets),
+            "uptime_s": round(time.time() - self._started_at, 3),
+            "warmup": self.pool.warmup_report,
+        }
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            requests = dict(self._requests)
+            annotate_windows = self._annotate_windows
+        return {
+            "uptime_s": round(time.time() - self._started_at, 3),
+            "requests": requests,
+            "annotate": {
+                "windows": annotate_windows,
+                "latency_ms": self.annotate_latency_ms.summary(),
+            },
+            "models": {
+                name: batcher.stats()
+                for name, batcher in self._batchers.items()
+            },
+        }
+
+    # ----------------------------------------------------------- shutdown
+    def shutdown(self, drain: bool = True) -> None:
+        """Refuse new work, then (with ``drain``) serve what's queued."""
+        self._draining = True
+        for batcher in self._batchers.values():
+            batcher.shutdown(drain=drain)
+
+
+def _clip_picks(result: Dict[str, Any], n_real: int, fs: float) -> None:
+    """Drop decoded picking outputs that fall inside zero-padding (sample
+    >= ``n_real``); detection intervals are clipped to the real extent."""
+    if result.get("task") != "picking":
+        return
+    for kind in ("ppk", "spk"):
+        if kind in result:
+            result[kind] = [p for p in result[kind] if p["sample"] < n_real]
+    if "det" in result:
+        kept = []
+        for d in result["det"]:
+            if d["onset"] >= n_real:
+                continue
+            if d["offset"] >= n_real:
+                d = dict(
+                    d,
+                    offset=n_real - 1,
+                    offset_s=round((n_real - 1) / fs, 6),
+                )
+            kept.append(d)
+        result["det"] = kept
+
+
+def _normalize_trace(x: np.ndarray, norm_mode: str) -> np.ndarray:
+    if norm_mode not in _NORM_MODES:
+        raise BadRequest(
+            f"norm_mode must be one of {_NORM_MODES}, got '{norm_mode}'"
+        )
+    from seist_tpu.data.preprocess import normalize
+
+    # (L, C): time axis is 0.
+    return np.asarray(normalize(x, norm_mode, axis=0), np.float32)
+
+
+# ---------------------------------------------------------------- HTTP shim
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "seist-serve/0.1"
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def service(self) -> ServeService:
+        return self.server.service  # type: ignore[attr-defined]
+
+    def log_message(self, format: str, *args: Any) -> None:
+        logger.debug(f"[serve] {self.address_string()} {format % args}")
+
+    def _reply(self, status: int, payload: Dict[str, Any]) -> None:
+        body = json_bytes(payload)
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            # Tell the client, not just the socket: without the header an
+            # HTTP/1.1 client assumes keep-alive and retries a dead conn.
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        try:
+            if self.path == "/healthz":
+                self._reply(200, self.service.healthz())
+            elif self.path == "/metrics":
+                self._reply(200, self.service.metrics())
+            else:
+                self._reply(404, {"error": "not_found", "message": self.path})
+        except Exception as e:  # noqa: BLE001
+            self._reply(500, {"error": "internal", "message": repr(e)})
+
+    def do_POST(self) -> None:  # noqa: N802
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            if length > MAX_BODY_BYTES:
+                # The unread body would desync this keep-alive connection
+                # (its bytes would parse as the next request line) — close.
+                self.close_connection = True
+                self._reply(
+                    413,
+                    {"error": "too_large",
+                     "message": f"body {length} > {MAX_BODY_BYTES} bytes"},
+                )
+                return
+            body = parse_body(self.rfile.read(length))
+            if self.path == "/predict":
+                fn = self.service.predict
+            elif self.path == "/annotate":
+                fn = self.service.annotate
+            else:
+                self._reply(404, {"error": "not_found", "message": self.path})
+                return
+            result = fn(
+                body.get("data"),
+                model=body.get("model"),
+                options=body.get("options"),
+            )
+            self._reply(200, result)
+        except ServeError as e:
+            self._reply(e.status, e.payload())
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"[serve] unhandled error: {e!r}")
+            self._reply(500, {"error": "internal", "message": repr(e)})
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr: Tuple[str, int], service: ServeService):
+        super().__init__(addr, _Handler)
+        self.service = service
+
+
+def start_http_server(
+    service: ServeService, host: str = "127.0.0.1", port: int = 8080
+) -> ServeHTTPServer:
+    """Bind + serve on a daemon thread; returns the bound server (use
+    ``server.server_address`` to discover an ephemeral port)."""
+    server = ServeHTTPServer((host, port), service)
+    thread = threading.Thread(
+        target=server.serve_forever, name="serve-http", daemon=True
+    )
+    thread.start()
+    return server
+
+
+# ----------------------------------------------------------------- CLI
+def get_serve_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        prog="serve", description="seist_tpu online inference service"
+    )
+    ap.add_argument(
+        "--model", action="append", default=[], metavar="NAME[=CKPT]",
+        help="model to serve, repeatable; NAME alone serves fresh-init "
+        "weights (smoke/testing)",
+    )
+    ap.add_argument("--model-name", default="", help="single-model shorthand")
+    ap.add_argument("--checkpoint", default="", help="with --model-name")
+    ap.add_argument("--window", type=int, default=8192)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-delay-ms", type=float, default=10.0)
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument(
+        "--buckets", default="",
+        help="comma-separated batch buckets (default: powers of 2 up to "
+        "--max-batch); largest must equal --max-batch",
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    return ap.parse_args(argv)
+
+
+def parse_model_flags(args: argparse.Namespace) -> List[Tuple[str, str]]:
+    entries: List[Tuple[str, str]] = []
+    for spec in args.model:
+        name, _, ckpt = spec.partition("=")
+        entries.append((name, ckpt))
+    if args.model_name:
+        entries.append((args.model_name, args.checkpoint))
+    if not entries:
+        raise SystemExit("serve: need --model NAME[=CKPT] or --model-name")
+    return entries
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    from seist_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+    args = get_serve_args(argv)
+    entries = parse_model_flags(args)
+    config = BatcherConfig(
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        max_queue=args.max_queue,
+        buckets=(
+            tuple(int(b) for b in args.buckets.split(","))
+            if args.buckets
+            else None
+        ),
+    )
+    pool = ModelPool(entries, window=args.window, seed=args.seed)
+    service = ServeService(pool, config)
+    server = ServeHTTPServer((args.host, args.port), service)
+    host, port = server.server_address[:2]
+    logger.info(
+        f"[serve] listening on http://{host}:{port} "
+        f"models={pool.names()} buckets={list(service.buckets)}"
+    )
+
+    import signal
+
+    # Containers stop with SIGTERM; turn it into the same graceful drain
+    # as Ctrl-C. shutdown() must run off the serve_forever thread.
+    def _term(signum, frame):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
+    try:
+        server.serve_forever()
+        logger.info("[serve] draining...")  # SIGTERM path
+    except KeyboardInterrupt:
+        logger.info("[serve] draining...")
+    finally:
+        server.shutdown()
+        service.shutdown(drain=True)
+        logger.info("[serve] stopped")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,8 +1,11 @@
-"""Running-average meters and progress strings (ref: utils/meters.py:4-45)."""
+"""Running-average meters, latency histograms and progress strings
+(ref: utils/meters.py:4-45; the histogram backs serve's /metrics)."""
 
 from __future__ import annotations
 
-from typing import Iterable, List
+import bisect
+import threading
+from typing import Dict, Iterable, List, Sequence
 
 
 class AverageMeter:
@@ -28,6 +31,92 @@ class AverageMeter:
     def __str__(self) -> str:
         fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
         return fmtstr.format(**self.__dict__)
+
+
+#: Default latency buckets (ms): roughly log-spaced from sub-ms dispatch to
+#: multi-second compiles, the range an online inference service spans.
+LATENCY_BOUNDS_MS = (
+    1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
+    500.0, 1000.0, 2000.0, 5000.0, 10000.0,
+)
+
+
+class LatencyHistogram:
+    """Fixed-bucket histogram with percentile estimates — O(1) observe,
+    O(buckets) quantile, bounded memory regardless of traffic volume (the
+    property an always-on /metrics endpoint needs; storing raw samples
+    would grow without bound).
+
+    Thread-safe: serve handler threads observe concurrently with /metrics
+    reads. Percentiles are estimated by linear interpolation inside the
+    owning bucket (upper-bounded by bucket width); exact values above the
+    last bound are clamped to it.
+    """
+
+    def __init__(self, bounds: Sequence[float] = LATENCY_BOUNDS_MS):
+        self._bounds = [float(b) for b in bounds]
+        if self._bounds != sorted(self._bounds):
+            raise ValueError(f"bounds must be sorted, got {bounds}")
+        self._counts = [0] * (len(self._bounds) + 1)  # last = overflow
+        self._sum = 0.0
+        self._count = 0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        i = bisect.bisect_left(self._bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+            if value > self._max:
+                self._max = value
+
+    def percentile(self, q: float) -> float:
+        """Estimate the ``q``-quantile (q in [0, 1])."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            mx = self._max
+        if total == 0:
+            return 0.0
+        rank = q * total
+        seen = 0.0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                lo = self._bounds[i - 1] if i > 0 else 0.0
+                hi = self._bounds[i] if i < len(self._bounds) else mx
+                frac = (rank - seen) / c
+                est = lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+                # An estimate can't exceed the largest observed value.
+                return min(est, mx)
+            seen += c
+        return mx
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
+
+    def summary(self) -> Dict[str, float]:
+        """{count, mean, p50, p90, p99, max} — the /metrics payload."""
+        return {
+            "count": float(self._count),
+            "mean": self.mean,
+            "p50": self.percentile(0.50),
+            "p90": self.percentile(0.90),
+            "p99": self.percentile(0.99),
+            "max": self._max,
+        }
 
 
 class ProgressMeter:

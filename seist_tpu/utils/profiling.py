@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Dict, Iterator, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional
 
 
 def trace_start(logdir: str) -> None:
@@ -39,6 +39,23 @@ def trace(logdir: str) -> Iterator[None]:
         yield
     finally:
         trace_stop()
+
+
+@contextlib.contextmanager
+def stopwatch() -> Iterator[Callable[[], float]]:
+    """``with stopwatch() as elapsed:`` — ``elapsed()`` returns seconds
+    since entry (monotonic), both inside the block and after it exits.
+    Used by serve warmup/handlers so timing reads the same everywhere."""
+    t0 = time.perf_counter()
+    done = []
+
+    def elapsed() -> float:
+        return (done[0] if done else time.perf_counter()) - t0
+
+    try:
+        yield elapsed
+    finally:
+        done.append(time.perf_counter())
 
 
 def device_memory_stats() -> List[Dict[str, float]]:

@@ -1,0 +1,431 @@
+"""serve subsystem: micro-batcher contracts (pure threads, no jax) and an
+end-to-end in-process service on a tiny phasenet.
+
+The e2e class is the ISSUE's acceptance check: N concurrent single-trace
+requests must be served by < N forwards (coalescing observable via
+/metrics), with per-task outputs identical to the offline path
+(ops/postprocess + ops/stream.annotate — what tools/predict.py runs).
+"""
+
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from seist_tpu.serve.batcher import (
+    BatcherConfig,
+    MicroBatcher,
+    default_buckets,
+)
+from seist_tpu.serve.protocol import (
+    BadRequest,
+    DeadlineExceeded,
+    QueueFull,
+    ShuttingDown,
+)
+from seist_tpu.utils.meters import LatencyHistogram
+
+
+# --------------------------------------------------------------- unit: knobs
+def test_default_buckets_powers_of_two_plus_max():
+    assert default_buckets(8) == (1, 2, 4, 8)
+    assert default_buckets(5) == (1, 2, 4, 5)
+    assert default_buckets(1) == (1,)
+
+
+def test_bad_buckets_rejected():
+    with pytest.raises(ValueError):
+        BatcherConfig(max_batch=8, buckets=(1, 2)).resolved_buckets()
+
+
+def test_bad_option_values_rejected():
+    from seist_tpu.serve.protocol import PredictOptions
+
+    for bad in (
+        {"timeout_ms": -1000},  # would become an unbounded lock wait
+        {"timeout_ms": 0},
+        {"timeout_ms": "soon"},
+        {"ppk_threshold": True},
+        {"sampling_rate": 0},
+        {"max_events": 0},
+        {"stride": -1},
+        {"combine": "median"},
+        {"norm_mode": 3},
+        {"timeout_ms": float("nan")},  # NaN passes every range check
+        {"min_peak_dist": float("inf")},
+        {"stride": 2.5},  # int field, non-integral
+        {"max_events": 8.5},
+    ):
+        with pytest.raises(BadRequest):
+            PredictOptions.from_dict(bad)
+    assert PredictOptions.from_dict({"timeout_ms": 250}).timeout_ms == 250
+
+
+def test_latency_histogram_percentiles():
+    h = LatencyHistogram()
+    for v in [1.5, 3.0, 7.0, 15.0, 40.0, 80.0, 150.0, 400.0, 900.0, 1800.0]:
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 10
+    assert 0 < s["p50"] <= s["p90"] <= s["p99"] <= s["max"] == 1800.0
+    assert h.percentile(0.0) == 0.0 or h.percentile(0.0) <= s["p50"]
+
+
+# ----------------------------------------------------------- unit: batcher
+def _make(forward, **kw):
+    return MicroBatcher(forward, BatcherConfig(**kw), name="test")
+
+
+def test_bucket_padding_and_per_item_slicing():
+    """3 concurrent requests with buckets (1,2,4): one forward at the
+    padded bucket-4 shape, each caller getting its own slice back."""
+    shapes = []
+
+    def forward(batch):
+        shapes.append(batch.shape)
+        return batch * 2.0
+
+    b = _make(forward, max_batch=4, max_delay_ms=30.0)
+    xs = [np.full((5, 3), i, np.float32) for i in range(3)]
+    with ThreadPoolExecutor(3) as ex:
+        outs = list(ex.map(lambda x: b.submit(x, timeout_ms=5000), xs))
+    assert shapes == [(4, 5, 3)]  # padded to the bucket, single forward
+    for i, out in enumerate(outs):
+        assert out.shape == (1, 5, 3)
+        np.testing.assert_allclose(out, xs[i][None] * 2.0)
+    stats = b.stats()
+    assert stats["forwards"] == 1
+    assert stats["batch_fill_ratio"] == pytest.approx(3 / 4)
+    b.shutdown()
+
+
+def test_full_batch_flushes_before_max_delay():
+    """max_batch simultaneous requests must not wait out max_delay_ms."""
+    b = _make(lambda x: x, max_batch=4, max_delay_ms=60_000.0)
+    t0 = time.monotonic()
+    with ThreadPoolExecutor(4) as ex:
+        list(ex.map(
+            lambda i: b.submit(np.zeros((2,), np.float32), timeout_ms=10_000),
+            range(4),
+        ))
+    assert time.monotonic() - t0 < 30.0  # nowhere near the 60 s delay cap
+    assert b.stats()["forwards"] == 1
+    b.shutdown()
+
+
+def test_max_delay_flushes_partial_batch():
+    """A lone request is served after ~max_delay_ms, not never."""
+    b = _make(lambda x: x, max_batch=64, max_delay_ms=20.0)
+    out = b.submit(np.ones((2,), np.float32), timeout_ms=10_000)
+    assert out.shape == (1, 2)
+    stats = b.stats()
+    assert stats["forwards"] == 1 and stats["completed"] == 1
+    b.shutdown()
+
+
+def test_tuple_outputs_sliced_per_item():
+    b = _make(lambda x: (x + 1.0, x.sum(axis=1)), max_batch=2,
+              max_delay_ms=10.0)
+    out = b.submit(np.ones((3,), np.float32), timeout_ms=5000)
+    assert isinstance(out, tuple) and out[0].shape == (1, 3)
+    np.testing.assert_allclose(out[1], [3.0])
+    b.shutdown()
+
+
+def test_deadline_expiry_while_queued():
+    """With the worker pinned on a slow forward, a short-deadline request
+    expires in the queue and raises DeadlineExceeded."""
+    release = threading.Event()
+
+    def slow_forward(batch):
+        release.wait(timeout=10.0)
+        return batch
+
+    b = _make(slow_forward, max_batch=1, max_delay_ms=1.0, max_queue=8)
+    with ThreadPoolExecutor(2) as ex:
+        first = ex.submit(
+            lambda: b.submit(np.zeros((1,), np.float32), timeout_ms=10_000)
+        )
+        time.sleep(0.1)  # worker is now inside slow_forward with request A
+        with pytest.raises(DeadlineExceeded):
+            b.submit(np.zeros((1,), np.float32), timeout_ms=100)
+        release.set()
+        assert first.result(timeout=10).shape == (1, 1)
+    assert b.stats()["expired"] >= 1
+    b.shutdown()
+
+
+def test_bounded_queue_rejects_with_queue_full():
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_forward(batch):
+        entered.set()
+        release.wait(timeout=10.0)
+        return batch
+
+    b = _make(slow_forward, max_batch=1, max_delay_ms=1.0, max_queue=2)
+    results = []
+    with ThreadPoolExecutor(4) as ex:
+        futs = [ex.submit(
+            lambda: b.submit(np.zeros((1,), np.float32), timeout_ms=10_000)
+        )]
+        assert entered.wait(timeout=5.0)  # A popped; queue now empty
+        for _ in range(2):  # B, C fill the bounded queue
+            futs.append(ex.submit(
+                lambda: b.submit(np.zeros((1,), np.float32),
+                                 timeout_ms=10_000)
+            ))
+        deadline = time.monotonic() + 5.0
+        while b.stats()["queue_depth"] < 2:
+            assert time.monotonic() < deadline, "queue never filled"
+            time.sleep(0.01)
+        with pytest.raises(QueueFull):  # D bounces
+            b.submit(np.zeros((1,), np.float32), timeout_ms=10_000)
+        release.set()
+        results = [f.result(timeout=10) for f in futs]
+    assert len(results) == 3
+    stats = b.stats()
+    assert stats["rejected"] == 1 and stats["completed"] == 3
+    b.shutdown()
+
+
+def test_shutdown_drains_queued_requests():
+    release = threading.Event()
+
+    def gated_forward(batch):
+        release.wait(timeout=10.0)
+        return batch
+
+    b = _make(gated_forward, max_batch=1, max_delay_ms=1.0, max_queue=8)
+    with ThreadPoolExecutor(3) as ex:
+        futs = [
+            ex.submit(lambda: b.submit(np.zeros((1,), np.float32),
+                                       timeout_ms=20_000))
+            for _ in range(3)
+        ]
+        time.sleep(0.1)
+        release.set()
+        b.shutdown(drain=True)  # returns once the queue is served
+        for f in futs:
+            assert f.result(timeout=10).shape == (1, 1)
+    with pytest.raises(ShuttingDown):
+        b.submit(np.zeros((1,), np.float32))
+    assert b.stats()["completed"] == 3
+
+
+def test_timeout_during_forward_counted_once():
+    """A caller abandoning mid-forward is expired, NOT also completed:
+    submitted == completed + expired + rejected + failed must hold."""
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_forward(batch):
+        entered.set()
+        release.wait(timeout=10.0)
+        return batch
+
+    b = _make(slow_forward, max_batch=1, max_delay_ms=1.0)
+    with pytest.raises(DeadlineExceeded):
+        b.submit(np.zeros((1,), np.float32), timeout_ms=150)
+    assert entered.is_set()  # the worker had collected the request
+    release.set()
+    b.shutdown(drain=True)
+    stats = b.stats()
+    assert stats["submitted"] == 1
+    assert stats["expired"] == 1
+    assert stats["completed"] == 0  # not double-counted
+    assert stats["submitted"] == (
+        stats["completed"] + stats["expired"]
+        + stats["rejected"] + stats["failed"]
+    )
+
+
+def test_forward_failure_propagates_not_kills_worker():
+    calls = []
+
+    def flaky(batch):
+        calls.append(batch.shape[0])
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+        return batch
+
+    b = _make(flaky, max_batch=1, max_delay_ms=1.0)
+    from seist_tpu.serve.protocol import ServeError
+
+    with pytest.raises(ServeError):
+        b.submit(np.zeros((1,), np.float32), timeout_ms=5000)
+    # Worker survived; next request succeeds.
+    out = b.submit(np.zeros((1,), np.float32), timeout_ms=5000)
+    assert out.shape == (1, 1)
+    b.shutdown()
+
+
+# ------------------------------------------------------------ e2e: service
+WINDOW = 256
+N_CONCURRENT = 6
+
+
+@pytest.fixture(scope="module")
+def service():
+    from seist_tpu.serve import BatcherConfig as BC
+    from seist_tpu.serve import ModelPool, ServeService
+
+    pool = ModelPool([("phasenet", "")], window=WINDOW)
+    svc = ServeService(
+        pool, BC(max_batch=4, max_delay_ms=25.0, max_queue=32)
+    )
+    yield svc
+    svc.shutdown()
+
+
+@pytest.fixture(scope="module")
+def traces():
+    rng = np.random.default_rng(0)
+    return [
+        rng.standard_normal((WINDOW, 3)).astype(np.float32)
+        for _ in range(N_CONCURRENT)
+    ]
+
+
+class TestServiceEndToEnd:
+    def test_concurrent_requests_coalesce_into_fewer_forwards(
+        self, service, traces
+    ):
+        before = service.metrics()["models"]["phasenet"]["forwards"]
+        opts = {"ppk_threshold": 0.05, "spk_threshold": 0.05}
+        with ThreadPoolExecutor(N_CONCURRENT) as ex:
+            results = list(ex.map(
+                lambda t: service.predict(t.tolist(), options=opts), traces
+            ))
+        assert len(results) == N_CONCURRENT
+        stats = service.metrics()["models"]["phasenet"]
+        forwards = stats["forwards"] - before
+        assert 0 < forwards < N_CONCURRENT  # the acceptance criterion
+        assert stats["completed"] >= N_CONCURRENT
+        assert 0 < stats["batch_fill_ratio"] <= 1.0
+        assert stats["latency_ms"]["count"] >= N_CONCURRENT
+
+    def test_predict_matches_offline_postprocess(self, service, traces):
+        """Serve output == the offline path (normalize -> forward ->
+        ops/postprocess.process_outputs) on the same input."""
+        from seist_tpu.data.preprocess import normalize
+        from seist_tpu.ops.postprocess import process_outputs
+        from seist_tpu.serve.protocol import PredictOptions
+
+        entry = service.pool.get("phasenet")
+        opts = PredictOptions(ppk_threshold=0.05, spk_threshold=0.05)
+        for trace in traces[:2]:
+            served = service.predict(
+                trace.tolist(),
+                options={"ppk_threshold": 0.05, "spk_threshold": 0.05},
+            )
+            x = np.asarray(normalize(trace, "std", axis=0), np.float32)
+            raw = entry.forward(x[None])
+            offline = process_outputs(
+                raw,
+                entry.spec.labels,
+                opts.sampling_rate,
+                ppk_threshold=opts.ppk_threshold,
+                spk_threshold=opts.spk_threshold,
+                det_threshold=opts.det_threshold,
+                min_peak_dist=opts.min_peak_dist,
+                max_detect_event_num=opts.max_events,
+            )
+            for kind in ("ppk", "spk"):
+                want = np.asarray(offline[kind])[0]
+                want = [int(i) for i in want[want >= 0]]
+                got = [p["sample"] for p in served[kind]]
+                assert got == want
+
+    def test_annotate_matches_offline_stream(self, service):
+        """/annotate == direct ops/stream.annotate with the same warm
+        forward — the tools/predict.py code path."""
+        from seist_tpu.ops.stream import annotate
+
+        rng = np.random.default_rng(1)
+        record = rng.standard_normal((700, 3)).astype(np.float32)
+        entry = service.pool.get("phasenet")
+        served = service.annotate(
+            record.tolist(),
+            options={"ppk_threshold": 0.05, "det_threshold": 0.05},
+        )
+        offline = annotate(
+            entry.forward,
+            record,
+            window=WINDOW,
+            batch_size=service.buckets[-1],
+            ppk_threshold=0.05,
+            det_threshold=0.05,
+            combine="max",
+            channel0=entry.channel0,
+            jitted=True,
+        )
+        assert [p["sample"] for p in served["ppk"]] == [
+            int(i) for i in offline["ppk"]
+        ]
+        assert [p["sample"] for p in served["spk"]] == [
+            int(i) for i in offline["spk"]
+        ]
+        assert served["windows"] > 1
+
+    def test_short_trace_padded_long_trace_rejected(self, service):
+        rng = np.random.default_rng(2)
+        short = service.predict(
+            rng.standard_normal((WINDOW // 2, 3)).astype(np.float32).tolist(),
+            options={"ppk_threshold": 0.05},
+        )
+        assert short["task"] == "picking"
+        # Nothing decoded from the zero-padding the client never sent.
+        for kind in ("ppk", "spk"):
+            assert all(p["sample"] < WINDOW // 2 for p in short[kind])
+        assert all(
+            d["onset"] < WINDOW // 2 and d["offset"] < WINDOW // 2
+            for d in short.get("det", [])
+        )
+        with pytest.raises(BadRequest):
+            service.predict(
+                rng.standard_normal((WINDOW * 2, 3)).tolist()
+            )
+
+    def test_http_roundtrip(self, service):
+        import http.client
+
+        from seist_tpu.serve import start_http_server
+
+        server = start_http_server(service, port=0)
+        host, port = server.server_address[:2]
+        try:
+            def call(method, path, payload=None):
+                conn = http.client.HTTPConnection(host, port, timeout=120)
+                body = json.dumps(payload) if payload is not None else None
+                conn.request(method, path, body)
+                resp = conn.getresponse()
+                out = json.loads(resp.read())
+                conn.close()
+                return resp.status, out
+
+            rng = np.random.default_rng(3)
+            trace = rng.standard_normal((3, WINDOW)).tolist()  # (C, L) ok
+            status, out = call("POST", "/predict", {
+                "data": trace, "options": {"ppk_threshold": 0.05},
+            })
+            assert status == 200 and out["model"] == "phasenet"
+            status, out = call("GET", "/healthz")
+            assert status == 200 and out["status"] == "ok"
+            status, out = call("GET", "/metrics")
+            assert status == 200 and "phasenet" in out["models"]
+            assert out["models"]["phasenet"]["latency_ms"]["count"] > 0
+            status, out = call("POST", "/predict", {"data": [[1, 2], [3, 4]]})
+            assert status == 400 and out["error"] == "bad_request"
+            status, out = call("POST", "/predict", {
+                "model": "nope", "data": trace,
+            })
+            assert status == 404 and out["error"] == "unknown_model"
+            status, _ = call("GET", "/nope")
+            assert status == 404
+        finally:
+            server.shutdown()

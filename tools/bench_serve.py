@@ -1,0 +1,124 @@
+"""Serving latency/throughput bench -> BENCH-style one-line JSON.
+
+Drives the in-process ServeService (no sockets — measures batching +
+forward + decode, not loopback TCP) with a closed-loop client pool, then
+reports client-observed latency percentiles, throughput and the
+batch-fill ratio from /metrics:
+
+    python tools/bench_serve.py --model-name phasenet --window 256 \
+        --requests 64 --concurrency 8 [--checkpoint CKPT] \
+        [--output BENCH_serve.json]
+
+Emits {"metric": "serve_predict_latency", "p50_ms": ..., "p99_ms": ...,
+"throughput_rps": ..., "batch_fill_ratio": ...} — the same trajectory
+shape as the BENCH_*.json training numbers. `make serve-smoke` runs a
+small CPU configuration of exactly this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from datetime import datetime, timezone
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="serve micro-batching bench")
+    ap.add_argument("--model-name", default="phasenet")
+    ap.add_argument("--checkpoint", default="",
+                    help="optional; fresh-init weights when omitted")
+    ap.add_argument("--window", type=int, default=256)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-delay-ms", type=float, default=10.0)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--timeout-ms", type=float, default=60_000.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--output", default="", help="also write JSON here")
+    args = ap.parse_args()
+
+    from seist_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
+    import numpy as np
+
+    from seist_tpu.serve import BatcherConfig, ModelPool, ServeService
+    from seist_tpu.utils.profiling import stopwatch
+
+    pool = ModelPool(
+        [(args.model_name, args.checkpoint)], window=args.window,
+        seed=args.seed,
+    )
+    service = ServeService(
+        pool,
+        BatcherConfig(
+            max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms,
+            max_queue=args.max_queue,
+        ),
+    )
+    entry = pool.get(args.model_name)
+    rng = np.random.default_rng(args.seed)
+    traces = [
+        rng.standard_normal((args.window, entry.in_channels))
+        .astype(np.float32).tolist()
+        for _ in range(min(args.requests, 32))  # cycle a small pool
+    ]
+    options = {"timeout_ms": args.timeout_ms}
+    if entry.is_picker:
+        options.update(ppk_threshold=0.05, spk_threshold=0.05)
+
+    latencies_ms = []
+
+    def one(i: int) -> None:
+        with stopwatch() as elapsed:
+            service.predict(traces[i % len(traces)], options=options)
+        latencies_ms.append(elapsed() * 1000.0)
+
+    with stopwatch() as wall:
+        with ThreadPoolExecutor(args.concurrency) as ex:
+            list(ex.map(one, range(args.requests)))
+    service.shutdown()
+
+    lat = np.asarray(latencies_ms)
+    stats = service.metrics()["models"][args.model_name]
+    import jax
+
+    result = {
+        "metric": "serve_predict_latency",
+        "model": args.model_name,
+        "window": args.window,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "max_batch": args.max_batch,
+        "max_delay_ms": args.max_delay_ms,
+        "p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "p90_ms": round(float(np.percentile(lat, 90)), 3),
+        "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "mean_ms": round(float(lat.mean()), 3),
+        "throughput_rps": round(args.requests / wall(), 2),
+        "batch_fill_ratio": round(stats["batch_fill_ratio"], 4),
+        "forwards": stats["forwards"],
+        "completed": stats["completed"],
+        "device": jax.devices()[0].device_kind,
+        "measured_at": datetime.now(timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ"
+        ),
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -52,9 +52,8 @@ def main() -> None:
 
     import seist_tpu
     from seist_tpu import taskspec
-    from seist_tpu.models import api
     from seist_tpu.ops.stream import annotate
-    from seist_tpu.train.checkpoint import load_checkpoint
+    from seist_tpu.serve.pool import load_model_entry
 
     seist_tpu.load_all()
 
@@ -80,21 +79,16 @@ def main() -> None:
     if record.shape[0] < record.shape[1]:  # (C, L) -> (L, C)
         record = record.T
 
-    in_channels = taskspec.get_num_inchannels(args.model_name)
-    model = api.create_model(
-        args.model_name, in_channels=in_channels, in_samples=args.window
+    # Checkpoint loading/warm-up logic lives in the serve model pool —
+    # offline CLI and online service share exactly one loader.
+    entry = load_model_entry(
+        args.model_name, args.checkpoint, window=args.window
     )
-    restored = load_checkpoint(args.checkpoint)
-    variables = {"params": restored["params"]}
-    if restored.get("batch_stats"):
-        variables["batch_stats"] = restored["batch_stats"]
-
-    def apply_fn(x):
-        return model.apply(variables, x, train=False)
 
     picks = annotate(
-        apply_fn,
+        entry.forward,
         record,
+        jitted=True,  # entry.forward is already jax.jit'd by the pool
         window=args.window,
         stride=args.stride or None,
         batch_size=args.batch_size,
