@@ -4,7 +4,7 @@ NATIVE_DIR := seist_tpu/native
 CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
-.PHONY: native test serve-smoke clean
+.PHONY: native test t1 serve-smoke clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -13,6 +13,18 @@ $(NATIVE_DIR)/libwavekit.so: $(NATIVE_DIR)/wavekit.cpp
 
 test:
 	python -m pytest tests/ -x -q
+
+# Tier-1 verify: the exact line from ROADMAP.md (fast lane, CPU backend,
+# slow-marked kill/resume e2e excluded). Prints DOTS_PASSED for the driver.
+t1: SHELL := /bin/bash
+t1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
 
 # Checkpoint-free serving smoke: warm-compile, micro-batch 24 requests,
 # print a BENCH-style latency/throughput/fill-ratio JSON line.

@@ -155,6 +155,29 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--optim", default="Adam", type=str)
     parser.add_argument("--momentum", default=0.9, type=float)
     parser.add_argument("--weight_decay", default=0.0, type=float)
+    parser.add_argument("--save-interval-steps", default=0, type=int,
+                        dest="save_interval_steps",
+                        help="step-granular async checkpoints every N "
+                        "batches (orbax CheckpointManager; resume continues "
+                        "mid-epoch at the exact data position). 0 = only "
+                        "the best-val epoch checkpoints. A preemption "
+                        "loses at most N batches of work")
+    parser.add_argument("--keep-checkpoints", default=3, type=int,
+                        dest="keep_checkpoints",
+                        help="checkpoint retention: keep the last K step "
+                        "checkpoints plus the best-val one; older ones are "
+                        "GC'd (logged). Default 3")
+    parser.add_argument("--bad-step-guard", default=True, type=bool_,
+                        dest="bad_step_guard",
+                        help="detect non-finite loss/grad-norm inside the "
+                        "jitted step and skip the poisoned update (params, "
+                        "optimizer state and LR-schedule step untouched). "
+                        "Default true")
+    parser.add_argument("--max-bad-steps", default=3, type=int,
+                        dest="max_bad_steps",
+                        help="consecutive guard-skipped updates before "
+                        "rolling back to the last checkpoint. 0 disables "
+                        "rollback (skips only). Default 3")
     parser.add_argument("--use-lr-scheduler", default=True, type=bool_)
     parser.add_argument("--lr-scheduler-mode", default="exp_range", type=str,
                         help="'triangular', 'triangular2' or 'exp_range'")

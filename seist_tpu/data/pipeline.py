@@ -225,12 +225,24 @@ class Loader:
         self.num_shards = num_shards
         self.shard_index = shard_index
         self.epoch = 0
+        self._start_batch = 0
         self._pool: Optional[ThreadPoolExecutor] = None
         self._proc_pool = None
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = int(epoch)
         self.dataset.set_epoch(epoch)
+
+    def set_start_batch(self, start_batch: int) -> None:
+        """Begin the NEXT ``__iter__`` at batch ``start_batch`` instead of
+        0 (one-shot; subsequent epochs start at 0 again). This is the
+        mid-epoch resume hook: the shuffle order is a pure function of
+        (seed, epoch), so a restored (epoch, batch_offset) position
+        continues the exact same sample sequence an uninterrupted run
+        would have seen — no replayed and no skipped data."""
+        if start_batch < 0:
+            raise ValueError(f"start_batch must be >= 0, got {start_batch}")
+        self._start_batch = int(start_batch)
 
     def close(self) -> None:
         """Release the worker pool(s). Safe to call multiple times; the
@@ -333,7 +345,8 @@ class Loader:
     def __iter__(self) -> Iterator[Batch]:
         indices = self._indices()
         nb = len(self)
-        for b in range(nb):
+        start, self._start_batch = self._start_batch, 0  # one-shot
+        for b in range(start, nb):
             chunk = indices[b * self.batch_size : (b + 1) * self.batch_size]
             pad = self.batch_size - len(chunk)
             if pad:

@@ -114,8 +114,14 @@ class MicroBatcher:
         self.buckets = self.config.resolved_buckets()
         self.name = name
         self._queue: List[_Pending] = []
+        self._inflight: List[_Pending] = []
         self._cond = threading.Condition()
         self._stopping = False
+        # Set when the flush loop itself dies (not a per-request forward
+        # error — those are caught in _run_batch). A dead flush thread
+        # means every future submit would hang to its deadline; the server
+        # watchdog (serve/server.py) polls `healthy` and exits non-zero.
+        self._fatal: Optional[BaseException] = None
         # Counters (guarded by self._cond's lock):
         self._submitted = 0
         self._rejected = 0
@@ -138,6 +144,11 @@ class MicroBatcher:
         t0 = time.monotonic()
         item = _Pending(np.asarray(x), deadline=t0 + timeout_ms / 1000.0)
         with self._cond:
+            if self._fatal is not None:
+                raise ServeError(
+                    f"batcher {self.name} flush thread died: "
+                    f"{self._fatal!r}"
+                )
             if self._stopping:
                 raise ShuttingDown(f"batcher {self.name} is draining")
             if len(self._queue) >= self.config.max_queue:
@@ -171,6 +182,26 @@ class MicroBatcher:
 
     # ---------------------------------------------------------- worker
     def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except BaseException as e:  # noqa: BLE001 — record, fail fast
+            with self._cond:
+                self._fatal = e
+                err = ServeError(f"batcher {self.name} flush thread died: {e!r}")
+                # Fail everyone still waiting: the queued AND the
+                # already-dequeued in-flight batch — their callers would
+                # otherwise block to their full deadline on a thread that
+                # no longer exists. Items whose event is already set got
+                # a real result (or error) from _run_batch before the
+                # crash; don't clobber it.
+                for item in self._queue + self._inflight:
+                    if not item.event.is_set():
+                        item.error = err
+                        item.event.set()
+                self._queue.clear()
+                self._inflight = []
+
+    def _loop_inner(self) -> None:
         while True:
             with self._cond:
                 while True:
@@ -192,7 +223,10 @@ class MicroBatcher:
                 take = min(len(self._queue), self.config.max_batch)
                 pending = self._queue[:take]
                 del self._queue[:take]
+                self._inflight = pending
             self._run_batch(pending)
+            with self._cond:
+                self._inflight = []
 
     def _run_batch(self, pending: List[_Pending]) -> None:
         now = time.monotonic()
@@ -269,11 +303,21 @@ class MicroBatcher:
             self._cond.notify_all()
         self._thread.join(timeout=timeout_s)
 
-    def stats(self) -> Dict[str, Any]:
+    @property
+    def healthy(self) -> bool:
+        """False once the flush thread has died (fatal error or silent
+        thread exit) — the liveness signal for the server watchdog."""
         with self._cond:
+            if self._fatal is not None:
+                return False
+            return self._stopping or self._thread.is_alive()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:  # Condition wraps an RLock: `healthy` can re-enter
             slots = self._batch_slots
             return {
                 "queue_depth": len(self._queue),
+                "healthy": self.healthy,
                 "submitted": self._submitted,
                 "completed": self._completed,
                 "rejected": self._rejected,

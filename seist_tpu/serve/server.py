@@ -64,11 +64,11 @@ class ServeService:
         self,
         pool: ModelPool,
         batcher_config: Optional[BatcherConfig] = None,
+        warmup_async: bool = False,
     ):
         self.pool = pool
         self.config = batcher_config or BatcherConfig()
         self.buckets = self.config.resolved_buckets()
-        pool.warmup(self.buckets)
         self._batchers: Dict[str, MicroBatcher] = {}
         for name in pool.names():
             entry = pool.get(name)
@@ -87,6 +87,34 @@ class ServeService:
         self._annotate_windows = 0
         self._started_at = time.time()
         self._draining = False
+        # Readiness gate: /healthz/ready reports 503 while the pool is
+        # still pre-compiling (warmup_async=True lets the HTTP socket come
+        # up first so orchestrators can probe during the compile) and
+        # during SIGTERM drain. Requests arriving while warming are still
+        # served — they just pay the compile — so readiness is advisory,
+        # exactly what a load balancer wants.
+        self._warming = True
+        self._warmup_error: Optional[BaseException] = None
+        if warmup_async:
+            threading.Thread(
+                target=self._run_warmup, name="serve-warmup", daemon=True
+            ).start()
+        else:
+            self._run_warmup()
+            if self._warmup_error is not None:
+                raise self._warmup_error  # sync path keeps crashing loudly
+
+    def _run_warmup(self) -> None:
+        try:
+            self.pool.warmup(self.buckets)
+            self._warming = False
+        except BaseException as e:  # noqa: BLE001
+            # A failed warm-up (compile OOM, bad bucket, XLA error) must
+            # never flip the service to ready: record it so liveness goes
+            # false and the watchdog exits non-zero — the async
+            # equivalent of the sync path's crash.
+            self._warmup_error = e
+            logger.warning(f"[serve] warm-up failed: {e!r}")
 
     # ----------------------------------------------------------- predict
     def predict(
@@ -211,9 +239,33 @@ class ServeService:
         }
 
     # ------------------------------------------------------ health/metrics
+    def alive(self) -> bool:
+        """Liveness: warm-up didn't fail and every batcher flush thread
+        is still running. Neither condition can recover — the server
+        watchdog exits non-zero on this so the orchestrator restarts the
+        process instead of leaving a zombie that black-holes requests."""
+        return self._warmup_error is None and all(
+            b.healthy for b in self._batchers.values()
+        )
+
+    def ready(self) -> bool:
+        """Readiness: alive, warm-compiled, and not draining."""
+        return self.alive() and not self._warming and not self._draining
+
+    def _state_str(self) -> str:
+        if not self.alive():
+            return "dead"
+        if self._draining:
+            return "draining"
+        if self._warming:
+            return "warming"
+        return "ok"
+
     def healthz(self) -> Dict[str, Any]:
         return {
-            "status": "draining" if self._draining else "ok",
+            "status": self._state_str(),
+            "live": self.alive(),
+            "ready": self.ready(),
             "models": self.pool.names(),
             "buckets": list(self.buckets),
             "uptime_s": round(time.time() - self._started_at, 3),
@@ -238,6 +290,13 @@ class ServeService:
         }
 
     # ----------------------------------------------------------- shutdown
+    def begin_drain(self) -> None:
+        """Flip to not-ready (new /predict //annotate get 503, readiness
+        probe fails) without yet stopping the batchers — the signal
+        handler calls this so in-flight work finishes while the load
+        balancer routes away."""
+        self._draining = True
+
     def shutdown(self, drain: bool = True) -> None:
         """Refuse new work, then (with ``drain``) serve what's queued."""
         self._draining = True
@@ -306,7 +365,21 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         try:
             if self.path == "/healthz":
+                # Combined report (back-compat); always 200 while the
+                # process can answer at all.
                 self._reply(200, self.service.healthz())
+            elif self.path == "/healthz/live":
+                live = self.service.alive()
+                self._reply(
+                    200 if live else 503,
+                    {"status": "ok" if live else "dead"},
+                )
+            elif self.path == "/healthz/ready":
+                ready = self.service.ready()
+                self._reply(
+                    200 if ready else 503,
+                    {"status": self.service._state_str(), "ready": ready},
+                )
             elif self.path == "/metrics":
                 self._reply(200, self.service.metrics())
             else:
@@ -409,6 +482,32 @@ def parse_model_flags(args: argparse.Namespace) -> List[Tuple[str, str]]:
     return entries
 
 
+def watch_until_shutdown(
+    service: ServeService,
+    stop: "threading.Event",
+    poll_s: float = 0.5,
+) -> int:
+    """Main-thread watchdog: block until ``stop`` (graceful shutdown) or
+    a batcher flush thread dies. Returns the process exit code — 0 for a
+    clean drain, 1 for a dead batcher. The non-zero exit is the point: a
+    server whose flush thread died would otherwise sit silently while
+    every request times out, and no orchestrator would restart it."""
+    while not stop.is_set():
+        if not service.alive():
+            sick = [
+                n for n, b in service._batchers.items() if not b.healthy
+            ]
+            reason = (
+                f"batcher flush thread(s) died: {sick}"
+                if sick
+                else f"warm-up failed: {service._warmup_error!r}"
+            )
+            logger.warning(f"[serve] {reason}; exiting 1")
+            return 1
+        stop.wait(poll_s)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     from seist_tpu.utils.platform import honor_jax_platforms
 
@@ -426,8 +525,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         ),
     )
     pool = ModelPool(entries, window=args.window, seed=args.seed)
-    service = ServeService(pool, config)
-    server = ServeHTTPServer((args.host, args.port), service)
+    # Async warm-up: the socket (and /healthz/ready, reporting 503
+    # "warming") comes up immediately; orchestrators gate traffic on
+    # readiness instead of timing out their liveness probe on the compile.
+    service = ServeService(pool, config, warmup_async=True)
+    server = start_http_server(service, args.host, args.port)
     host, port = server.server_address[:2]
     logger.info(
         f"[serve] listening on http://{host}:{port} "
@@ -436,21 +538,28 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     import signal
 
-    # Containers stop with SIGTERM; turn it into the same graceful drain
-    # as Ctrl-C. shutdown() must run off the serve_forever thread.
+    stop = threading.Event()
+
+    # Containers stop with SIGTERM; flip to not-ready first so the load
+    # balancer routes away, then drain what's queued.
     def _term(signum, frame):
-        threading.Thread(target=server.shutdown, daemon=True).start()
+        service.begin_drain()
+        stop.set()
 
     signal.signal(signal.SIGTERM, _term)
-    try:
-        server.serve_forever()
-        logger.info("[serve] draining...")  # SIGTERM path
-    except KeyboardInterrupt:
+    signal.signal(signal.SIGINT, _term)
+    rc = watch_until_shutdown(service, stop)
+    if rc == 0:
         logger.info("[serve] draining...")
-    finally:
-        server.shutdown()
         service.shutdown(drain=True)
+        server.shutdown()
         logger.info("[serve] stopped")
+    else:
+        server.shutdown()
+        service.shutdown(drain=False)
+        logger.info("[serve] stopped (unhealthy)")
+    if rc:
+        raise SystemExit(rc)
 
 
 if __name__ == "__main__":

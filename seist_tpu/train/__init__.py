@@ -1,6 +1,8 @@
 """Training engine: schedules, optimizers, jitted steps, checkpointing."""
 
 from seist_tpu.train.checkpoint import (  # noqa: F401
+    PREEMPT_EXIT_CODE,
+    TrainCheckpointManager,
     load_checkpoint,
     restore_into_state,
     save_checkpoint,

@@ -19,6 +19,7 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from seist_tpu.taskspec import TaskSpec
@@ -68,8 +69,40 @@ def _forward_loss(spec: TaskSpec, loss_fn: Callable, cdtype, apply_fn) -> Callab
     return compute
 
 
+def _guarded_update(state: TrainState, grads, loss, new_stats):
+    """Apply the gradient update only when loss AND global grad-norm are
+    finite; otherwise return ``state`` unchanged (params, opt_state, BN
+    stats, and ``step`` all keep their pre-update values, so a skipped
+    step does not advance the LR schedule).
+
+    Multi-host agreement: by the time this runs, ``grads`` have already
+    been all-reduced over the mesh's ``data`` axis (XLA emits the
+    collective for the batch-sharded backward), so the finite flag is
+    computed from values that are bit-identical on every host — the
+    gradient all-reduce IS the cross-host agreement, and no worker can
+    take the skip branch while another applies the update.
+
+    Returns ``(state, diag)`` with ``diag = {"applied": i32 0/1,
+    "grad_norm": f32}``.
+    """
+    grad_norm = optax.global_norm(grads)
+    finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+    updated = state.apply_gradients(grads=grads)
+    if new_stats is not None:
+        updated = updated.replace(batch_stats=cast_to_float32(new_stats))
+    # NaN grads make NaN optimizer moments; jnp.where discards the whole
+    # poisoned update in one pass over the state pytree.
+    state = jax.tree.map(
+        lambda n, o: jnp.where(finite, n, o), updated, state
+    )
+    return state, {"applied": finite.astype(jnp.int32), "grad_norm": grad_norm}
+
+
 def make_train_step(
-    spec: TaskSpec, loss_fn: Callable, compute_dtype: Optional[str] = None
+    spec: TaskSpec,
+    loss_fn: Callable,
+    compute_dtype: Optional[str] = None,
+    guard: bool = False,
 ) -> Callable:
     """Build ``train_step(state, inputs, targets, rng) -> (state, loss, outputs)``.
 
@@ -80,6 +113,12 @@ def make_train_step(
     master params, optimizer, BN stats, softmax, loss — see
     train/precision.py); gradients flow through the cast back to the fp32
     params, so the optimizer update is full precision.
+
+    ``guard=True`` adds the bad-update guard (:func:`_guarded_update`):
+    the step then returns ``(state, loss, outputs, diag)`` where a
+    non-finite loss or gradient norm leaves the state untouched and
+    ``diag["applied"] == 0``. The returned ``loss`` is the raw (possibly
+    non-finite) value so callers can log what happened.
     """
     cdtype = resolve_dtype(compute_dtype)
 
@@ -89,6 +128,9 @@ def make_train_step(
         (loss, (outputs, new_stats)), grads = jax.value_and_grad(
             fwd, has_aux=True
         )(state.params, state.batch_stats, inputs, targets, step_rng)
+        if guard:
+            state, diag = _guarded_update(state, grads, loss, new_stats)
+            return state, loss, outputs, diag
         state = state.apply_gradients(grads=grads)
         if new_stats is not None:
             state = state.replace(batch_stats=cast_to_float32(new_stats))
@@ -102,6 +144,7 @@ def make_multi_train_step(
     loss_fn: Callable,
     compute_dtype: Optional[str] = None,
     steps_per_call: int = 1,
+    guard: bool = False,
 ) -> Callable:
     """Build a step that runs ``steps_per_call`` optimizer updates inside ONE
     jitted program via ``lax.scan`` over stacked micro-batches.
@@ -132,8 +175,35 @@ def make_multi_train_step(
     replicated)``.
     """
     if steps_per_call <= 1:
-        return make_train_step(spec, loss_fn, compute_dtype)
-    base = make_train_step(spec, loss_fn, compute_dtype)
+        return make_train_step(spec, loss_fn, compute_dtype, guard=guard)
+    base = make_train_step(spec, loss_fn, compute_dtype, guard=guard)
+
+    if guard:
+        # Each scanned micro-update carries its own finite check; the call
+        # reports the per-micro-step applied MASK (ordered — the worker's
+        # consecutive-bad tracking needs to know whether skips were
+        # trailing), and the mean loss is taken over the finite
+        # micro-steps only (all-skipped -> NaN, which the worker logs but
+        # never feeds back into params).
+        def guarded_multi_step(state: TrainState, inputs_k, targets_k, rng):
+            def body(st, batch):
+                x, y = batch
+                st, loss, _, diag = base(st, x, y, rng)
+                return st, (loss, diag["applied"])
+
+            state, (losses, applied) = jax.lax.scan(
+                body, state, (inputs_k, targets_k)
+            )
+            n_ok = applied.sum()
+            mean_loss = jnp.where(
+                n_ok > 0,
+                jnp.where(applied > 0, losses, 0.0).sum()
+                / jnp.maximum(n_ok, 1).astype(losses.dtype),
+                jnp.float32(jnp.nan),
+            )
+            return state, mean_loss, None, {"applied": applied}
+
+        return guarded_multi_step
 
     def multi_step(state: TrainState, inputs_k, targets_k, rng):
         def body(st, batch):
@@ -152,6 +222,7 @@ def make_accum_train_step(
     loss_fn: Callable,
     compute_dtype: Optional[str] = None,
     accum_steps: int = 1,
+    guard: bool = False,
 ) -> Callable:
     """Build ONE optimizer update from ``accum_steps`` micro-batch
     gradients, scanned inside a single jitted program.
@@ -182,7 +253,7 @@ def make_accum_train_step(
       schedules see update counts, not micro-step counts.
     """
     if accum_steps <= 1:
-        return make_train_step(spec, loss_fn, compute_dtype)
+        return make_train_step(spec, loss_fn, compute_dtype, guard=guard)
     cdtype = resolve_dtype(compute_dtype)
 
     def accum_step(state: TrainState, inputs_k, targets_k, rng):
@@ -208,10 +279,19 @@ def make_accum_train_step(
             body, carry0, (inputs_k, targets_k)
         )
         grads = jax.tree.map(lambda g: g / accum_steps, grads_sum)
+        mean_loss = loss_sum / accum_steps
+        if guard:
+            # One NaN micro-batch poisons the summed gradient (and the
+            # chained BN stats), so the finite check on the mean covers
+            # every micro-step: skip the whole accumulated update.
+            state, diag = _guarded_update(
+                state, grads, mean_loss, stats if has_stats else None
+            )
+            return state, mean_loss, None, diag
         state = state.apply_gradients(grads=grads)
         if has_stats:
             state = state.replace(batch_stats=stats)
-        return state, loss_sum / accum_steps, None
+        return state, mean_loss, None
 
     return accum_step
 

@@ -14,8 +14,12 @@ jitted step over a device mesh:
 
 from __future__ import annotations
 
+import collections
 import json
 import os
+import signal
+import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -28,6 +32,8 @@ from seist_tpu.models import api
 from seist_tpu.ops import Metrics, ResultSaver, process_outputs
 from seist_tpu.parallel import mesh as mesh_lib
 from seist_tpu.train import (
+    PREEMPT_EXIT_CODE,
+    TrainCheckpointManager,
     build_cyclic_schedule,
     build_optimizer,
     create_train_state,
@@ -40,8 +46,8 @@ from seist_tpu.train import (
     make_multi_train_step,
     make_train_step,
     restore_into_state,
-    save_checkpoint,
 )
+from seist_tpu.utils import faults as faults_lib
 from seist_tpu.utils import profiling
 from seist_tpu.utils.logger import logger
 from seist_tpu.utils.meters import AverageMeter, ProgressMeter
@@ -51,6 +57,115 @@ from seist_tpu.utils.tb import ScalarWriter
 
 def is_main_process() -> bool:
     return jax.process_index() == 0
+
+
+class _PreemptionHandler:
+    """SIGTERM -> checkpoint-at-next-step-boundary -> exit(75).
+
+    The handler only flips a flag; the train loop polls it at step
+    boundaries (between jitted dispatches), saves a final checkpoint, and
+    exits with :data:`~seist_tpu.train.checkpoint.PREEMPT_EXIT_CODE` so
+    tools/supervise.py relaunches immediately without burning its retry
+    budget. Cluster managers deliver SIGTERM to every host's process, so
+    the collective orbax save finds all participants.
+
+    Install/uninstall is a context manager; outside the main thread (e.g.
+    a test harness driving train_worker from a worker thread) signal
+    handlers cannot be installed and the guard degrades to inert.
+    """
+
+    def __init__(self):
+        self.triggered = False
+        self._prev = None
+        self._installed = False
+
+    def __enter__(self) -> "_PreemptionHandler":
+        if threading.current_thread() is threading.main_thread():
+            def _on_term(signum, frame):
+                self.triggered = True
+                logger.warning(
+                    "SIGTERM received: will checkpoint at the next step "
+                    f"boundary and exit {PREEMPT_EXIT_CODE}"
+                )
+            self._prev = signal.signal(signal.SIGTERM, _on_term)
+            self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            signal.signal(signal.SIGTERM, self._prev)
+            self._installed = False
+
+
+class _BadUpdateMonitor:
+    """Host-side consecutive-skipped-update tracking for the bad-update
+    guard (train/step.py ``guard=True``).
+
+    Fetching the per-step finite flag immediately would serialize JAX's
+    async dispatch (the same stall the worker avoids for losses), so
+    flags are evaluated ``lag`` calls late: by then the device has long
+    finished that step and the host read costs nothing. The rollback
+    decision is therefore delayed by at most ``lag`` extra bad updates —
+    all of which the guard already prevented from touching the params.
+
+    Every host computes the same flags (they derive from the all-reduced
+    gradients), so rollback decisions cannot diverge across hosts.
+    """
+
+    def __init__(self, max_bad: int, lag: int = 2):
+        self.max_bad = int(max_bad)
+        self.lag = max(0, int(lag))
+        self.bad_run = 0  # consecutive skipped updates at the tail
+        self.total_skipped = 0
+        self._pending: "collections.deque" = collections.deque()
+
+    def push(self, applied_dev) -> bool:
+        """Queue one call's applied flag (scalar 0/1) or per-micro-step
+        applied mask (ordered (k,) array from the scanned paths); returns
+        True when the consecutive-bad run has reached ``max_bad``
+        (rollback needed)."""
+        self._pending.append(applied_dev)
+        while len(self._pending) > self.lag:
+            self._eval(self._pending.popleft())
+        return self.exceeded
+
+    def flush(self) -> bool:
+        while self._pending:
+            self._eval(self._pending.popleft())
+        return self.exceeded
+
+    def reset(self) -> None:
+        self.bad_run = 0
+        self._pending.clear()
+
+    @property
+    def exceeded(self) -> bool:
+        return bool(self.max_bad) and self.bad_run >= self.max_bad
+
+    def _eval(self, applied_dev) -> None:
+        mask = np.atleast_1d(np.asarray(jax.device_get(applied_dev)))
+        skipped = int(mask.size - mask.sum())
+        self.total_skipped += skipped
+        if skipped == 0:
+            self.bad_run = 0
+        else:
+            # Only the TRAILING skips extend a consecutive run: a call
+            # ending in a successful update (e.g. [skip, skip, ok] on the
+            # packed paths) breaks the run regardless of earlier skips.
+            trailing = 0
+            for v in mask[::-1]:
+                if v:
+                    break
+                trailing += 1
+            if trailing == mask.size:
+                self.bad_run += trailing
+            else:
+                self.bad_run = trailing
+        if skipped > 0:
+            logger.warning(
+                f"Bad-update guard: skipped {skipped} non-finite update(s) "
+                f"(consecutive run: {self.bad_run})"
+            )
 
 
 def _build_loader(args: Any, spec: taskspec.TaskSpec, mode: str) -> pipeline.Loader:
@@ -328,16 +443,61 @@ def train_worker(args: Any) -> str:
     state = create_train_state(model, variables, tx)
 
     start_epoch = args.start_epoch
+    start_batch = 0  # mid-epoch resume offset (batches already consumed)
     if args.checkpoint:
         restored = load_checkpoint(args.checkpoint, state)
         state = restore_into_state(state, restored)
-        start_epoch = int(restored["meta"]["epoch"]) + 1
+        meta = restored["meta"]
+        if "data_epoch" in meta:
+            # Step-granular checkpoint: continue mid-epoch from the exact
+            # data position — no replayed, no skipped samples.
+            start_epoch = int(meta["data_epoch"])
+            start_batch = int(meta["data_batch_offset"])
+            if start_batch >= steps_per_epoch:
+                start_epoch += 1
+                start_batch = 0
+            # The shuffle order is a pure function of (seed, epoch) and
+            # the batch offset is expressed in the saving run's batch
+            # geometry: resuming mid-epoch with a different seed or batch
+            # size would replay some samples and skip others — the exact
+            # failure this machinery exists to prevent.
+            for field, current in (
+                ("seed", int(args.seed)),
+                ("steps_per_epoch", steps_per_epoch),
+                ("batch_size", int(args.batch_size)),
+            ):
+                saved_v = int(meta.get(field, 0) or current)
+                if saved_v == current:
+                    continue
+                if start_batch > 0:
+                    raise ValueError(
+                        f"{field} {current} does not match the "
+                        f"checkpoint's {field} {saved_v}; a mid-epoch "
+                        f"resume (batch offset {start_batch}) would "
+                        "replay/skip data. Relaunch with the original "
+                        f"{field}."
+                    )
+                logger.warning(
+                    f"{field} {current} differs from the checkpoint's "
+                    f"{saved_v}: epoch boundaries/shuffles will not "
+                    "match the original run"
+                )
+        else:
+            # Legacy epoch checkpoint: next epoch from scratch.
+            start_epoch = int(meta["epoch"]) + 1
         logger.info(
             f"Resumed from {args.checkpoint} (epoch {start_epoch}, "
-            f"loss {restored['meta']['loss']:.4f})"
+            f"batch offset {start_batch}, loss {float(meta['loss']):.4f}, "
+            f"update step {int(state.step)})"
         )
 
     dtype = getattr(args, "dtype", "fp32")
+    # Bad-update guard: detect non-finite loss/grad-norm inside the jitted
+    # step, skip the poisoned update, and after max_bad_steps consecutive
+    # skips roll back to the last checkpoint (train/step.py
+    # _guarded_update; docs/FAULT_TOLERANCE.md).
+    guard_on = bool(getattr(args, "bad_step_guard", True))
+    max_bad = int(getattr(args, "max_bad_steps", 3) or 0)
     spc = max(1, int(getattr(args, "steps_per_call", 1) or 1))
     if spc > 1 and gas > 1:
         raise ValueError(
@@ -355,7 +515,8 @@ def train_worker(args: Any) -> str:
             )
         train_step = jit_multi_step(
             make_accum_train_step(
-                spec, loss_fn, compute_dtype=dtype, accum_steps=gas
+                spec, loss_fn, compute_dtype=dtype, accum_steps=gas,
+                guard=guard_on,
             ),
             mesh,
         )
@@ -382,14 +543,16 @@ def train_worker(args: Any) -> str:
             )
         train_step = jit_multi_step(
             make_multi_train_step(
-                spec, loss_fn, compute_dtype=dtype, steps_per_call=spc
+                spec, loss_fn, compute_dtype=dtype, steps_per_call=spc,
+                guard=guard_on,
             ),
             mesh,
         )
         logger.info(f"steps_per_call={spc}: scanned multi-step training")
     else:
         train_step = jit_step(
-            make_train_step(spec, loss_fn, compute_dtype=dtype), mesh
+            make_train_step(spec, loss_fn, compute_dtype=dtype, guard=guard_on),
+            mesh,
         )
     eval_step = jit_eval_step(
         make_eval_step(spec, loss_fn, compute_dtype=dtype), mesh
@@ -402,6 +565,93 @@ def train_worker(args: Any) -> str:
         else None
     )
     ckpt_dir = os.path.join(logger.logdir(), "checkpoints")
+    save_every = int(getattr(args, "save_interval_steps", 0) or 0)
+    ckpt_mgr = TrainCheckpointManager(
+        ckpt_dir, keep_last=int(getattr(args, "keep_checkpoints", 3) or 3)
+    )
+    if args.checkpoint:
+        # Manual rollback (resume from an older step while newer step
+        # dirs exist): saves that re-reach those exact steps are SKIPPED
+        # (overwrite refused), so the stale lineage would shadow this
+        # one. Make the operator decide.
+        resume_gstep = start_epoch * steps_per_epoch + start_batch
+        stale = [s for s in ckpt_mgr.all_steps() if s > resume_gstep]
+        if stale:
+            logger.warning(
+                f"Checkpoint dir has steps {stale} AHEAD of the resume "
+                f"position ({resume_gstep}); saves re-reaching them will "
+                "be skipped, and resume tooling may prefer them. Delete "
+                "them if this resume supersedes that lineage."
+            )
+    faults = faults_lib.FaultInjector.from_env()
+    if faults.enabled:
+        logger.warning(f"Fault injection ACTIVE: {faults.plan}")
+
+    def _step_out(ret):
+        """Normalize (state, loss, outputs[, diag]) across guard on/off."""
+        if len(ret) == 4:
+            return ret
+        s, l, o = ret
+        return s, l, o, None
+
+    def _interval_save(state, epoch, batches_done, gstep, wait=False):
+        """Step-granular async save at a --save-interval-steps boundary
+        (also the preempt-exit save, with ``wait=True``). The recorded
+        data position is the NEXT batch to consume."""
+        if batches_done >= steps_per_epoch:
+            d_epoch, d_off = epoch + 1, 0
+        else:
+            d_epoch, d_off = epoch, batches_done
+        ckpt_mgr.save(
+            gstep,
+            state,
+            epoch=epoch,
+            data_epoch=d_epoch,
+            data_batch_offset=d_off,
+            seed=args.seed,
+            steps_per_epoch=steps_per_epoch,
+            batch_size=int(args.batch_size),
+            on_exists="skip",  # resume/rollback may legitimately re-reach
+            wait=wait,
+        )
+        return d_epoch, d_off
+
+    def _rollback(state):
+        """Bad-update-guard rollback: restore the last checkpoint (params
+        + optimizer) and continue from the CURRENT data position."""
+        ckpt_mgr.wait()
+        step_r = ckpt_mgr.latest_step()
+        if step_r is None:
+            raise RuntimeError(
+                f"{monitor.bad_run} consecutive non-finite updates and no "
+                "checkpoint to roll back to — aborting (enable "
+                "--save-interval-steps for rollback coverage)"
+            )
+        logger.warning(
+            f"Bad-update guard: {monitor.bad_run} consecutive non-finite "
+            f"updates; rolling back to checkpoint step {step_r}"
+        )
+        restored = ckpt_mgr.restore(state, step=step_r)
+        monitor.reset()
+        return restore_into_state(state, restored)
+
+    def _preempt_exit(state, epoch, batches_done, gstep):
+        """Step-boundary preemption: make the final checkpoint durable
+        (wait=True barriers the async write), then exit with the
+        documented preempt code for tools/supervise.py."""
+        d_epoch, d_off = _interval_save(
+            state, epoch, batches_done, gstep, wait=True
+        )
+        logger.warning(
+            f"Preempted: checkpoint step {gstep} durable "
+            f"(data position {d_epoch}:{d_off}); exiting {PREEMPT_EXIT_CODE}"
+        )
+        if writer is not None:
+            writer.close()
+        train_loader.close()
+        val_loader.close()
+        ckpt_mgr.close()
+        sys.exit(PREEMPT_EXIT_CODE)
 
     best_loss = float("inf")
     best_ckpt_path = ""
@@ -424,6 +674,10 @@ def train_worker(args: Any) -> str:
     updates_per_call = 1 if gas > 1 else spc
     profile_from = 2 * updates_per_call  # skip the first two loop iterations
     tracing = False
+
+    monitor = _BadUpdateMonitor(max_bad)
+    preempt = _PreemptionHandler()
+    preempt.__enter__()  # uninstalled after the epoch loop (normal path)
 
     kernel_status_logged = False
 
@@ -462,6 +716,19 @@ def train_worker(args: Any) -> str:
     for epoch in range(start_epoch, epochs):
         t0 = time.time()
         train_loader.set_epoch(epoch)
+        skip = start_batch if epoch == start_epoch else 0
+        if skip and kpack > 1 and skip % kpack:
+            # Packed paths consume kpack batches per call; a checkpoint
+            # from the single-step path may sit off a call boundary.
+            logger.warning(
+                f"Resume offset {skip} is not a multiple of the packed "
+                f"group {kpack}; rounding down (re-trains {skip % kpack} "
+                "batch(es))"
+            )
+            skip = (skip // kpack) * kpack
+        if skip:
+            train_loader.set_start_batch(skip)
+            logger.info(f"Mid-epoch resume: epoch {epoch} from batch {skip}")
         epoch_rng = jax.random.fold_in(base_rng, epoch)
 
         # -- train epoch (ref train.py:20-179) --------------------------------
@@ -488,12 +755,34 @@ def train_worker(args: Any) -> str:
             for call, (xk, yk) in enumerate(
                 pipeline.prefetch_packed_to_device(
                     iter(train_loader), mesh, kpack
-                )
+                ),
+                start=skip // kpack,
             ):
-                state, loss, _ = train_step(state, xk, yk, epoch_rng)
+                first_b = epoch * steps_per_epoch + call * kpack
+                faults.on_step(first_b, n_steps=kpack)
+                xk = faults.corrupt_inputs(first_b, xk, n_steps=kpack)
+                state, loss, _, diag = _step_out(
+                    train_step(state, xk, yk, epoch_rng)
+                )
                 deferred_losses.append(loss)
+                if diag is not None and monitor.push(diag["applied"]):
+                    state = _rollback(state)
                 _log_kernel_status_once()
                 _maybe_trace(call * updates_per_call, loss)
+                batches_done = (call + 1) * kpack
+                if save_every and (
+                    batches_done // save_every
+                    > (batches_done - kpack) // save_every
+                ):
+                    _interval_save(
+                        state, epoch, batches_done,
+                        epoch * steps_per_epoch + batches_done,
+                    )
+                if preempt.triggered:
+                    _preempt_exit(
+                        state, epoch, batches_done,
+                        epoch * steps_per_epoch + batches_done,
+                    )
                 if call % args.log_step == 0:
                     loss_f = float(loss)
                     loss_meter.update(loss_f, 1)
@@ -518,15 +807,24 @@ def train_worker(args: Any) -> str:
 
         else:
             for step, batch in enumerate(
-                pipeline.prefetch_to_device(iter(train_loader), mesh)
+                pipeline.prefetch_to_device(iter(train_loader), mesh),
+                start=skip,
             ):
-                state, loss, outputs = train_step(
-                    state, batch.inputs, batch.loss_targets, epoch_rng
+                gstep = epoch * steps_per_epoch + step
+                faults.on_step(gstep)
+                inputs = faults.corrupt_inputs(gstep, batch.inputs)
+                state, loss, outputs, diag = _step_out(
+                    train_step(state, inputs, batch.loss_targets, epoch_rng)
                 )
                 deferred_losses.append(loss)
+                if diag is not None and monitor.push(diag["applied"]):
+                    state = _rollback(state)
                 _log_kernel_status_once()
                 _maybe_trace(step, loss)
-                gstep = epoch * steps_per_epoch + step
+                if save_every and (step + 1) % save_every == 0:
+                    _interval_save(state, epoch, step + 1, gstep + 1)
+                if preempt.triggered:
+                    _preempt_exit(state, epoch, step + 1, gstep + 1)
 
                 if step % args.log_step == 0:
                     loss_f = float(loss)
@@ -569,11 +867,18 @@ def train_worker(args: Any) -> str:
             profile_steps = 0
             logger.info("Profiler trace saved (short epoch)")
 
+        if monitor.flush():  # lagging guard flags from the epoch tail
+            state = _rollback(state)
         epoch_losses = [float(l) for l in jax.device_get(deferred_losses)]
         train_losses.extend(epoch_losses)
         # Exact epoch mean from every step's loss (the meter only samples
-        # every log_step steps, for the progress line).
-        epoch_train_loss = float(np.mean(epoch_losses)) if epoch_losses else 0.0
+        # every log_step steps, for the progress line). Guard-skipped steps
+        # leave non-finite entries in the raw curve; the epoch mean is
+        # taken over the finite ones only.
+        finite_losses = [l for l in epoch_losses if np.isfinite(l)]
+        epoch_train_loss = (
+            float(np.mean(finite_losses)) if finite_losses else 0.0
+        )
         for m in metrics_merged.values():
             m.synchronize_between_processes()
 
@@ -596,14 +901,27 @@ def train_worker(args: Any) -> str:
                     f"val.{task}.metrics/epoch", m.get_all_metrics(), epoch
                 )
 
+        epoch_end_step = (epoch + 1) * steps_per_epoch
         if val_loss < best_loss:
             best_loss = val_loss
             patience_counter = 0
-            # Checkpoint path is deterministic across hosts: epoch-numbered
+            # Checkpoint path is deterministic across hosts: step-numbered
             # under the log_dir that cli.main_worker broadcast from process 0
             # (replacing the reference's rank0 ckpt-path broadcast,
-            # train.py:481-482).
-            best_ckpt_path = save_checkpoint(ckpt_dir, state, epoch, val_loss)
+            # train.py:481-482). The val metric feeds the manager's
+            # keep-best retention, so GC never deletes this step.
+            best_ckpt_path = ckpt_mgr.save(
+                epoch_end_step,
+                state,
+                epoch=epoch,
+                data_epoch=epoch + 1,
+                data_batch_offset=0,
+                val_loss=val_loss,
+                seed=args.seed,
+                steps_per_epoch=steps_per_epoch,
+                batch_size=int(args.batch_size),
+                on_exists="skip",  # an interval save may own this boundary
+            )
         else:
             patience_counter += 1
             if patience_counter > args.patience:
@@ -612,6 +930,8 @@ def train_worker(args: Any) -> str:
                     f"(no val improvement in {args.patience} epochs)"
                 )
                 break
+        if preempt.triggered:  # SIGTERM during validation
+            _preempt_exit(state, epoch, steps_per_epoch, epoch_end_step)
 
         dt = time.time() - t0
         epoch_times.append(dt)
@@ -622,6 +942,13 @@ def train_worker(args: Any) -> str:
             f"time {strftimedelta(dt)} ETA {strftimedelta(eta)}"
         )
 
+    preempt.__exit__()
+    if monitor.total_skipped:
+        logger.warning(
+            f"Bad-update guard skipped {monitor.total_skipped} non-finite "
+            "update(s) this run"
+        )
+    ckpt_mgr.close()  # barrier on any in-flight async save
     if is_main_process():
         np.save(os.path.join(logger.logdir(), "train_losses.npy"), train_losses)
         np.save(os.path.join(logger.logdir(), "val_losses.npy"), val_losses)

@@ -1,0 +1,185 @@
+"""Fault-injection harness for robustness testing.
+
+Long unattended TPU runs die in exactly three ways — a preemption signal,
+a hard kill (spot VM reclaim, OOM-killer, `tools/tpu_outage_r4.log`), or
+numerically (a NaN loss poisoning the params) — and none of them can be
+unit-tested without a way to *cause* them on demand. This module is that
+way: a tiny, env/flag-driven injector the train worker consults at every
+step boundary, so the kill/resume, preempt, and bad-update-guard paths in
+``train/worker.py`` are exercised end-to-end by real faults rather than
+mocks (tests/test_fault_tolerance_e2e.py).
+
+Knobs (all opt-in; absent means "never fire"). Steps are GLOBAL batch
+indices (``epoch * steps_per_epoch + step``), matching the checkpoint
+step numbering, so "kill at step k" and "resume loses at most
+``save_interval_steps`` of work" talk about the same counter::
+
+    SEIST_FAULT_NAN_STEP      corrupt the input batch to NaN at this step
+    SEIST_FAULT_NAN_COUNT     ...and the following COUNT-1 steps (default 1)
+    SEIST_FAULT_KILL_STEP     SIGKILL the process at this step (hard crash:
+                              no handlers run, simulates VM reclaim)
+    SEIST_FAULT_SIGTERM_STEP  SIGTERM self at this step (graceful preempt)
+    SEIST_FAULT_SLOW_MS       sleep this long at each step start
+    SEIST_FAULT_SLOW_STEP     ...restricted to this one step (default: all)
+    SEIST_FAULT_STAMP         path of a stamp file recording which faults
+                              already fired — each fault fires AT MOST ONCE
+                              across process restarts. Without it, a
+                              relaunched run replays the same global step
+                              and dies in a crash loop, which is sometimes
+                              exactly what a test wants (supervise retry
+                              budget) and sometimes not (resume e2e).
+
+The injector is deliberately dependency-free above numpy/jax tree utils:
+it must be importable (and inert) in every entry point that might train.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass
+from typing import Any, Mapping, Optional, Set
+
+import numpy as np
+
+from seist_tpu.utils.logger import logger
+
+
+def _env_int(env: Mapping[str, str], key: str, default: int) -> int:
+    raw = env.get(key, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError as e:
+        raise ValueError(f"{key} must be an integer, got {raw!r}") from e
+
+
+def _env_float(env: Mapping[str, str], key: str, default: float) -> float:
+    raw = env.get(key, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError as e:
+        raise ValueError(f"{key} must be a number, got {raw!r}") from e
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Parsed fault schedule. ``-1`` step values mean "never"."""
+
+    nan_step: int = -1
+    nan_count: int = 1
+    kill_step: int = -1
+    sigterm_step: int = -1
+    slow_ms: float = 0.0
+    slow_step: int = -1
+    stamp_path: str = ""
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "FaultPlan":
+        env = os.environ if env is None else env
+        return cls(
+            nan_step=_env_int(env, "SEIST_FAULT_NAN_STEP", -1),
+            nan_count=max(1, _env_int(env, "SEIST_FAULT_NAN_COUNT", 1)),
+            kill_step=_env_int(env, "SEIST_FAULT_KILL_STEP", -1),
+            sigterm_step=_env_int(env, "SEIST_FAULT_SIGTERM_STEP", -1),
+            slow_ms=_env_float(env, "SEIST_FAULT_SLOW_MS", 0.0),
+            slow_step=_env_int(env, "SEIST_FAULT_SLOW_STEP", -1),
+            stamp_path=env.get("SEIST_FAULT_STAMP", ""),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return (
+            self.nan_step >= 0
+            or self.kill_step >= 0
+            or self.sigterm_step >= 0
+            or self.slow_ms > 0
+        )
+
+
+class FaultInjector:
+    """Step-boundary fault driver. ``on_step`` fires process-level faults
+    (kill / sigterm / slow); ``corrupt_inputs`` handles the numeric one.
+
+    Each named fault fires once per process; with a stamp file, once per
+    *run* (surviving relaunches — the stamp is read at construction and
+    appended to just before the fault fires, so even a SIGKILL cannot
+    outrun it)."""
+
+    def __init__(self, plan: Optional[FaultPlan] = None):
+        self.plan = plan or FaultPlan()
+        self._fired: Set[str] = set()
+        if self.plan.stamp_path and os.path.exists(self.plan.stamp_path):
+            with open(self.plan.stamp_path) as f:
+                self._fired = {line.strip() for line in f if line.strip()}
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "FaultInjector":
+        return cls(FaultPlan.from_env(env))
+
+    @property
+    def enabled(self) -> bool:
+        return self.plan.enabled
+
+    # ------------------------------------------------------------- internals
+    def _armed(self, name: str) -> bool:
+        return name not in self._fired
+
+    def _mark(self, name: str) -> None:
+        """Record a firing BEFORE acting on it: SIGKILL never returns, so
+        the stamp write must precede the kill or relaunches loop forever."""
+        self._fired.add(name)
+        if self.plan.stamp_path:
+            with open(self.plan.stamp_path, "a") as f:
+                f.write(name + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    # ------------------------------------------------------------- step hook
+    def on_step(self, step: int, n_steps: int = 1) -> None:
+        """Fire any process-level fault scheduled inside the global-step
+        window ``[step, step + n_steps)``. Call at the START of the step
+        (or packed call — the packed train paths only visit kpack
+        boundaries, so a fault scheduled mid-call must still fire),
+        before dispatching compute."""
+        p = self.plan
+
+        def hit(target: int) -> bool:
+            return step <= target < step + n_steps
+
+        if p.slow_ms > 0 and (p.slow_step < 0 or hit(p.slow_step)):
+            time.sleep(p.slow_ms / 1000.0)
+        if p.sigterm_step >= 0 and hit(p.sigterm_step) and self._armed("sigterm"):
+            self._mark("sigterm")
+            logger.warning(f"[faults] SIGTERM self at step {p.sigterm_step}")
+            os.kill(os.getpid(), signal.SIGTERM)
+        if p.kill_step >= 0 and hit(p.kill_step) and self._armed("kill"):
+            self._mark("kill")
+            logger.warning(f"[faults] SIGKILL self at step {p.kill_step}")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    # ------------------------------------------------------- numeric faults
+    def nan_active(self, step: int) -> bool:
+        p = self.plan
+        return (
+            p.nan_step >= 0
+            and p.nan_step <= step < p.nan_step + p.nan_count
+            and self._armed(f"nan@{step}")
+        )
+
+    def corrupt_inputs(self, step: int, inputs: Any, n_steps: int = 1) -> Any:
+        """Return ``inputs`` with every array turned to NaN when any of the
+        global steps ``[step, step + n_steps)`` falls in the NaN window
+        (``n_steps > 1`` covers the packed train paths, where one call
+        consumes several batches). The corruption flows through forward +
+        backward, so the non-finite loss/gradient the bad-update guard
+        must catch arises exactly the way a real numeric blow-up does."""
+        hits = [s for s in range(step, step + n_steps) if self.nan_active(s)]
+        if not hits:
+            return inputs
+        for s in hits:
+            self._mark(f"nan@{s}")
+        logger.warning(f"[faults] NaN batch injected at step(s) {hits}")
+        import jax
+
+        return jax.tree.map(lambda x: x * np.float32("nan"), inputs)

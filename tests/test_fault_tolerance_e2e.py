@@ -1,0 +1,256 @@
+"""End-to-end fault-tolerance runs driven by the utils/faults harness:
+NaN-loss skip + rollback (in-process), SIGKILL + supervise resume and
+SIGTERM preemption (subprocess; slow lane per the tier-1 contract).
+
+These are the ISSUE's acceptance checks: a training run must survive a
+hard kill losing at most the save interval of work, continue a loss
+curve seamlessly after relaunch, shrug off injected NaNs without
+poisoning params, and turn SIGTERM into a durable checkpoint plus the
+documented preempt exit code.
+"""
+
+import glob
+import os
+import signal
+import subprocess
+import sys
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import seist_tpu
+from seist_tpu.utils.logger import logger
+
+# Whole-file slow: every test here is a real (or in-process) training run
+# dominated by jit compiles — the tier-1 fast lane stays fast (ISSUE
+# satellite); `pytest -m slow tests/test_fault_tolerance_e2e.py` runs the
+# acceptance checks.
+pytestmark = pytest.mark.slow
+
+seist_tpu.load_all()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_args(**over):
+    d = dict(
+        mode="train",
+        model_name="phasenet",
+        checkpoint="",
+        seed=1,
+        log_base="",
+        log_step=100,
+        use_tensorboard=False,
+        save_test_results=False,
+        data="",
+        dataset_name="synthetic",
+        data_split=True,
+        train_size=0.8,
+        val_size=0.1,
+        shuffle=True,
+        workers=2,
+        in_samples=512,
+        label_width=0.5,
+        label_shape="gaussian",
+        coda_ratio=2.0,
+        norm_mode="std",
+        min_snr=-float("inf"),
+        p_position_ratio=-1,
+        augmentation=False,
+        add_event_rate=0.0,
+        max_event_num=1,
+        shift_event_rate=0.0,
+        add_noise_rate=0.0,
+        add_gap_rate=0.0,
+        min_event_gap=0.5,
+        drop_channel_rate=0.0,
+        scale_amplitude_rate=0.0,
+        pre_emphasis_rate=0.0,
+        pre_emphasis_ratio=0.97,
+        generate_noise_rate=0.0,
+        mask_percent=0,
+        noise_percent=0,
+        epochs=1,
+        patience=30,
+        steps=0,
+        start_epoch=0,
+        batch_size=8,
+        optim="Adam",
+        momentum=0.9,
+        weight_decay=0.0,
+        use_lr_scheduler=True,
+        lr_scheduler_mode="exp_range",
+        base_lr=8e-5,
+        max_lr=1e-3,
+        warmup_steps=2000,
+        down_steps=3000,
+        time_threshold=0.1,
+        min_peak_dist=1.0,
+        ppk_threshold=0.3,
+        spk_threshold=0.3,
+        det_threshold=0.5,
+        max_detect_event_num=1,
+        dataset_kwargs={"num_events": 40, "trace_samples": 2048},
+        # fault-tolerance knobs (cli.py defaults)
+        save_interval_steps=2,
+        keep_checkpoints=3,
+        bad_step_guard=True,
+        max_bad_steps=2,
+    )
+    d.update(over)
+    return SimpleNamespace(**d)
+
+
+# --------------------------------------------------- NaN guard (in-process)
+def test_injected_nan_is_skipped_without_poisoning_params(
+    tmp_path, monkeypatch
+):
+    """Acceptance: an injected NaN loss is skipped — the raw loss curve
+    records it, params stay finite, training completes and checkpoints."""
+    from seist_tpu.train.checkpoint import load_checkpoint
+    from seist_tpu.train.worker import train_worker
+
+    monkeypatch.setenv("SEIST_FAULT_NAN_STEP", "1")
+    logger.set_logdir(str(tmp_path))
+    ckpt = train_worker(make_args(max_bad_steps=0))  # skip-only, no rollback
+    assert ckpt and os.path.exists(ckpt)
+    losses = np.load(os.path.join(str(tmp_path), "train_losses.npy"))
+    assert len(losses) == 4  # 32 train events / batch 8
+    assert np.isnan(losses[1]), losses
+    assert np.isfinite(np.delete(losses, 1)).all(), losses
+    raw = load_checkpoint(ckpt)
+    for leaf in __import__("jax").tree.leaves(raw["params"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_consecutive_nans_trigger_rollback_to_last_good_checkpoint(
+    tmp_path, monkeypatch
+):
+    """Acceptance: N consecutive NaNs roll the run back to the last good
+    checkpoint (params + optimizer), after which training continues."""
+    from seist_tpu.train.worker import train_worker
+
+    monkeypatch.setenv("SEIST_FAULT_NAN_STEP", "2")
+    monkeypatch.setenv("SEIST_FAULT_NAN_COUNT", "2")
+    logger.set_logdir(str(tmp_path))
+    ckpt = train_worker(make_args(max_bad_steps=2, save_interval_steps=2))
+    assert ckpt and os.path.exists(ckpt)
+    with open(os.path.join(str(tmp_path), "global.log")) as f:
+        log = f.read()
+    # The guard's skips kept every interval checkpoint un-poisoned, so
+    # "last good" is simply the newest one at rollback time.
+    assert "rolling back to checkpoint step" in log, log[-2000:]
+    assert os.path.exists(os.path.join(str(tmp_path), "checkpoints", "model_2"))
+    assert os.path.exists(os.path.join(str(tmp_path), "checkpoints", "model_4"))
+
+
+# ----------------------------------------------------- subprocess helpers
+def _train_cmd(log_base, extra=()):
+    return [
+        sys.executable, os.path.join(REPO, "main.py"),
+        "--mode", "train", "--model-name", "phasenet",
+        "--dataset-name", "synthetic", "--synthetic-events", "40",
+        "--in-samples", "512", "--batch-size", "8", "--epochs", "2",
+        "--seed", "1", "--augmentation", "false", "--workers", "2",
+        "--use-tensorboard", "false", "--save-interval-steps", "2",
+        "--log-step", "100", "--log-base", log_base, *extra,
+    ]
+
+
+def _env(**over):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("SEIST_FAULT_NAN_STEP", None)
+    env.pop("SEIST_FAULT_NAN_COUNT", None)
+    env.update(over)
+    return env
+
+
+def _final_params(log_base, step=8):
+    import jax
+    import orbax.checkpoint as ocp
+
+    paths = glob.glob(os.path.join(log_base, "*", "checkpoints",
+                                   f"model_{step}", "default"))
+    assert paths, f"no model_{step} under {log_base}"
+    with ocp.StandardCheckpointer() as c:
+        raw = c.restore(paths[0])
+    return jax.tree.leaves(raw["params"]), raw["meta"]
+
+
+# ------------------------------------------------- SIGKILL + supervise e2e
+@pytest.mark.slow  # three subprocess training runs (compile-dominated)
+def test_sigkill_midrun_supervise_resumes_with_loss_continuity(tmp_path):
+    """Acceptance: SIGKILL a run mid-epoch via the fault harness, relaunch
+    under tools/supervise.py, and the run resumes from the last durable
+    step checkpoint: optimizer state intact, no data replayed/skipped, and
+    final params matching an uninterrupted run."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from supervise import main as supervise_main
+
+    kill_base = str(tmp_path / "kill_logs")
+    stamp = str(tmp_path / "stamp")
+    env = _env(SEIST_FAULT_KILL_STEP="5", SEIST_FAULT_STAMP=stamp)
+    old_env = os.environ.copy()
+    os.environ.update(env)
+    try:
+        rc = supervise_main(
+            ["--retries", "2", "--backoff", "0", "--"] + _train_cmd(kill_base)
+        )
+    finally:
+        os.environ.clear()
+        os.environ.update(old_env)
+    assert rc == 0
+    # The kill actually happened (stamp) and the run still completed.
+    with open(stamp) as f:
+        assert "kill" in f.read()
+
+    ref_base = str(tmp_path / "ref_logs")
+    subprocess.run(_train_cmd(ref_base), env=_env(), check=True, timeout=600)
+
+    killed, meta = _final_params(kill_base)
+    reference, _ = _final_params(ref_base)
+    assert int(meta["data_epoch"]) == 2 and int(meta["data_batch_offset"]) == 0
+    # Loss-curve continuity in its strongest form: the resumed trajectory
+    # lands on the same final params as the never-interrupted run (tiny
+    # tolerance absorbs environment-level float noise under load).
+    for a, b in zip(killed, reference):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-3, rtol=0
+        )
+    # "At most save_interval_steps lost": with async saves the last
+    # DURABLE checkpoint trails the kill by < 2 intervals; the run dir
+    # must hold a pre-kill step checkpoint that the relaunch resumed from.
+    run_dir = glob.glob(os.path.join(kill_base, "*"))[0]
+    steps = sorted(
+        int(os.path.basename(p).split("_")[1])
+        for p in glob.glob(os.path.join(run_dir, "checkpoints", "model_*"))
+        if ".orbax-checkpoint-tmp-" not in p
+    )
+    assert steps[-1] == 8 and steps[0] >= 2
+
+
+# --------------------------------------------------- SIGTERM preempt e2e
+@pytest.mark.slow  # one subprocess training run
+def test_sigterm_checkpoints_and_exits_preempt_code(tmp_path):
+    """Acceptance: SIGTERM during training produces a checkpoint at the
+    next step boundary and the documented preempt exit code (75)."""
+    from seist_tpu.train.checkpoint import PREEMPT_EXIT_CODE
+
+    log_base = str(tmp_path / "logs")
+    proc = subprocess.run(
+        _train_cmd(log_base),
+        env=_env(SEIST_FAULT_SIGTERM_STEP="3"),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == PREEMPT_EXIT_CODE, proc.stdout[-2000:]
+    ckpts = glob.glob(os.path.join(log_base, "*", "checkpoints", "model_*"))
+    committed = [c for c in ckpts if ".orbax-checkpoint-tmp-" not in c]
+    assert committed, ckpts
+    # The boundary checkpoint covers the SIGTERM step: step >= 4.
+    assert max(
+        int(os.path.basename(c).split("_")[1]) for c in committed
+    ) >= 4
+    assert "Preempted" in proc.stdout

@@ -1,0 +1,283 @@
+"""utils/faults injection harness + the train/step.py bad-update guard
+(unit level; the end-to-end kill/NaN/preempt runs live in
+tests/test_fault_tolerance_e2e.py)."""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+import seist_tpu
+from seist_tpu import taskspec
+from seist_tpu.train import (
+    build_optimizer,
+    create_train_state,
+    make_accum_train_step,
+    make_multi_train_step,
+    make_train_step,
+)
+from seist_tpu.utils.faults import FaultInjector, FaultPlan
+
+seist_tpu.load_all()
+
+L = 64
+
+
+# ------------------------------------------------------------ plan parsing
+def test_plan_from_env_defaults_inert():
+    plan = FaultPlan.from_env({})
+    assert not plan.enabled
+    assert plan.nan_step == -1 and plan.kill_step == -1
+
+
+def test_plan_from_env_parses_knobs():
+    plan = FaultPlan.from_env({
+        "SEIST_FAULT_NAN_STEP": "12",
+        "SEIST_FAULT_NAN_COUNT": "3",
+        "SEIST_FAULT_KILL_STEP": "40",
+        "SEIST_FAULT_SIGTERM_STEP": "7",
+        "SEIST_FAULT_SLOW_MS": "1.5",
+        "SEIST_FAULT_SLOW_STEP": "2",
+        "SEIST_FAULT_STAMP": "/tmp/stamp",
+    })
+    assert plan.enabled
+    assert plan.nan_step == 12 and plan.nan_count == 3
+    assert plan.kill_step == 40 and plan.sigterm_step == 7
+    assert plan.slow_ms == 1.5 and plan.slow_step == 2
+    assert plan.stamp_path == "/tmp/stamp"
+
+
+def test_plan_rejects_garbage():
+    with pytest.raises(ValueError):
+        FaultPlan.from_env({"SEIST_FAULT_NAN_STEP": "soon"})
+
+
+# --------------------------------------------------------------- injection
+def test_corrupt_inputs_only_in_window():
+    inj = FaultInjector(FaultPlan(nan_step=2, nan_count=2))
+    x = {"a": np.ones((3, 4), np.float32)}
+    assert inj.corrupt_inputs(1, x) is x  # untouched outside the window
+    x2 = inj.corrupt_inputs(2, x)
+    assert np.isnan(np.asarray(x2["a"])).all()
+    x3 = inj.corrupt_inputs(3, x)
+    assert np.isnan(np.asarray(x3["a"])).all()
+    assert inj.corrupt_inputs(4, x) is x
+
+
+def test_corrupt_inputs_packed_window_overlap():
+    """Packed paths hand one call covering [step, step+n); any overlap
+    with the NaN window corrupts the stacked batch."""
+    inj = FaultInjector(FaultPlan(nan_step=5, nan_count=1))
+    x = np.ones((2, 3), np.float32)
+    assert inj.corrupt_inputs(0, x, n_steps=4) is x  # [0,4) misses 5
+    out = inj.corrupt_inputs(4, x, n_steps=4)  # [4,8) hits 5
+    assert np.isnan(np.asarray(out)).all()
+
+
+def test_stamp_file_makes_faults_fire_once_across_restarts(tmp_path):
+    stamp = str(tmp_path / "stamp")
+    plan = FaultPlan(nan_step=3, stamp_path=stamp)
+    inj = FaultInjector(plan)
+    x = np.ones(2, np.float32)
+    assert np.isnan(np.asarray(inj.corrupt_inputs(3, x))).all()
+    # Same process: already fired.
+    assert inj.corrupt_inputs(3, x) is x
+    # "Relaunched" process reads the stamp and stays inert.
+    inj2 = FaultInjector(plan)
+    assert inj2.corrupt_inputs(3, x) is x
+
+
+def test_sigterm_and_kill_fire_via_os_kill(monkeypatch):
+    sent = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: sent.append((pid, sig)))
+    inj = FaultInjector(FaultPlan(sigterm_step=2, kill_step=5))
+    inj.on_step(1)
+    assert sent == []
+    inj.on_step(2)
+    assert sent == [(os.getpid(), signal.SIGTERM)]
+    inj.on_step(2)  # once only
+    assert len(sent) == 1
+    inj.on_step(5)
+    assert sent[-1] == (os.getpid(), signal.SIGKILL)
+
+
+def test_on_step_window_covers_packed_calls(monkeypatch):
+    """Packed train paths only visit kpack boundaries; a kill scheduled
+    mid-call must still fire (window semantics, like corrupt_inputs)."""
+    sent = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: sent.append(sig))
+    inj = FaultInjector(FaultPlan(kill_step=5))
+    inj.on_step(0, n_steps=4)  # [0, 4) misses 5
+    assert sent == []
+    inj.on_step(4, n_steps=4)  # [4, 8) hits 5
+    assert sent == [signal.SIGKILL]
+
+
+def test_slow_step_sleeps(monkeypatch):
+    import seist_tpu.utils.faults as faults_mod
+
+    naps = []
+    monkeypatch.setattr(faults_mod.time, "sleep", lambda s: naps.append(s))
+    inj = FaultInjector(FaultPlan(slow_ms=250.0, slow_step=3))
+    inj.on_step(2)
+    assert naps == []
+    inj.on_step(3)
+    assert naps == [0.25]
+    # slow_step=-1 means every step.
+    inj_all = FaultInjector(FaultPlan(slow_ms=100.0))
+    inj_all.on_step(0)
+    inj_all.on_step(1)
+    assert naps == [0.25, 0.1, 0.1]
+
+
+# -------------------------------------------------------- bad-update guard
+class Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        h = nn.gelu(nn.Dense(8)(x))
+        return jax.nn.softmax(nn.Dense(3)(h), axis=-1)
+
+
+def _tiny_setup():
+    model = Tiny()
+    variables = model.init(jax.random.PRNGKey(3), jnp.zeros((1, L, 3)))
+    state = create_train_state(
+        model, {"params": variables["params"]}, build_optimizer("adam", 1e-2)
+    )
+    spec = taskspec.get_task_spec("phasenet")  # CE on (N, L, 3) probs
+    return state, spec, taskspec.make_loss("phasenet")
+
+
+def _tiny_batch(rng, batch=4):
+    x = rng.standard_normal((batch, L, 3)).astype(np.float32)
+    ppk = np.zeros((batch, L), np.float32)
+    ppk[:, 16] = 1.0
+    spk = np.zeros((batch, L), np.float32)
+    spk[:, 32] = 1.0
+    y = np.stack([1.0 - ppk - spk, ppk, spk], axis=-1)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_guarded_step_skips_nonfinite_update(rng):
+    state, spec, loss_fn = _tiny_setup()
+    step = jax.jit(make_train_step(spec, loss_fn, guard=True))
+    x, y = _tiny_batch(rng)
+    key = jax.random.PRNGKey(0)
+
+    s1, loss1, out1, d1 = step(state, x, y, key)
+    assert int(d1["applied"]) == 1
+    assert np.isfinite(float(d1["grad_norm"]))
+    assert int(s1.step) == 1
+
+    xnan = x * np.float32("nan")
+    s2, loss2, _, d2 = step(s1, xnan, y, key)
+    assert int(d2["applied"]) == 0
+    assert not np.isfinite(float(loss2))
+    # The poisoned update touched NOTHING: params, opt_state, step.
+    assert int(s2.step) == 1
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree.leaves(s1.opt_state), jax.tree.leaves(s2.opt_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ...and training continues cleanly afterwards.
+    s3, loss3, _, d3 = step(s2, x, y, key)
+    assert int(d3["applied"]) == 1 and np.isfinite(float(loss3))
+    assert int(s3.step) == 2
+
+
+def test_guarded_step_matches_unguarded_on_clean_data(rng):
+    state, spec, loss_fn = _tiny_setup()
+    x, y = _tiny_batch(rng)
+    key = jax.random.PRNGKey(0)
+    plain = jax.jit(make_train_step(spec, loss_fn))
+    guarded = jax.jit(make_train_step(spec, loss_fn, guard=True))
+    s1, l1, _ = plain(state, x, y, key)
+    s2, l2, _, _ = guarded(state, x, y, key)
+    assert float(l1) == float(l2)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_guarded_multi_step_counts_applied(rng):
+    """k=3 scanned updates with the middle batch NaN: 2 applied, and the
+    mean loss is over the finite micro-steps only."""
+    state, spec, loss_fn = _tiny_setup()
+    k = 3
+    batches = [_tiny_batch(rng) for _ in range(k)]
+    xs = jnp.stack([b[0] for b in batches])
+    ys = jnp.stack([b[1] for b in batches])
+    xs = xs.at[1].set(jnp.nan)
+    multi = jax.jit(
+        make_multi_train_step(spec, loss_fn, steps_per_call=k, guard=True)
+    )
+    s, mean_loss, _, diag = multi(state, xs, ys, jax.random.PRNGKey(7))
+    # Ordered per-micro-step mask: the worker's consecutive-bad tracking
+    # needs skip POSITIONS, not just the count.
+    np.testing.assert_array_equal(np.asarray(diag["applied"]), [1, 0, 1])
+    assert np.isfinite(float(mean_loss))
+    # Skipped micro-steps do not advance state.step either.
+    assert int(s.step) == 2
+
+
+def test_guarded_accum_step_skips_whole_update(rng):
+    """One NaN micro-batch poisons the summed gradient: the single
+    accumulated update is skipped entirely."""
+    state, spec, loss_fn = _tiny_setup()
+    batches = [_tiny_batch(rng) for _ in range(2)]
+    xs = jnp.stack([b[0] for b in batches]).at[0].set(jnp.nan)
+    ys = jnp.stack([b[1] for b in batches])
+    accum = jax.jit(
+        make_accum_train_step(spec, loss_fn, accum_steps=2, guard=True)
+    )
+    s, loss, _, diag = accum(state, xs, ys, jax.random.PRNGKey(0))
+    assert int(diag["applied"]) == 0
+    assert int(s.step) == 0
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(s.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------- worker monitor
+def test_bad_update_monitor_consecutive_and_lag():
+    from seist_tpu.train.worker import _BadUpdateMonitor
+
+    m = _BadUpdateMonitor(max_bad=3, lag=2)
+    # Flags evaluate `lag` pushes late.
+    assert m.push(0) is False  # nothing evaluated yet
+    assert m.push(0) is False
+    assert m.push(0) is False  # first 0 evaluated -> run=1
+    assert m.bad_run == 1
+    assert m.push(0) is False  # run=2
+    assert m.push(0) is True   # run=3 -> rollback
+    m.reset()
+    assert m.bad_run == 0 and m.push(0) is False
+    # A good step clears the run.
+    m2 = _BadUpdateMonitor(max_bad=2, lag=0)
+    assert m2.push(0) is False and m2.bad_run == 1
+    assert m2.push(1) is False and m2.bad_run == 0
+    assert m2.push(0) is False and m2.push(0) is True
+    assert m2.flush() is True
+    # Packed calls push the ordered applied mask: all-skipped accumulates,
+    # a call ENDING in a success breaks the run even with earlier skips,
+    # and trailing skips start a fresh run.
+    m3 = _BadUpdateMonitor(max_bad=4, lag=0)
+    assert m3.push([0, 0, 0]) is False and m3.bad_run == 3
+    assert m3.push([0, 0, 1]) is False and m3.bad_run == 0  # run broken
+    assert m3.push([1, 0, 0]) is False and m3.bad_run == 2
+    assert m3.push([0, 0, 0]) is True  # 2 + 3 >= 4
+    assert m3.total_skipped == 3 + 2 + 2 + 3
+
+
+def test_monitor_disabled_when_max_bad_zero():
+    from seist_tpu.train.worker import _BadUpdateMonitor
+
+    m = _BadUpdateMonitor(max_bad=0, lag=0)
+    for _ in range(10):
+        assert m.push(0) is False
+    assert m.flush() is False
+    assert m.total_skipped == 10

@@ -429,3 +429,143 @@ class TestServiceEndToEnd:
             assert status == 404
         finally:
             server.shutdown()
+
+
+# -------------------------------------------- robustness: health + watchdog
+class TestHealthAndWatchdog:
+    def test_live_and_ready_endpoints(self, service):
+        import http.client
+
+        from seist_tpu.serve import start_http_server
+
+        server = start_http_server(service, port=0)
+        host, port = server.server_address[:2]
+        try:
+            def call(path):
+                conn = http.client.HTTPConnection(host, port, timeout=60)
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                out = json.loads(resp.read())
+                conn.close()
+                return resp.status, out
+
+            status, out = call("/healthz/live")
+            assert status == 200 and out["status"] == "ok"
+            status, out = call("/healthz/ready")
+            assert status == 200 and out["ready"] is True
+            status, out = call("/healthz")
+            assert status == 200 and out["live"] and out["ready"]
+
+            # SIGTERM drain window: not-ready (503) but still live (200).
+            service.begin_drain()
+            try:
+                status, out = call("/healthz/ready")
+                assert status == 503 and out["status"] == "draining"
+                status, _ = call("/healthz/live")
+                assert status == 200
+                with pytest.raises(ShuttingDown):
+                    service.predict(np.zeros((WINDOW, 3)).tolist())
+            finally:
+                service._draining = False  # restore the shared fixture
+            status, _ = call("/healthz/ready")
+            assert status == 200
+        finally:
+            server.shutdown()
+
+    def test_async_warmup_reports_not_ready_then_ready(self, service):
+        """warmup_async: readiness flips only after the pool pre-compile
+        finishes (the pool here is already warm, so 'compile' is instant —
+        the test pins the state machine, not the compile time)."""
+        from seist_tpu.serve import BatcherConfig as BC
+        from seist_tpu.serve import ServeService
+
+        svc = ServeService(
+            service.pool,
+            BC(max_batch=4, max_delay_ms=5.0, max_queue=8),
+            warmup_async=True,
+        )
+        try:
+            deadline = time.monotonic() + 60
+            while not svc.ready() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert svc.ready() and svc.alive()
+        finally:
+            svc.shutdown()
+
+    def test_dead_flush_thread_fails_liveness_and_watchdog_exits(self):
+        """A batcher whose flush loop dies must (a) fail fast on submit,
+        (b) drop liveness, and (c) make the server watchdog return 1 —
+        the server process then exits non-zero instead of hanging."""
+        from types import SimpleNamespace
+
+        from seist_tpu.serve.protocol import ServeError
+        from seist_tpu.serve.server import watch_until_shutdown
+
+        b = _make(lambda x: x, max_batch=2, max_delay_ms=5.0)
+        assert b.healthy
+
+        def boom(pending):
+            raise RuntimeError("flush machinery broke")
+
+        b._run_batch = boom  # fails OUTSIDE the per-request try/except
+        with pytest.raises(ServeError, match="flush thread died"):
+            b.submit(np.zeros((2,), np.float32), timeout_ms=2000)
+        deadline = time.monotonic() + 5
+        while b.healthy and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not b.healthy
+        assert b.stats()["healthy"] is False
+        # Fast-fail for later submitters (no deadline wait).
+        with pytest.raises(ServeError, match="flush thread died"):
+            b.submit(np.zeros((2,), np.float32), timeout_ms=60_000)
+
+        svc = SimpleNamespace(_batchers={"m": b}, alive=lambda: b.healthy)
+        rc = watch_until_shutdown(svc, threading.Event(), poll_s=0.01)
+        assert rc == 1
+
+    def test_watchdog_returns_zero_on_stop(self, service):
+        from seist_tpu.serve.server import watch_until_shutdown
+
+        stop = threading.Event()
+        stop.set()
+        assert watch_until_shutdown(service, stop, poll_s=0.01) == 0
+
+    def test_failed_warmup_never_reports_ready(self):
+        """A warm-up that raises (compile OOM, bad bucket) must not flip
+        the service to ready; liveness drops and the watchdog exits 1 —
+        the async equivalent of the sync path's crash."""
+        from types import SimpleNamespace
+
+        from seist_tpu.serve import BatcherConfig as BC
+        from seist_tpu.serve import ServeService
+        from seist_tpu.serve.server import watch_until_shutdown
+
+        class BoomPool:
+            warmup_report = []
+
+            def names(self):
+                return ["m"]
+
+            def get(self, name):
+                return SimpleNamespace(forward=lambda x: x)
+
+            def warmup(self, buckets):
+                raise RuntimeError("compile boom")
+
+        svc = ServeService(
+            BoomPool(), BC(max_batch=2, max_delay_ms=5.0),
+            warmup_async=True,
+        )
+        try:
+            deadline = time.monotonic() + 10
+            while svc._warmup_error is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert svc._warmup_error is not None
+            assert not svc.ready() and not svc.alive()
+            rc = watch_until_shutdown(svc, threading.Event(), poll_s=0.01)
+            assert rc == 1
+            # Sync construction of the same pool crashes loudly.
+            with pytest.raises(RuntimeError, match="compile boom"):
+                ServeService(BoomPool(), BC(max_batch=2, max_delay_ms=5.0))
+        finally:
+            svc.shutdown(drain=False)

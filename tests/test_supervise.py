@@ -1,4 +1,5 @@
-"""tools/supervise.py: relaunch-on-failure with checkpoint resume.
+"""tools/supervise.py: relaunch-on-failure with checkpoint resume and the
+preemption exit-code contract.
 
 The reference has no automatic failure recovery (SURVEY.md §5 — resume is
 a manual relaunch with --checkpoint, ref train.py:255-264); these tests
@@ -9,12 +10,19 @@ handed a checkpoint.
 import os
 import sys
 import textwrap
+import time
 
 sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
 )
 
-from supervise import find_newest_checkpoint, main, with_checkpoint  # noqa: E402
+from supervise import (  # noqa: E402
+    PREEMPT_EXIT_CODE,
+    checkpoint_step,
+    find_newest_checkpoint,
+    main,
+    with_checkpoint,
+)
 
 
 def _make_ckpt(base, run, name, t):
@@ -41,6 +49,50 @@ class TestHelpers:
         committed = _make_ckpt(base, "run", "model-6", 100)
         _make_ckpt(base, "run", "model-7.orbax-checkpoint-tmp-123", 200)
         assert find_newest_checkpoint(base) == committed
+
+    def test_interrupted_manager_save_layout(self, tmp_path):
+        """Satellite regression: the step-granular manager layout after a
+        crash mid-async-save — committed `model_<step>` dirs plus one
+        `.orbax-checkpoint-tmp-` in-progress dir. Only the exact orbax
+        marker disqualifies; the old `"tmp" in d` substring match is gone
+        (it would also have rejected any legitimately-named dir whose
+        name happened to contain those three letters)."""
+        base = str(tmp_path)
+        _make_ckpt(base, "run", "model_2", 100)
+        committed = _make_ckpt(base, "run", "model_4", 200)
+        _make_ckpt(base, "run", "model_6.orbax-checkpoint-tmp-1722", 300)
+        assert find_newest_checkpoint(base) == committed
+
+    def test_step_number_breaks_mtime_ties(self, tmp_path):
+        """Two async saves can finalize within mtime granularity; the
+        higher step must win."""
+        base = str(tmp_path)
+        _make_ckpt(base, "run", "model_4", 100)
+        newest = _make_ckpt(base, "run", "model_6", 100)
+        assert find_newest_checkpoint(base) == newest
+
+    def test_non_checkpoint_dirs_ignored(self, tmp_path):
+        base = str(tmp_path)
+        committed = _make_ckpt(base, "run", "model-3", 100)
+        _make_ckpt(base, "run", "model-best", 200)  # no step number
+        _make_ckpt(base, "run", "other-5", 300)
+        assert find_newest_checkpoint(base) == committed
+
+    def test_checkpoint_step_parsing(self):
+        assert checkpoint_step("model-7") == 7
+        assert checkpoint_step("model_123") == 123
+        assert checkpoint_step("/a/b/checkpoints/model_9") == 9
+        assert checkpoint_step("model_9.orbax-checkpoint-tmp-1") is None
+        assert checkpoint_step("model-best") is None
+
+    def test_preempt_code_matches_trainer(self):
+        """supervise.py is stdlib-only, so the constant is duplicated
+        from seist_tpu.train.checkpoint — pin them together."""
+        from seist_tpu.train.checkpoint import (
+            PREEMPT_EXIT_CODE as trainer_code,
+        )
+
+        assert PREEMPT_EXIT_CODE == trainer_code == 75
 
     def test_with_checkpoint_appends_and_replaces(self):
         cmd = ["python", "main.py", "--mode", "train"]
@@ -89,3 +141,64 @@ class TestEndToEnd:
             sys.executable, str(script),
         ])
         assert rc == 7
+
+    def test_clean_preempt_relaunches_immediately_without_budget(
+        self, tmp_path
+    ):
+        """rc=75 with checkpoint progress: immediate relaunch (no
+        backoff sleep) and the retry budget untouched — retries=0 still
+        completes."""
+        log_base = tmp_path / "logs"
+        script = tmp_path / "trainer.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys
+            log_base = {str(log_base)!r}
+            if "--checkpoint" in sys.argv:
+                sys.exit(0)  # resumed run finishes the job
+            os.makedirs(os.path.join(log_base, "run", "checkpoints", "model_4"),
+                        exist_ok=True)
+            sys.exit({PREEMPT_EXIT_CODE})  # preempted after checkpointing
+        """))
+        t0 = time.monotonic()
+        rc = main([
+            "--retries", "0", "--backoff", "30", "--",
+            sys.executable, str(script), "--log-base", str(log_base),
+        ])
+        assert rc == 0
+        # No 30 s backoff was paid: the preempt path relaunches at once.
+        assert time.monotonic() - t0 < 20.0
+
+    def test_preempt_without_progress_consumes_budget(self, tmp_path):
+        """An exit-75 loop that never advances a checkpoint must not
+        relaunch forever: without progress it's treated as a crash."""
+        script = tmp_path / "fake_preempt.py"
+        script.write_text(
+            f"import sys; sys.exit({PREEMPT_EXIT_CODE})\n"
+        )
+        rc = main([
+            "--retries", "1", "--backoff", "0", "--",
+            sys.executable, str(script),
+        ])
+        assert rc == PREEMPT_EXIT_CODE
+
+    def test_checkpoint_progress_resets_crash_budget(self, tmp_path):
+        """Crashes WITH forward progress (newer checkpoint each attempt)
+        keep resetting the budget: retries=1 survives 2 crashes because
+        each one advanced the checkpoint (tpu_outage_r4.log ate 4 outages
+        in one night — a long healthy run must outlive them)."""
+        log_base = tmp_path / "logs"
+        script = tmp_path / "progressing.py"
+        script.write_text(textwrap.dedent(f"""
+            import glob, os, sys
+            log_base = {str(log_base)!r}
+            ck = os.path.join(log_base, "run", "checkpoints")
+            n = len(glob.glob(os.path.join(ck, "model_*")))
+            os.makedirs(os.path.join(ck, f"model_{{2 * (n + 1)}}"),
+                        exist_ok=True)
+            sys.exit(0 if n >= 2 else 1)
+        """))
+        rc = main([
+            "--retries", "1", "--backoff", "0", "--",
+            sys.executable, str(script), "--log-base", str(log_base),
+        ])
+        assert rc == 0

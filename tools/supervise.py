@@ -1,5 +1,5 @@
 """Supervised training: relaunch on failure, resuming from the newest
-checkpoint.
+checkpoint; preemption-aware.
 
 The reference has no failure-recovery mechanism at all — a crashed run is
 relaunched by hand with `--checkpoint` (SURVEY.md §5; ref train.py:255-264
@@ -11,39 +11,81 @@ that gap for long unattended runs:
         --dataset-name diting --data /path --log-base logs/run1
 
 On a nonzero exit it scans the run's `--log-base` tree for the newest
-`checkpoints/model-*` directory (orbax layout, train/checkpoint.py) and
-relaunches the SAME command with `--checkpoint <newest>` (replacing any
-prior value), up to `--retries` times with `--backoff` seconds between
-attempts. A run that produced no checkpoint yet is relaunched from
-scratch. Exit code is the final attempt's.
+committed checkpoint dir (legacy `model-<epoch>` or step-granular
+`model_<step>`, the orbax layouts of train/checkpoint.py) and relaunches
+the SAME command with `--checkpoint <newest>` (replacing any prior value).
+
+Exit-code contract (docs/FAULT_TOLERANCE.md):
+
+* ``PREEMPT_EXIT_CODE`` (75, sysexits EX_TEMPFAIL) — the worker caught
+  SIGTERM, checkpointed, and exited cleanly. Relaunched IMMEDIATELY (no
+  backoff) and the retry budget is untouched — but only when the
+  checkpoint actually advanced since the last launch; a trainer stuck in
+  an exit-75 loop without making progress consumes the budget like any
+  crash (otherwise a broken job would relaunch forever).
+* any other nonzero — a crash. Relaunch after ``--backoff`` seconds, up
+  to ``--retries`` times. The budget RESETS whenever a relaunch dies with
+  a newer checkpoint than the previous attempt had: forward progress
+  means the job is healthy and the environment is flaky, so a long run
+  is not killed by N spread-out outages (tools/tpu_outage_r4.log ate 4
+  in one night).
+
+A run that produced no checkpoint yet is relaunched from scratch. Exit
+code is the final attempt's. This file is stdlib-only (it must not drag
+jax into the supervisor process); PREEMPT_EXIT_CODE is therefore
+duplicated from seist_tpu/train/checkpoint.py — a unit test pins the two
+constants together.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import re
 import subprocess
 import sys
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
+
+# Keep in sync with seist_tpu.train.checkpoint.PREEMPT_EXIT_CODE
+# (tests/test_supervise.py::test_preempt_code_matches_trainer).
+PREEMPT_EXIT_CODE = 75
+
+# Committed checkpoint dirs: legacy epoch naming `model-<epoch>` or the
+# step-granular manager naming `model_<step>`.
+_CKPT_RE = re.compile(r"^model[-_](\d+)$")
+# Orbax in-progress dirs (e.g. `model_7.orbax-checkpoint-tmp-123`): a
+# crash mid-save leaves one with the newest mtime, and resuming from it
+# would fail on every retry. Match the exact orbax marker, NOT a bare
+# "tmp" substring — that rejected legitimate names containing those
+# three letters anywhere.
+_ORBAX_TMP_MARKER = ".orbax-checkpoint-tmp-"
+
+
+def checkpoint_step(path_or_name: str) -> Optional[int]:
+    """Step/epoch number parsed from a checkpoint dir name, else None."""
+    m = _CKPT_RE.match(os.path.basename(str(path_or_name)))
+    return int(m.group(1)) if m else None
 
 
 def find_newest_checkpoint(log_base: str) -> Optional[str]:
-    """Newest `*/checkpoints/model-*` dir under ``log_base`` by mtime."""
-    newest, newest_t = None, -1.0
+    """Newest committed `*/checkpoints/model{-,_}<n>` dir under
+    ``log_base`` by mtime (step number breaks same-second ties)."""
+    newest: Optional[str] = None
+    newest_key: Tuple[float, int] = (-1.0, -1)
     for dirpath, dirnames, _ in os.walk(log_base):
         if os.path.basename(dirpath) != "checkpoints":
             continue
         for d in dirnames:
-            # Skip orbax in-progress dirs (e.g. model-7.orbax-checkpoint-
-            # tmp-<ts>): a crash mid-save leaves one with the newest mtime,
-            # and resuming from it would fail on every retry.
-            if not d.startswith("model-") or "tmp" in d:
+            if _ORBAX_TMP_MARKER in d:
+                continue  # interrupted save: never resume from it
+            step = checkpoint_step(d)
+            if step is None:
                 continue
             p = os.path.join(dirpath, d)
-            t = os.path.getmtime(p)
-            if t > newest_t:
-                newest, newest_t = p, t
+            key = (os.path.getmtime(p), step)
+            if key > newest_key:
+                newest, newest_key = p, key
     return newest
 
 
@@ -79,9 +121,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         usage="supervise.py [--retries N] [--backoff S] -- <command...>",
     )
     ap.add_argument("--retries", type=int, default=3,
-                    help="max relaunches after the first attempt (default 3)")
+                    help="max relaunches after a crash WITHOUT checkpoint "
+                    "progress (default 3); progress resets the budget")
     ap.add_argument("--backoff", type=float, default=30.0,
-                    help="seconds to wait before each relaunch (default 30)")
+                    help="seconds to wait before a crash relaunch "
+                    "(default 30); clean preempts relaunch immediately")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="the training command, after `--`")
     args = ap.parse_args(argv)
@@ -94,24 +138,46 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     log_base = _arg_value(cmd, "--log-base") or "./logs"
 
-    rc = 0
-    for attempt in range(args.retries + 1):
-        if attempt:
-            ckpt = find_newest_checkpoint(log_base)
-            if ckpt:
-                cmd = with_checkpoint(cmd, ckpt)
-                print(f"[supervise] resuming from {ckpt}", file=sys.stderr)
-            else:
-                print("[supervise] no checkpoint yet; restarting fresh",
-                      file=sys.stderr)
-            time.sleep(args.backoff)
-        print(f"[supervise] attempt {attempt + 1}/{args.retries + 1}: "
-              f"{' '.join(cmd)}", file=sys.stderr, flush=True)
+    def _log(msg: str) -> None:
+        print(f"[supervise] {msg}", file=sys.stderr, flush=True)
+
+    failures = 0  # crash relaunches since the last checkpoint progress
+    attempt = 0
+    prev_ckpt = find_newest_checkpoint(log_base)
+    while True:
+        attempt += 1
+        _log(f"attempt {attempt} (budget {failures}/{args.retries} used): "
+             f"{' '.join(cmd)}")
         rc = subprocess.call(cmd)
         if rc == 0:
             return 0
-        print(f"[supervise] exited rc={rc}", file=sys.stderr, flush=True)
-    return rc
+        ckpt = find_newest_checkpoint(log_base)
+        # Progress = the newest checkpoint CHANGED (a new step in the
+        # same run, or a fresh run's first save). Comparing raw step
+        # numbers across the whole log_base would let a stale higher-step
+        # checkpoint from an old run sharing the tree mask every new
+        # run's progress and burn the budget on clean preempts.
+        progressed = ckpt is not None and ckpt != prev_ckpt
+        if progressed:
+            # Forward progress: the job is healthy, the environment flaky.
+            failures = 0
+        if rc == PREEMPT_EXIT_CODE and progressed:
+            _log(f"clean preempt (rc={rc}), checkpoint advanced to "
+                 f"{ckpt}: immediate relaunch, retry budget untouched")
+        else:
+            failures += 1
+            _log(f"exited rc={rc} "
+                 f"({'no checkpoint progress' if not progressed else 'crash'}); "
+                 f"budget {failures}/{args.retries} used")
+            if failures > args.retries:
+                return rc
+            time.sleep(args.backoff)
+        if ckpt:
+            cmd = with_checkpoint(cmd, ckpt)
+            _log(f"resuming from {ckpt}")
+        else:
+            _log("no checkpoint yet; restarting fresh")
+        prev_ckpt = ckpt
 
 
 if __name__ == "__main__":
