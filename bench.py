@@ -569,6 +569,174 @@ def _setup_model(cfg: dict, tx=None):
     return spec, loss_fn, state
 
 
+def measure_input_split(spec, loss_fn, cfg: dict, steps: int) -> dict:
+    """Host-wait vs device-compute split of the training input pipeline,
+    measured BOTH ways in the same run (BENCH_PIPELINE_STEPS knob):
+
+    * ``host_path`` — the classic loop: per-sample numpy augmentation +
+      Python stacking on the host, ``device_put``, then the jitted step.
+      ``host_wait`` is everything before the device can start.
+    * ``device_aug_cached`` — raw epochs resident on device
+      (data/pipeline.DeviceEpochCache), augmentation + label synthesis
+      inside the jitted step; the only per-step host work is handing over
+      a (1, B) int32 index array.
+
+    The per-path ``input_bound_fraction`` (utils/profiling.StepTimeSplit)
+    is the input-bound→compute-bound evidence the r05 silicon run needs:
+    host_path ~1 and cached ~0 means the chip was idling behind the input
+    pipeline and no longer is.
+    """
+    from seist_tpu.utils.logger import logger as _logger
+
+    # Dataset/loader construction logs to the console handler, which
+    # writes to stdout — keep the BENCH stdout contract (one JSON line)
+    # from picking up more noise than it already tolerates.
+    _logger.enable_console(False)
+    try:
+        return _measure_input_split(spec, loss_fn, cfg, steps)
+    finally:
+        _logger.enable_console(True)
+
+
+def _measure_input_split(spec, loss_fn, cfg: dict, steps: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from seist_tpu import taskspec as _ts
+    from seist_tpu.data import device_aug as da
+    from seist_tpu.data import pipeline as pl
+    from seist_tpu.train import make_cached_train_call, make_train_step
+    from seist_tpu.utils.profiling import StepTimeSplit
+
+    batch, in_samples = cfg["batch"], cfg["in_samples"]
+    dtype = cfg["dtype"]
+    label_kinds = {
+        _ts.get_kind(n) for n in _ts.flatten_io_names(spec.labels)
+    }
+    aug_rates = dict(
+        shift_event_rate=0.2,
+        add_noise_rate=0.4,
+        add_gap_rate=0.4,
+        drop_channel_rate=0.4,
+        scale_amplitude_rate=0.4,
+        pre_emphasis_rate=0.4,
+        # generate_noise clears VALUE/ONEHOT labels (host path crashes,
+        # device path refuses) — only enable it for soft-label specs.
+        generate_noise_rate=(
+            0.05 if label_kinds == {_ts.SOFT} else 0.0
+        ),
+    )
+    n_events = max(batch, batch * (steps + 2) // 2)
+    sds = pl.from_task_spec(
+        spec,
+        "synthetic",
+        "train",
+        seed=0,
+        in_samples=in_samples,
+        augmentation=True,
+        data_split=False,
+        shuffle=True,
+        dataset_kwargs={
+            "num_events": n_events,
+            "trace_samples": in_samples + in_samples // 2,
+        },
+        **aug_rates,
+    )
+    key = jax.random.PRNGKey(0)
+
+    def fresh_state():
+        # Same construction as the headline bench (_setup_model), so the
+        # split measures the program the bench actually times.
+        return _setup_model(cfg)[2]
+
+    # -- host path --------------------------------------------------------
+    split_host = StepTimeSplit(skip_first=1)
+    state = fresh_state()
+    step = jax.jit(make_train_step(spec, loss_fn, compute_dtype=dtype))
+    loader = pl.Loader(
+        sds, batch_size=batch, shuffle=True, drop_last=True, num_workers=8
+    )
+    try:
+        it, epoch = iter(loader), 0
+        for _ in range(steps + 1):
+            t0 = time.perf_counter()
+            b = next(it, None)
+            if b is None:
+                epoch += 1
+                loader.set_epoch(epoch)
+                it = iter(loader)
+                b = next(it)
+            x = jax.device_put(b.inputs)
+            y = jax.device_put(b.loss_targets)
+            jax.block_until_ready((x, y))
+            t1 = time.perf_counter()
+            state, loss, _ = step(state, x, y, key)
+            jax.block_until_ready(loss)
+            split_host.step(t1 - t0, time.perf_counter() - t1)
+    finally:
+        loader.close()
+
+    # -- cached device-aug path -------------------------------------------
+    store = pl.RawStore.build(sds)
+    cache = pl.DeviceEpochCache(store)
+    acfg = da.AugConfig.from_preprocessor(
+        sds.preprocessor,
+        seed=0,
+        raw_len=store.raw_len,
+        phase_slots=store.phase_slots,
+    )
+    proc = da.make_cache_processor(
+        acfg, sds.input_names, sds.label_names,
+        n_raw=store.n_raw, augmentation=store.augmentation,
+    )
+    call = jax.jit(
+        make_cached_train_call(
+            spec, loss_fn, proc, steps_per_call=1, compute_dtype=dtype
+        )
+    )
+    split_cached = StepTimeSplit(skip_first=1)
+    state = fresh_state()
+
+    def chunk_stream():
+        epoch = 0
+        while True:
+            yield from (
+                (epoch, c)
+                for c in cache.epoch_index_chunks(
+                    epoch, seed=0, shuffle=True,
+                    batch_size=batch, steps_per_call=1,
+                )
+            )
+            epoch += 1
+
+    chunks = chunk_stream()
+    for _ in range(steps + 1):
+        t0 = time.perf_counter()
+        epoch, idx = next(chunks)
+        idx_dev = jax.block_until_ready(jnp.asarray(idx))
+        t1 = time.perf_counter()
+        state, loss, _ = call(
+            state, cache.arrays, idx_dev, jnp.int32(epoch), key
+        )
+        jax.block_until_ready(loss)
+        split_cached.step(t1 - t0, time.perf_counter() - t1)
+
+    host = split_host.summary()
+    cached = split_cached.summary()
+    return {
+        "steps": steps,
+        "batch": batch,
+        "cache_mib": round(cache.nbytes / 2**20, 1),
+        "host_path": host,
+        "device_aug_cached": cached,
+        # The tentpole claim, decided from numbers measured in THIS run.
+        "host_stack_removed": (
+            (host["host_wait_ms_per_step"] or 0.0)
+            > (cached["host_wait_ms_per_step"] or 0.0)
+        ),
+    }
+
+
 def bench_train(device_kind: str) -> None:
     import jax
 
@@ -665,10 +833,28 @@ def bench_train(device_kind: str) -> None:
     from seist_tpu.ops.pallas_attention import kernel_status_summary
 
     ks = kernel_status_summary()
+
+    # Input-pipeline split (BENCH_PIPELINE_STEPS=0 disables): host-path
+    # vs cached-device-aug host-wait/device-time per step, measured in
+    # THIS run so the input_bound_fraction claim is self-contained.
+    split = None
+    psteps = int(os.environ.get("BENCH_PIPELINE_STEPS", 4))
+    if psteps > 0:
+        t_split = time.time()
+        try:
+            split = measure_input_split(spec, loss_fn, cfg, psteps)
+            _eprint(f"input-split measured in {time.time() - t_split:.1f}s")
+        except Exception as e:  # noqa: BLE001 - split is diagnostics only
+            _eprint(f"input-split measurement failed: {e!r}")
+
     payload = {
         "metric": metric,
         "value": round(wfs, 2),
         "unit": unit,
+        "input_pipeline": split,
+        "input_bound_fraction": (
+            (split or {}).get("host_path", {}).get("input_bound_fraction")
+        ),
         "vs_baseline": vs_anchor,  # null when cost analysis gave no FLOPs
         "baseline": (
             "one A100 at a frozen 3% MFU analytical anchor "

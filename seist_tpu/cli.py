@@ -71,14 +71,17 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "steady-state train steps (first epoch, after "
                         "warmup) into <logdir>/profile; view with "
                         "TensorBoard's profile plugin. Default 0 = off")
-    parser.add_argument("--steps-per-call", default=1, type=int,
+    parser.add_argument("--steps-per-call", default=0, type=int,
                         dest="steps_per_call",
                         help="scan this many optimizer updates inside one "
                         "jitted call (distinct micro-batches, NOT gradient "
                         "accumulation) — amortizes per-dispatch latency on "
                         "remote/contended devices. Per-step train metrics "
                         "are skipped (loss only); trailing batches that "
-                        "don't fill a call are dropped. Default 1")
+                        "don't fill a call are dropped. Default 0 = auto: "
+                        "1 on the host path, min(32, steps/epoch) under "
+                        "--device-aug cached (pass an explicit 1 to keep "
+                        "per-step save/preempt granularity there)")
     parser.add_argument("--grad-accum-steps", default=1, type=int,
                         dest="grad_accum_steps",
                         help="accumulate gradients over this many "
@@ -91,6 +94,25 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "trailing batches that don't fill an update are "
                         "dropped, as with --steps-per-call. Mutually "
                         "exclusive with --steps-per-call. Default 1")
+
+    parser.add_argument("--device-aug", default="off", type=str,
+                        choices=["off", "step", "cached"], dest="device_aug",
+                        help="device-side augmentation + label synthesis "
+                        "(docs/DATA_PIPELINE.md). 'step': the jitted train "
+                        "step augments raw rows the host feeds (no "
+                        "per-sample numpy work, no Python stacking). "
+                        "'cached': whole raw epochs live in HBM, sharded "
+                        "over the mesh data axis, and a scan executor "
+                        "consumes (k,B) index arrays — zero per-step host "
+                        "stacking; falls back to 'step' over the HBM "
+                        "budget, to 'off' on unsupported configs (both "
+                        "logged). Default off")
+    parser.add_argument("--device-aug-hbm-gb", default=0.0, type=float,
+                        dest="device_aug_hbm_gb",
+                        help="HBM budget (GiB) for the --device-aug cached "
+                        "epoch store. 0 = auto: half the device "
+                        "bytes_limit, or 4 GiB when the backend reports "
+                        "no memory stats")
 
     # Random seed
     parser.add_argument("--seed", default=0, type=int)

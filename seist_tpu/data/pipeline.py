@@ -33,7 +33,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from seist_tpu import taskspec
-from seist_tpu.data.preprocess import DataPreprocessor
+from seist_tpu.data.preprocess import DataPreprocessor, pad_phases
 from seist_tpu.registry import DATASETS
 from seist_tpu.utils.logger import logger
 
@@ -111,6 +111,28 @@ class SeismicDataset:
     def preprocessor(self) -> DataPreprocessor:
         return self._preprocessor
 
+    @property
+    def augmentation(self) -> bool:
+        return self._augmentation
+
+    @property
+    def raw_size(self) -> int:
+        """Number of RAW events (len() doubles under augmentation)."""
+        return self._dataset_size
+
+    @property
+    def input_names(self) -> list:
+        return list(self._input_names)
+
+    @property
+    def label_names(self) -> list:
+        return list(self._label_names)
+
+    def raw_event(self, idx: int):
+        """One UNprocessed event + meta — the device-aug upload path reads
+        raw traces here and runs augmentation/labels on device."""
+        return self._dataset[idx % self._dataset_size]
+
     def sampling_rate(self) -> int:
         return self._dataset.sampling_rate()
 
@@ -170,6 +192,34 @@ def from_task_spec(
         task_names=list(spec.eval),
         **kwargs,
     )
+
+
+def epoch_indices(
+    n: int,
+    *,
+    seed: int,
+    epoch: int,
+    shuffle: bool,
+    num_shards: int = 1,
+    shard_index: int = 0,
+) -> np.ndarray:
+    """This host's epoch-``epoch`` sample order — THE shuffle contract
+    shared by the host :class:`Loader` and the device-aug executors, so
+    both paths consume the identical global sample sequence: seeded
+    permutation (a pure function of (seed, epoch) — mid-epoch resume
+    depends on this), head-wrapped to equalize shard sizes (torch
+    ``DistributedSampler``'s pad rule; unequal step counts would deadlock
+    the collective-bearing jitted steps), interleaved ``rank::world``."""
+    if shuffle:
+        rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+        order = rng.permutation(n)
+    else:
+        order = np.arange(n)
+    if num_shards > 1:
+        target = -(-n // num_shards) * num_shards
+        if target > n:
+            order = np.concatenate([order, order[: target - n]])
+    return order[shard_index::num_shards]
 
 
 def _stack(samples: List[Any]) -> Any:
@@ -261,24 +311,14 @@ class Loader:
             pass
 
     def _indices(self) -> np.ndarray:
-        n = len(self.dataset)
-        if self.shuffle:
-            rng = np.random.default_rng(
-                np.random.SeedSequence([self.seed, self.epoch])
-            )
-            order = rng.permutation(n)
-        else:
-            order = np.arange(n)
-        if self.num_shards > 1:
-            # Equalize shard sizes by wrapping the head (exactly torch
-            # DistributedSampler's pad-to-even rule): every host must see
-            # the SAME number of batches or the collective-bearing jitted
-            # steps deadlock mid-epoch.
-            target = -(-n // self.num_shards) * self.num_shards
-            if target > n:
-                order = np.concatenate([order, order[: target - n]])
-        # Interleaved host shard (DistributedSampler-style: rank::world).
-        return order[self.shard_index :: self.num_shards]
+        return epoch_indices(
+            len(self.dataset),
+            seed=self.seed,
+            epoch=self.epoch,
+            shuffle=self.shuffle,
+            num_shards=self.num_shards,
+            shard_index=self.shard_index,
+        )
 
     def __len__(self) -> int:
         n = len(self._indices())
@@ -446,6 +486,293 @@ def prefetch_to_device(
         )
 
     yield from _double_buffer(iterator, put, prefetch)
+
+
+class RawStore:
+    """Host-side fixed-shape raw arrays for the device-aug paths
+    (``--device-aug step|cached``): every raw trace decoded ONCE, the
+    draw-free preprocessing (``_is_noise`` classification + ``pad_phases``)
+    precomputed per sample, VALUE/ONEHOT label fields extracted to dense
+    arrays. The per-step host work collapses to (at most) a fancy-index
+    row gather — all augmentation, windowing, normalization and label
+    synthesis happen on device (seist_tpu/data/device_aug.py).
+
+    Requires a uniform raw trace length (every real dataset here decodes
+    fixed-length traces); :meth:`build` raises ``ValueError`` otherwise
+    and the worker falls back to the host path.
+    """
+
+    def __init__(
+        self,
+        arrays: Dict[str, Any],
+        *,
+        n_raw: int,
+        augmentation: bool,
+        raw_len: int,
+        phase_slots: int,
+    ) -> None:
+        self.arrays = arrays
+        self.n_raw = int(n_raw)
+        self.augmentation = bool(augmentation)
+        self.raw_len = int(raw_len)
+        self.phase_slots = int(phase_slots)
+
+    def __len__(self) -> int:
+        # 2x-epoch rule: raw copy for idx < n_raw, augmented for >= n_raw
+        # (matches SeismicDataset.__len__).
+        return 2 * self.n_raw if self.augmentation else self.n_raw
+
+    @property
+    def nbytes(self) -> int:
+        import jax
+
+        return int(
+            sum(np.asarray(a).nbytes for a in jax.tree.leaves(self.arrays))
+        )
+
+    @classmethod
+    def estimate_bytes(cls, sds: SeismicDataset) -> int:
+        """Resident-cache size estimate WITHOUT decoding the dataset:
+        one sample's raw waveform bytes x dataset size (phase/value
+        sidecars are noise next to the waveforms)."""
+        event, _ = sds.raw_event(0)
+        return int(
+            np.asarray(event["data"]).astype(np.float32, copy=False).nbytes
+            * sds.raw_size
+        )
+
+    @classmethod
+    def build(cls, sds: SeismicDataset) -> "RawStore":
+        pre = sds.preprocessor
+        names = taskspec.flatten_io_names(
+            sds.input_names + sds.label_names
+        )
+        value_names = sorted(
+            {n for n in names if taskspec.get_kind(n) == taskspec.VALUE}
+        )
+        onehot_names = sorted(
+            {n for n in names if taskspec.get_kind(n) == taskspec.ONEHOT}
+        )
+
+        from seist_tpu.data import device_aug as da
+
+        # ONE decode pass per sample (the expensive part); the big
+        # waveform arrays are written straight into the final stacked
+        # buffer and per-sample events are dropped as they are consumed,
+        # so peak host RAM stays ~1x the dataset. The cheap
+        # _is_noise/pad_phases list math runs twice (once to size
+        # phase_slots, once inside host_prepare — the ONE implementation
+        # of the row contract the device kernels rely on).
+        n = sds.raw_size
+        events: List[Optional[dict]] = []
+        raw_len = None
+        max_phases = 1
+        for i in range(n):
+            event, _ = sds.raw_event(i)
+            length = int(np.asarray(event["data"]).shape[-1])
+            if raw_len is None:
+                raw_len = length
+            elif length != raw_len:
+                raise ValueError(
+                    f"device-aug needs uniform raw trace lengths; sample "
+                    f"{i} has {length} != {raw_len}"
+                )
+            ppks, spks = list(event["ppks"]), list(event["spks"])
+            if not pre._is_noise(event["data"], ppks, spks, event["snr"]):
+                p, s = pad_phases(
+                    ppks, spks, pre.min_event_gap, pre.in_samples
+                )
+                max_phases = max(max_phases, len(p), len(s))
+            events.append(event)
+        phase_slots = max(max_phases, pre._max_event_num)
+        n_ch = len(pre.data_channels)
+
+        arrays: Dict[str, Any] = {
+            "data": np.empty((n, n_ch, int(raw_len or 0)), np.float32),
+            "ppks": np.empty((n, phase_slots), np.int32),
+            "np_p": np.empty((n,), np.int32),
+            "spks": np.empty((n, phase_slots), np.int32),
+            "np_s": np.empty((n,), np.int32),
+        }
+        vals = {name: np.zeros((n, 1), np.float32) for name in value_names}
+        oh = {name: np.zeros((n,), np.int32) for name in onehot_names}
+        for i in range(n):
+            event = events[i]
+            events[i] = None  # free as consumed
+            row = da.host_prepare(pre, event, phase_slots)
+            arrays["data"][i] = row["data"]
+            arrays["ppks"][i] = row["ppks"]
+            arrays["np_p"][i] = row["np_p"]
+            arrays["spks"][i] = row["spks"]
+            arrays["np_s"][i] = row["np_s"]
+            if row["is_noise"] and (value_names or onehot_names):
+                # The host path ERRORS on a noise-classified trace with
+                # VALUE/ONEHOT labels (_clear_event_except empties the
+                # field and get_io_item raises / stacking fails);
+                # zero-filling here would silently train on fabricated
+                # labels. Refuse — the worker falls back to the host
+                # path, which surfaces the dataset problem loudly.
+                raise ValueError(
+                    f"sample {i} is noise-classified but the task has "
+                    f"VALUE/ONEHOT labels "
+                    f"({value_names + onehot_names}); the device path "
+                    "will not fabricate label values for it"
+                )
+            for name in value_names:
+                v = np.asarray(event.get(name, []), np.float32)
+                if v.size == 0:  # host path would crash at stacking
+                    raise ValueError(
+                        f"sample {i} has no '{name}' value; refusing to "
+                        "fabricate a device-path label"
+                    )
+                vals[name][i] = v.reshape(-1)[:1]
+            for name in onehot_names:
+                v = event.get(name, [])
+                if not len(v):  # host get_io_item raises here too
+                    raise ValueError(
+                        f"sample {i} has no '{name}' class; refusing to "
+                        "fabricate a device-path label"
+                    )
+                oh[name][i] = int(v[0])
+        if value_names:
+            arrays["values"] = vals
+        if onehot_names:
+            arrays["onehots"] = oh
+        return cls(
+            arrays,
+            n_raw=n,
+            augmentation=sds.augmentation,
+            raw_len=int(raw_len or 0),
+            phase_slots=phase_slots,
+        )
+
+    def row_batch(self, raw_idx: np.ndarray) -> Dict[str, Any]:
+        """Fancy-index a batch of raw rows (numpy; the step-mode per-step
+        host work)."""
+        import jax
+
+        return jax.tree.map(lambda a: a[raw_idx], self.arrays)
+
+
+class DeviceEpochCache:
+    """HBM-resident raw epochs (``--device-aug cached``): the RawStore
+    arrays uploaded ONCE, sample axis sharded over the mesh's ``data``
+    axis (sample count padded to divisibility; pad rows are never
+    indexed). Each train step then only receives a (k, B) int32 index
+    array — there is no per-step sample traffic across the host boundary
+    at all."""
+
+    def __init__(self, store: RawStore, mesh=None) -> None:
+        import jax
+
+        self.store = store
+        arrays = store.arrays
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from seist_tpu.parallel.mesh import AXIS_DATA
+
+            shards = mesh.shape[AXIS_DATA]
+            n = store.n_raw
+            pad = (-n) % shards
+            if pad:
+                arrays = jax.tree.map(
+                    lambda a: np.concatenate(
+                        [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
+                    ),
+                    arrays,
+                )
+            sharding = NamedSharding(mesh, P(AXIS_DATA))
+            self.arrays = jax.tree.map(
+                lambda a: jax.device_put(a, sharding), arrays
+            )
+        else:
+            self.arrays = jax.tree.map(jax.device_put, arrays)
+        self.nbytes = int(
+            sum(a.nbytes for a in jax.tree.leaves(self.arrays))
+        )
+
+    def epoch_index_chunks(
+        self,
+        epoch: int,
+        *,
+        seed: int,
+        shuffle: bool,
+        batch_size: int,
+        steps_per_call: int,
+        start_batch: int = 0,
+    ):
+        """Yield (k, B) int32 global-index arrays for one epoch — the
+        same global sample sequence the host Loader would produce
+        (:func:`epoch_indices`), chunked for the scan-based executor.
+        Trailing part-groups are dropped (drop-last + static jit shapes,
+        as on the packed host path)."""
+        order = epoch_indices(
+            len(self.store), seed=seed, epoch=epoch, shuffle=shuffle
+        )
+        nb = len(order) // batch_size
+        calls = nb // steps_per_call
+        for c in range(start_batch // steps_per_call, calls):
+            flat = order[
+                c * steps_per_call * batch_size
+                : (c + 1) * steps_per_call * batch_size
+            ]
+            yield np.asarray(
+                flat.reshape(steps_per_call, batch_size), np.int32
+            )
+
+
+def iter_raw_batches(
+    store: RawStore,
+    epoch: int,
+    *,
+    seed: int,
+    shuffle: bool,
+    batch_size: int,
+    num_shards: int = 1,
+    shard_index: int = 0,
+    start_batch: int = 0,
+):
+    """Step-mode (``--device-aug step``) feed: per batch, gather the raw
+    rows on host (a numpy fancy index — no per-sample augmentation, no
+    label synthesis, no Python stacking) and yield
+    ``(rows, idx, aug)`` for the augment-inside-the-step train step.
+    Sample order matches the host Loader exactly (:func:`epoch_indices`,
+    drop-last)."""
+    order = epoch_indices(
+        len(store),
+        seed=seed,
+        epoch=epoch,
+        shuffle=shuffle,
+        num_shards=num_shards,
+        shard_index=shard_index,
+    )
+    nb = len(order) // batch_size
+    n_raw = store.n_raw
+    for b in range(start_batch, nb):
+        sel = np.asarray(order[b * batch_size : (b + 1) * batch_size], np.int64)
+        raw = sel % n_raw if store.augmentation else sel
+        aug = (
+            (sel >= n_raw)
+            if store.augmentation
+            else np.zeros(sel.shape, bool)
+        )
+        yield store.row_batch(raw), sel.astype(np.int32), aug
+
+
+def prefetch_raw_to_device(iterator, mesh, prefetch: int = 2):
+    """Double-buffered device feed for :func:`iter_raw_batches` items:
+    rows/idx/aug all batch-sharded on ``data`` (same placement rule as
+    the host path's batches)."""
+    if mesh is None:
+        yield from iterator
+        return
+
+    from seist_tpu.parallel.mesh import shard_batch
+
+    yield from _double_buffer(
+        iterator, lambda item: shard_batch(mesh, item), prefetch
+    )
 
 
 def prefetch_packed_to_device(

@@ -84,6 +84,36 @@ def normalize(
     return data / scale
 
 
+def make_soft_window(soft_label_width: int, soft_label_shape: str) -> np.ndarray:
+    """The (width+1)-sample soft-label window (ref: preprocess.py:571-601).
+
+    Module-level so the device-side label synthesis
+    (seist_tpu/data/device_aug.py) shares the ONE window formula with
+    :class:`DataPreprocessor` — the gaussian's fixed sigma-10 quirk must
+    never fork between the host and device paths.
+    """
+    left = int(soft_label_width / 2)
+    right = soft_label_width - left
+    if soft_label_shape == "gaussian":
+        # NB the gaussian sigma is fixed at 10 regardless of label width
+        # (ref quirk, preprocess.py:576-578).
+        return np.exp(-((np.arange(-left, right + 1)) ** 2) / (2 * 10**2))
+    if soft_label_shape == "triangle":
+        return 1 - np.abs(2 / soft_label_width * np.arange(-left, right + 1))
+    if soft_label_shape == "box":
+        return np.ones(soft_label_width + 1)
+    if soft_label_shape == "sigmoid":
+        def _sigmoid(x):
+            return 1 / (1 + np.exp(x))
+
+        l_l, l_r = -int(left / 2), left - int(left / 2)
+        r_l, r_r = -int(right / 2), right - int(right / 2)
+        x_l = -10 / left * np.arange(l_l, l_r)
+        x_r = -10 / right * (-1) * np.arange(r_l, r_r)
+        return np.concatenate((_sigmoid(x_l), [1.0], _sigmoid(x_r)), axis=0)
+    raise NotImplementedError(f"Unsupported label shape: '{soft_label_shape}'")
+
+
 def pad_phases(
     ppks: list, spks: list, padding_idx: int, num_samples: int
 ) -> Tuple[list, list]:
@@ -503,26 +533,7 @@ class DataPreprocessor:
     def _make_soft_window(
         self, soft_label_width: int, soft_label_shape: str
     ) -> np.ndarray:
-        left = int(soft_label_width / 2)
-        right = soft_label_width - left
-        if soft_label_shape == "gaussian":
-            # NB the gaussian sigma is fixed at 10 regardless of label width
-            # (ref quirk, preprocess.py:576-578).
-            return np.exp(-((np.arange(-left, right + 1)) ** 2) / (2 * 10**2))
-        if soft_label_shape == "triangle":
-            return 1 - np.abs(2 / soft_label_width * np.arange(-left, right + 1))
-        if soft_label_shape == "box":
-            return np.ones(soft_label_width + 1)
-        if soft_label_shape == "sigmoid":
-            def _sigmoid(x):
-                return 1 / (1 + np.exp(x))
-
-            l_l, l_r = -int(left / 2), left - int(left / 2)
-            r_l, r_r = -int(right / 2), right - int(right / 2)
-            x_l = -10 / left * np.arange(l_l, l_r)
-            x_r = -10 / right * (-1) * np.arange(r_l, r_r)
-            return np.concatenate((_sigmoid(x_l), [1.0], _sigmoid(x_r)), axis=0)
-        raise NotImplementedError(f"Unsupported label shape: '{soft_label_shape}'")
+        return make_soft_window(soft_label_width, soft_label_shape)
 
     def _soft_label(
         self, idxs, length: int, soft_label_width: int, soft_label_shape: str

@@ -16,9 +16,13 @@ from seist_tpu.train.schedule import (  # noqa: F401
 from seist_tpu.train.state import TrainState, create_train_state  # noqa: F401
 from seist_tpu.train.step import (  # noqa: F401
     fold_rngs,
+    jit_cached_call,
+    jit_device_aug_step,
     jit_eval_step,
     jit_multi_step,
     jit_step,
+    make_cached_train_call,
+    make_device_aug_train_step,
     make_eval_step,
     make_accum_train_step,
     make_multi_train_step,

@@ -194,14 +194,9 @@ def make_multi_train_step(
             state, (losses, applied) = jax.lax.scan(
                 body, state, (inputs_k, targets_k)
             )
-            n_ok = applied.sum()
-            mean_loss = jnp.where(
-                n_ok > 0,
-                jnp.where(applied > 0, losses, 0.0).sum()
-                / jnp.maximum(n_ok, 1).astype(losses.dtype),
-                jnp.float32(jnp.nan),
-            )
-            return state, mean_loss, None, {"applied": applied}
+            return state, _finite_mean(losses, applied), None, {
+                "applied": applied
+            }
 
         return guarded_multi_step
 
@@ -215,6 +210,151 @@ def make_multi_train_step(
         return state, losses.mean(), None
 
     return multi_step
+
+
+def _finite_mean(losses, applied):
+    """Mean loss over the applied (finite) micro-steps of a scanned call;
+    NaN when every step was skipped (callers log it but never feed it
+    back into params)."""
+    n_ok = applied.sum()
+    return jnp.where(
+        n_ok > 0,
+        jnp.where(applied > 0, losses, 0.0).sum()
+        / jnp.maximum(n_ok, 1).astype(losses.dtype),
+        jnp.float32(jnp.nan),
+    )
+
+
+def make_device_aug_train_step(
+    spec: TaskSpec,
+    loss_fn: Callable,
+    process_rows: Callable,
+    compute_dtype: Optional[str] = None,
+    guard: bool = False,
+) -> Callable:
+    """Build the augment-inside-the-step variant (``--device-aug step``):
+
+    ``step(state, rows, idx, aug, epoch, rng)`` where ``rows`` is a raw
+    sample-row pytree (data/pipeline.RawStore batch), ``idx`` the (B,)
+    global epoch indices keying the augmentation PRNG, ``aug`` the (B,)
+    augment flags. ``process_rows`` (data/device_aug.make_row_processor)
+    turns them into (inputs, targets) INSIDE the jitted program — the
+    host never runs per-sample numpy augmentation, label synthesis, or
+    Python stacking; it only gathers raw rows. Jit with
+    :func:`jit_device_aug_step`.
+
+    Returns ``(state, loss, None[, diag])`` — per-step model outputs are
+    not exposed (the device path has no host-side metrics targets to
+    score them against, and returning them would force a cross-device
+    gather under the replicated out_shardings).
+    """
+    base = make_train_step(spec, loss_fn, compute_dtype, guard=guard)
+
+    def device_aug_step(state: TrainState, rows, idx, aug, epoch, rng):
+        inputs, targets = process_rows(rows, idx, aug, epoch)
+        ret = base(state, inputs, targets, rng)
+        if guard:
+            st, loss, _, diag = ret
+            return st, loss, None, diag
+        st, loss, _ = ret
+        return st, loss, None
+
+    return device_aug_step
+
+
+def jit_device_aug_step(step_fn: Callable, mesh: Optional[Mesh]) -> Callable:
+    """Jit a :func:`make_device_aug_train_step` function: rows/idx/aug
+    batch-sharded on ``data``; state/epoch/rng replicated. Outputs are
+    pinned replicated — without the pin GSPMD is free to hand back
+    data-sharded state leaves, which then clash with the replicated
+    in_shardings of the next consumer (the eval step)."""
+    donate = (0,)
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=donate)
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("data"))
+    return jax.jit(
+        step_fn,
+        in_shardings=(repl, data, data, data, repl, repl),
+        out_shardings=repl,
+        donate_argnums=donate,
+    )
+
+
+def make_cached_train_call(
+    spec: TaskSpec,
+    loss_fn: Callable,
+    process_cache: Callable,
+    steps_per_call: int = 1,
+    compute_dtype: Optional[str] = None,
+    guard: bool = False,
+) -> Callable:
+    """Build the scan-based epoch executor over an HBM-resident raw cache
+    (``--device-aug cached``):
+
+    ``call(state, cache, idx_k, epoch, rng) -> (state, mean_loss, None
+    [, diag])`` runs ``steps_per_call`` optimizer updates inside ONE
+    jitted program; each scanned step gathers its raw rows from
+    ``cache`` by the (k, B) ``idx_k`` slice, augments + synthesizes
+    labels on device (``process_cache`` =
+    data/device_aug.make_cache_processor), and updates. The only
+    per-call host->device traffic is the k*B int32 indices — per-step
+    host stacking is zero, which is the whole point.
+
+    Guarded calls return the ordered per-micro-step applied mask exactly
+    like :func:`make_multi_train_step`. Jit via :func:`jit_cached_call`.
+    """
+    base = make_train_step(spec, loss_fn, compute_dtype, guard=guard)
+
+    if guard:
+        def guarded_call(state: TrainState, cache, idx_k, epoch, rng):
+            def body(st, idx):
+                x, y = process_cache(cache, idx, epoch)
+                st, loss, _, diag = base(st, x, y, rng)
+                return st, (loss, diag["applied"])
+
+            state, (losses, applied) = jax.lax.scan(body, state, idx_k)
+            return state, _finite_mean(losses, applied), None, {
+                "applied": applied
+            }
+
+        return guarded_call
+
+    def call(state: TrainState, cache, idx_k, epoch, rng):
+        def body(st, idx):
+            x, y = process_cache(cache, idx, epoch)
+            st, loss, _ = base(st, x, y, rng)
+            return st, loss
+
+        state, losses = jax.lax.scan(body, state, idx_k)
+        return state, losses.mean(), None
+
+    return call
+
+
+def jit_cached_call(call_fn: Callable, mesh: Optional[Mesh], cache) -> Callable:
+    """Jit a :func:`make_cached_train_call` function. The cache pytree is
+    sharded on its sample axis over ``data`` (matching
+    pipeline.DeviceEpochCache's upload placement); the (k, B) index array
+    shards its batch axis; state/epoch/rng replicate. ``cache`` is only
+    consulted for its pytree structure."""
+    donate = (0,)
+    if mesh is None:
+        return jax.jit(call_fn, donate_argnums=donate)
+    import jax.tree_util as jtu
+
+    repl = NamedSharding(mesh, P())
+    row_sh = jtu.tree_map(lambda _: NamedSharding(mesh, P("data")), cache)
+    idx_sh = NamedSharding(mesh, P(None, "data"))
+    return jax.jit(
+        call_fn,
+        in_shardings=(repl, row_sh, idx_sh, repl, repl),
+        # Replicated outputs: GSPMD would otherwise be free to hand back
+        # data-sharded state leaves that clash with the eval step's
+        # replicated in_shardings (observed live on the 8-dev CPU mesh).
+        out_shardings=NamedSharding(mesh, P()),
+        donate_argnums=donate,
+    )
 
 
 def make_accum_train_step(

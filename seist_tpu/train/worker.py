@@ -37,11 +37,15 @@ from seist_tpu.train import (
     build_cyclic_schedule,
     build_optimizer,
     create_train_state,
+    jit_cached_call,
+    jit_device_aug_step,
     jit_eval_step,
     jit_multi_step,
     jit_step,
     load_checkpoint,
     make_accum_train_step,
+    make_cached_train_call,
+    make_device_aug_train_step,
     make_eval_step,
     make_multi_train_step,
     make_train_step,
@@ -498,14 +502,151 @@ def train_worker(args: Any) -> str:
     # _guarded_update; docs/FAULT_TOLERANCE.md).
     guard_on = bool(getattr(args, "bad_step_guard", True))
     max_bad = int(getattr(args, "max_bad_steps", 3) or 0)
-    spc = max(1, int(getattr(args, "steps_per_call", 1) or 1))
+    # steps_per_call <= 0 means "auto" (CLI default): 1 on the host path,
+    # raised high under --device-aug cached. An EXPLICIT 1 is honored there
+    # (per-step save/preempt granularity costs throughput but is a choice).
+    spc_raw = int(getattr(args, "steps_per_call", 0) or 0)
+    spc_auto = spc_raw <= 0
+    spc = max(1, spc_raw)
     if spc > 1 and gas > 1:
         raise ValueError(
             "--steps-per-call and --grad-accum-steps are mutually "
             "exclusive (both scan stacked micro-batches, with different "
             "update semantics)"
         )
-    if gas > 1:
+
+    # -- device-side augmentation (--device-aug; docs/DATA_PIPELINE.md) ----
+    # 'step': raw rows cross the host per step, augmentation + label
+    # synthesis run inside the jitted step. 'cached': whole raw epochs
+    # live in HBM and a scan executor consumes (k, B) index arrays — zero
+    # per-step host stacking. Unsupported configs fall back to the host
+    # path; an over-budget 'cached' falls back to 'step' (both logged).
+    device_req = str(getattr(args, "device_aug", "off") or "off")
+    device_mode = "off"
+    dev_store = dev_cache = None
+    sds_train = train_loader.dataset
+    if device_req != "off":
+        from seist_tpu.data import device_aug as da
+
+        if gas > 1:
+            raise ValueError(
+                "--device-aug is incompatible with --grad-accum-steps "
+                "(accumulation scans stacked host batches)"
+            )
+        reasons = da.unsupported_reasons(
+            sds_train.preprocessor, sds_train.input_names,
+            sds_train.label_names,
+        )
+        budget = da.hbm_budget_bytes(
+            float(getattr(args, "device_aug_hbm_gb", 0.0) or 0.0)
+        )
+        # The cache shards its sample axis over the mesh 'data' axis, so
+        # the budget comparison is PER-DEVICE bytes vs per-device HBM —
+        # comparing the raw total would downgrade a 40 GiB dataset on an
+        # 8-chip mesh (5 GiB/chip) that actually fits.
+        est = (
+            pipeline.RawStore.estimate_bytes(sds_train) // max(data_axis, 1)
+            if not reasons
+            else 0
+        )
+        device_mode, why = da.select_device_aug_mode(
+            device_req, est, budget, reasons, jax.process_count() > 1
+        )
+        if device_mode != device_req:
+            logger.warning(f"--device-aug {device_req} -> {device_mode}: {why}")
+        if device_mode != "off":
+            try:
+                dev_store = pipeline.RawStore.build(sds_train)
+            except ValueError as e:
+                logger.warning(f"--device-aug {device_mode} -> off: {e}")
+                device_mode = "off"
+        if device_mode == "step" and spc > 1:
+            # Explicit 'step' + packing is a config error; but a 'cached'
+            # request that FELL BACK to 'step' must not crash on its
+            # now-meaningless packing flag.
+            if device_req == "step":
+                raise ValueError(
+                    "--steps-per-call > 1 requires --device-aug cached "
+                    "(the step mode feeds one raw batch per dispatch)"
+                )
+            logger.warning(
+                f"--steps-per-call {spc} ignored on the device-aug step "
+                "fallback path"
+            )
+            spc = 1
+        if (
+            device_mode != "off"
+            and faults_lib.FaultInjector.from_env().plan.nan_step >= 0
+        ):
+            raise ValueError(
+                "SEIST_FAULT_NAN_STEP corrupts host-fed input batches, "
+                "which the device-aug paths never materialize; use "
+                "--device-aug off for NaN-injection runs (process-level "
+                "faults — SIGTERM/kill/slow — work on every path)"
+            )
+
+    if device_mode != "off":
+        from seist_tpu.data import device_aug as da
+
+        dev_cfg = da.AugConfig.from_preprocessor(
+            sds_train.preprocessor,
+            seed=args.seed,
+            raw_len=dev_store.raw_len,
+            phase_slots=dev_store.phase_slots,
+        )
+        dev_proc_args = (
+            dev_cfg, sds_train.input_names, sds_train.label_names
+        )
+    if device_mode == "cached":
+        # steps_per_call defaults HIGH here: with epochs resident there is
+        # no host work to overlap, so the only per-step cost left is the
+        # dispatch — amortize it.
+        if spc_auto:
+            spc = max(1, min(32, steps_per_epoch))
+        if steps_per_epoch // spc == 0:
+            raise ValueError(
+                f"--steps-per-call {spc} exceeds steps_per_epoch "
+                f"{steps_per_epoch}: every epoch would train ZERO steps "
+                f"(trailing part-groups are dropped)"
+            )
+        dev_cache = pipeline.DeviceEpochCache(dev_store, mesh)
+        logger.info(
+            f"device-aug cached: {len(dev_store)} epoch samples resident "
+            f"({dev_cache.nbytes / 2**20:.1f} MiB HBM), "
+            f"steps_per_call={spc}"
+        )
+        train_step = jit_cached_call(
+            make_cached_train_call(
+                spec, loss_fn,
+                da.make_cache_processor(
+                    *dev_proc_args,
+                    n_raw=dev_store.n_raw,
+                    augmentation=dev_store.augmentation,
+                ),
+                steps_per_call=spc, compute_dtype=dtype, guard=guard_on,
+            ),
+            mesh,
+            dev_cache.arrays,
+        )
+        if steps_per_epoch % spc:
+            logger.warning(
+                f"steps_per_call={spc} drops {steps_per_epoch % spc} "
+                f"trailing batch(es) per epoch ({steps_per_epoch} steps)"
+            )
+    elif device_mode == "step":
+        logger.info(
+            "device-aug step: augmentation + labels inside the jitted "
+            "step; host feeds raw rows only"
+        )
+        train_step = jit_device_aug_step(
+            make_device_aug_train_step(
+                spec, loss_fn,
+                da.make_row_processor(*dev_proc_args),
+                compute_dtype=dtype, guard=guard_on,
+            ),
+            mesh,
+        )
+    elif gas > 1:
         # One update from gas micro-batch gradients, scanned in one jitted
         # program; stacked-batch layout shares jit_multi_step's sharding.
         if steps_per_epoch % gas:
@@ -747,7 +888,132 @@ def train_worker(args: Any) -> str:
         deferred_losses: List[Any] = []
         global_bs = args.batch_size * jax.process_count()
 
-        if kpack > 1:
+        if device_mode == "cached":
+            # HBM-resident path: one jitted call = kpack scanned updates;
+            # the ONLY per-call host->device traffic is the (k, B) int32
+            # index array. Loss/save/preempt bookkeeping mirrors the
+            # packed host path.
+            import jax.numpy as jnp
+
+            for call, idx_k in enumerate(
+                dev_cache.epoch_index_chunks(
+                    epoch,
+                    seed=args.seed,
+                    shuffle=args.shuffle,
+                    batch_size=args.batch_size,
+                    steps_per_call=kpack,
+                    start_batch=skip,
+                ),
+                start=skip // kpack,
+            ):
+                faults.on_step(
+                    epoch * steps_per_epoch + call * kpack, n_steps=kpack
+                )
+                idx_dev = mesh_lib.shard_stacked_batch(mesh, idx_k)
+                state, loss, _, diag = _step_out(
+                    train_step(
+                        state, dev_cache.arrays, idx_dev,
+                        jnp.int32(epoch), epoch_rng,
+                    )
+                )
+                deferred_losses.append(loss)
+                if diag is not None and monitor.push(diag["applied"]):
+                    state = _rollback(state)
+                _log_kernel_status_once()
+                _maybe_trace(call * updates_per_call, loss)
+                batches_done = (call + 1) * kpack
+                if save_every and (
+                    batches_done // save_every
+                    > (batches_done - kpack) // save_every
+                ):
+                    _interval_save(
+                        state, epoch, batches_done,
+                        epoch * steps_per_epoch + batches_done,
+                    )
+                if preempt.triggered:
+                    _preempt_exit(
+                        state, epoch, batches_done,
+                        epoch * steps_per_epoch + batches_done,
+                    )
+                if call % args.log_step == 0:
+                    loss_f = float(loss)
+                    loss_meter.update(loss_f, 1)
+                    now = time.time()
+                    calls_done = min(args.log_step, call) or 1
+                    wps_meter.update(
+                        global_bs * kpack * calls_done
+                        / max(now - t_step, 1e-9)
+                    )
+                    t_step = now
+                    if writer is not None:
+                        writer.add_scalar(
+                            "train-loss/step",
+                            loss_f,
+                            epoch * steps_per_epoch + call * kpack,
+                        )
+                    if is_main_process():
+                        logger.info(
+                            f"{args.model_name}_train "
+                            f"{progress.get_str(call * kpack)}"
+                        )
+
+        elif device_mode == "step":
+            # Raw rows cross the host per step (fancy-index gather, no
+            # per-sample augmentation / label synthesis / stacking);
+            # the jitted step does the rest. Per-step train metrics are
+            # skipped like the packed path — metrics targets only exist
+            # on the host pipeline.
+            import jax.numpy as jnp
+
+            for step, (rows, idx, aug) in enumerate(
+                pipeline.prefetch_raw_to_device(
+                    pipeline.iter_raw_batches(
+                        dev_store,
+                        epoch,
+                        seed=args.seed,
+                        shuffle=args.shuffle,
+                        batch_size=args.batch_size,
+                        num_shards=jax.process_count(),
+                        shard_index=jax.process_index(),
+                        start_batch=skip,
+                    ),
+                    mesh,
+                ),
+                start=skip,
+            ):
+                gstep = epoch * steps_per_epoch + step
+                faults.on_step(gstep)
+                state, loss, _, diag = _step_out(
+                    train_step(
+                        state, rows, idx, aug, jnp.int32(epoch), epoch_rng
+                    )
+                )
+                deferred_losses.append(loss)
+                if diag is not None and monitor.push(diag["applied"]):
+                    state = _rollback(state)
+                _log_kernel_status_once()
+                _maybe_trace(step, loss)
+                if save_every and (step + 1) % save_every == 0:
+                    _interval_save(state, epoch, step + 1, gstep + 1)
+                if preempt.triggered:
+                    _preempt_exit(state, epoch, step + 1, gstep + 1)
+                if step % args.log_step == 0:
+                    loss_f = float(loss)
+                    loss_meter.update(loss_f, 1)
+                    now = time.time()
+                    steps_done = min(args.log_step, step) or 1
+                    wps_meter.update(
+                        global_bs * steps_done / max(now - t_step, 1e-9)
+                    )
+                    t_step = now
+                    if writer is not None:
+                        writer.add_scalar("train-loss/step", loss_f, gstep)
+                    if is_main_process():
+                        logger.info(
+                            f"{args.model_name}_train {progress.get_str(step)}"
+                        )
+
+        elif kpack > 1:
             # Packed path: one jitted call consumes kpack batches — either
             # kpack sequential updates (--steps-per-call) or one
             # accumulated update (--grad-accum-steps). The per-call loss is

@@ -71,6 +71,52 @@ def device_memory_stats() -> List[Dict[str, float]]:
     return out
 
 
+class StepTimeSplit:
+    """Per-step host-wait vs device-compute split.
+
+    ``host_wait`` is the time the step loop spends BEFORE the device can
+    start — fetching/stacking the batch and staging it to the device;
+    ``device_time`` is dispatch-to-block_until_ready. The
+    ``input_bound_fraction`` (host / (host + device)) is the number that
+    says whether training is input-bound: ~0 means the chip sets the
+    pace, ~1 means it idles behind the input pipeline. Recorded per step
+    so bench.py can emit the raw split; the first ``skip_first`` steps
+    (jit compile / warmup) are excluded from the summary.
+    """
+
+    def __init__(self, skip_first: int = 1):
+        self.skip_first = int(skip_first)
+        self.host_s: List[float] = []
+        self.device_s: List[float] = []
+
+    def step(self, host_s: float, device_s: float) -> None:
+        self.host_s.append(float(host_s))
+        self.device_s.append(float(device_s))
+
+    def summary(self) -> Dict[str, object]:
+        h = self.host_s[self.skip_first :]
+        d = self.device_s[self.skip_first :]
+        if not h:
+            return {
+                "steps": 0,
+                "host_wait_ms_per_step": None,
+                "device_time_ms_per_step": None,
+                "input_bound_fraction": None,
+                "per_step_host_wait_ms": [],
+                "per_step_device_time_ms": [],
+            }
+        hm = sum(h) / len(h)
+        dm = sum(d) / len(d)
+        return {
+            "steps": len(h),
+            "host_wait_ms_per_step": round(hm * 1e3, 3),
+            "device_time_ms_per_step": round(dm * 1e3, 3),
+            "input_bound_fraction": round(hm / max(hm + dm, 1e-12), 4),
+            "per_step_host_wait_ms": [round(x * 1e3, 3) for x in h],
+            "per_step_device_time_ms": [round(x * 1e3, 3) for x in d],
+        }
+
+
 class ThroughputMeter:
     """Waveforms/sec over a sliding run, skipping compile-time warmup steps."""
 

@@ -31,6 +31,15 @@ import jax  # noqa: E402
 if not _USE_TPU:
     jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache for the suite: tier-1 wall time is
+# dominated by jit compiles of the same model/step programs run after
+# run — the disk cache (the same one bench.py and the CLI use) cuts a
+# repeat compile ~3x even on CPU. Threshold 2 s: catches every model
+# compile, skips trivial jits. First (cold) run pays full price.
+from seist_tpu.utils.misc import enable_compile_cache  # noqa: E402
+
+enable_compile_cache(min_compile_seconds=2)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -60,6 +69,7 @@ _SMOKE_FILES = {
     "test_native.py",
     "test_bench_unit.py",
     "test_packed.py",
+    "test_collective_report.py",
 }
 
 

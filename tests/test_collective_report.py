@@ -1,0 +1,67 @@
+"""Regression tests for tools/collective_report.py's payload attribution
+(advisor r4: gradient bytes must never silently land in the bn_stat
+bucket when XLA's combiner drops op_name metadata)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from collective_report import attribute_collectives  # noqa: E402
+
+
+def _op(kind, dims, nbytes, op_name=""):
+    return {"kind": kind, "shape_dims": dims, "bytes": nbytes, "op_name": op_name}
+
+
+PARAMS = {(64, 3, 16), (16,)}
+
+
+def test_marked_gradient_allreduce_attributed():
+    ops = [
+        _op("all-reduce", [(64, 3, 16)], 12288, "transpose(jvp(Conv))/add"),
+        _op("all-reduce", [(16,)], 64, "batch_norm/mean"),
+    ]
+    b = attribute_collectives(ops, PARAMS, batch=32, devices=8)
+    assert b["grad_ops"] == 1 and b["grad_bytes"] == 12288
+    # the BN stat all-reduce is param-shaped but unmarked -> unattributed
+    assert b["unattr_ops"] == 1 and b["unattr_bytes"] == 64
+    assert not b["warn_unattributed"]  # gradient ops were found
+
+
+def test_unattributed_bucket_warns_when_no_gradients_found():
+    """The advisor-r4 case: XLA combined the gradient all-reduces and
+    dropped the transpose(jvp) metadata — the report must bucket the
+    bytes as unattributed AND flag them, never claim ~0 gradient
+    traffic silently."""
+    ops = [
+        _op("all-reduce", [(64, 3, 16), (16,)], 12352, "combined/all"),
+    ]
+    b = attribute_collectives(ops, PARAMS, batch=32, devices=8)
+    assert b["grad_ops"] == 0
+    assert b["unattr_ops"] == 1 and b["unattr_bytes"] == 12352
+    assert b["other_bytes"] == 12352  # also included in the bn_stat bucket
+    assert b["warn_unattributed"]
+
+
+def test_activation_traffic_by_batch_leading_dim():
+    ops = [
+        _op("all-gather", [(32, 128, 8)], 131072, "remat/fwd"),
+        _op("all-gather", [(4, 128, 8)], 16384, "remat/fwd"),  # per-shard
+        _op("all-reduce", [()], 4, "loss/mean"),
+    ]
+    b = attribute_collectives(ops, PARAMS, batch=32, devices=8)
+    assert b["act_ops"] == 2
+    assert b["act_bytes"] == 131072 + 16384
+    assert b["other_bytes"] == 4
+    assert not b["warn_unattributed"]  # no param-shaped bytes at all
+
+
+def test_param_shape_not_shadowed_by_batch_dim():
+    """A param whose leading dim equals the batch size must still hit the
+    unattributed bucket, not the activation heuristic."""
+    params = {(32, 7)}
+    ops = [_op("all-reduce", [(32, 7)], 896, "combined")]
+    b = attribute_collectives(ops, params, batch=32, devices=8)
+    assert b["unattr_ops"] == 1 and b["act_ops"] == 0
+    assert b["warn_unattributed"]

@@ -272,3 +272,109 @@ class TestH5HandleCache:
         assert result["n"] <= base._H5Handles.MAX_OPEN + 1
         assert result["evicted_closed"]  # oldest handle was closed, not leaked
         assert result["reopened"]
+
+
+class TestDeviceAugStore:
+    """RawStore / epoch_indices — the host half of --device-aug."""
+
+    def test_epoch_indices_matches_loader(self):
+        sds = make_sds(n=20, augmentation=True)
+        for shards, rank in [(1, 0), (2, 1), (3, 2)]:
+            loader = pipeline.Loader(
+                sds, batch_size=4, shuffle=True, seed=3,
+                num_shards=shards, shard_index=rank,
+            )
+            loader.set_epoch(5)
+            np.testing.assert_array_equal(
+                loader._indices(),
+                pipeline.epoch_indices(
+                    len(sds), seed=3, epoch=5, shuffle=True,
+                    num_shards=shards, shard_index=rank,
+                ),
+            )
+
+    def test_raw_store_matches_host_prepare(self):
+        from seist_tpu.data import device_aug as da
+
+        sds = make_sds(n=8, augmentation=True)
+        store = pipeline.RawStore.build(sds)
+        assert len(store) == 2 * sds.raw_size
+        assert store.raw_len == 4 * 1024
+        for i in (0, sds.raw_size - 1):
+            event, _ = sds.raw_event(i)
+            row = da.host_prepare(sds.preprocessor, event, store.phase_slots)
+            np.testing.assert_array_equal(store.arrays["data"][i], row["data"])
+            np.testing.assert_array_equal(store.arrays["ppks"][i], row["ppks"])
+            np.testing.assert_array_equal(store.arrays["spks"][i], row["spks"])
+            assert store.arrays["np_p"][i] == row["np_p"]
+            assert store.arrays["np_s"][i] == row["np_s"]
+
+    def test_iter_raw_batches_contract(self):
+        sds = make_sds(n=10, augmentation=True)
+        store = pipeline.RawStore.build(sds)
+        batches = list(
+            pipeline.iter_raw_batches(
+                store, 2, seed=3, shuffle=True, batch_size=4
+            )
+        )
+        assert len(batches) == len(store) // 4  # drop-last
+        order = pipeline.epoch_indices(
+            len(store), seed=3, epoch=2, shuffle=True
+        )
+        seen = np.concatenate([idx for _, idx, _ in batches])
+        np.testing.assert_array_equal(seen, order[: len(seen)])
+        rows, idx, aug = batches[0]
+        assert rows["data"].shape == (4, 3, store.raw_len)
+        # aug flag is exactly the 2x-epoch rule
+        np.testing.assert_array_equal(aug, idx >= store.n_raw)
+        # rows are the raw-index gather of the store
+        np.testing.assert_array_equal(
+            rows["data"], store.arrays["data"][idx % store.n_raw]
+        )
+
+    def test_device_epoch_cache_upload_roundtrip(self):
+        sds = make_sds(n=5, augmentation=False)
+        store = pipeline.RawStore.build(sds)
+        cache = pipeline.DeviceEpochCache(store)
+        assert cache.nbytes >= store.nbytes
+        np.testing.assert_array_equal(
+            np.asarray(cache.arrays["data"]), store.arrays["data"]
+        )
+
+    def test_device_epoch_cache_sharded_upload(self):
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs multi-device mesh")
+        from seist_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+        sds = make_sds(n=5, augmentation=False)  # 5 % 8 != 0 -> padded
+        store = pipeline.RawStore.build(sds)
+        cache = pipeline.DeviceEpochCache(store, mesh)
+        data = np.asarray(cache.arrays["data"])
+        assert data.shape[0] % mesh.shape["data"] == 0
+        np.testing.assert_array_equal(
+            data[: store.n_raw], store.arrays["data"]
+        )
+
+    def test_store_refuses_fabricated_value_labels(self):
+        """A noise-classified trace under a VALUE-label task crashes the
+        host path; the device store must refuse it loudly instead of
+        zero-filling a label (review finding)."""
+        sds = pipeline.from_task_spec(
+            taskspec.get_task_spec("magnet"), "synthetic", "train",
+            seed=0, in_samples=1024, augmentation=False, data_split=False,
+            dataset_kwargs={"num_events": 4, "trace_samples": 4096},
+        )
+        orig = sds.raw_event
+
+        def noisy(idx):
+            ev, meta = orig(idx)
+            if idx == 1:  # inverted picks -> _is_noise
+                ev = dict(ev, ppks=[ev["spks"][0] + 10])
+            return ev, meta
+
+        sds.raw_event = noisy
+        with pytest.raises(ValueError, match="fabricate"):
+            pipeline.RawStore.build(sds)

@@ -1,0 +1,719 @@
+"""Golden parity + executor tests for device-side augmentation
+(seist_tpu/data/device_aug.py).
+
+The parity suite injects the SAME random draws into both implementations:
+the device pipeline derives named draws from its (seed, epoch, idx) key;
+``build_replay_script`` translates them into the numpy
+``DataPreprocessor``'s consumption order and a ``ScriptedRNG`` feeds them
+to the REAL numpy code. Outputs must match within float32 tolerance —
+per-op and end-to-end through ``process()`` + label synthesis.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import seist_tpu
+from seist_tpu import taskspec
+from seist_tpu.data import device_aug as da
+from seist_tpu.data import pipeline as pl
+from seist_tpu.data.preprocess import DataPreprocessor
+
+seist_tpu.load_all()
+
+C, L, W = 3, 600, 512
+TOL = dict(rtol=2e-4, atol=2e-4)
+
+
+def make_event(seed, ppks=(120,), spks=(200,)):
+    rng = np.random.default_rng(seed)
+    return {
+        "data": rng.standard_normal((C, L)).astype(np.float32),
+        "ppks": list(ppks),
+        "spks": list(spks),
+        "emg": [3.5],
+        "snr": np.full(C, 20.0, np.float32),
+    }
+
+
+def make_pre(**over):
+    kw = dict(
+        data_channels=["z", "n", "e"],
+        sampling_rate=50,
+        in_samples=W,
+        coda_ratio=2.0,  # f32-exact so coda truncation can't split (see
+        # device_aug module docstring's tolerated-deviation list)
+        norm_mode="std",
+        add_event_rate=0.9,
+        max_event_num=2,
+        shift_event_rate=0.9,
+        add_noise_rate=0.9,
+        add_gap_rate=0.9,
+        drop_channel_rate=0.9,
+        scale_amplitude_rate=0.9,
+        pre_emphasis_rate=0.9,
+        generate_noise_rate=0.05,
+        min_event_gap_sec=0.1,
+        soft_label_shape="gaussian",
+        soft_label_width=40,
+    )
+    kw.update(over)
+    return DataPreprocessor(**kw)
+
+
+def make_cfg(pre, seed=0, phase_slots=4, raw_len=L):
+    return da.AugConfig.from_preprocessor(
+        pre, seed=seed, raw_len=raw_len, phase_slots=phase_slots
+    )
+
+
+def get_draws(cfg, epoch, idx):
+    return jax.device_get(da.draw_all(cfg, da.sample_key(cfg.seed, epoch, idx)))
+
+
+def phase_arrays(ppks, spks, P=4):
+    arr = lambda v: jnp.asarray(  # noqa: E731
+        list(v) + [da._BIG] * (P - len(v)), jnp.int32
+    )
+    return arr(ppks), jnp.int32(len(ppks)), arr(spks), jnp.int32(len(spks))
+
+
+# --------------------------------------------------------------- per-op parity
+class TestPerOpParity:
+    def test_normalize_modes(self):
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((C, W)).astype(np.float32) * 7.0
+        from seist_tpu.data.preprocess import normalize as np_normalize
+
+        for mode in ("std", "max", ""):
+            ours = np.asarray(da.normalize(jnp.asarray(data), mode))
+            ref = np_normalize(data.copy(), mode, axis=1)
+            np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+    def test_normalize_zero_scale(self):
+        data = np.zeros((C, 16), np.float32)
+        out = np.asarray(da.normalize(jnp.asarray(data), "max"))
+        assert np.all(np.isfinite(out))
+
+    def test_shift_event(self):
+        pre = make_pre()
+        ev = make_event(1, ppks=(100, 300), spks=(180, 420))
+        shift = 217
+        d_np, p_np, s_np = pre._shift_event(
+            ev["data"].copy(), list(ev["ppks"]), list(ev["spks"]),
+            da.ScriptedRNG([("integers", shift)]),
+        )
+        pp, npp, ss, nss = phase_arrays(ev["ppks"], ev["spks"])
+        d_d, pp2, npp2, ss2, nss2 = da.shift_event(
+            jnp.asarray(ev["data"]), pp, npp, ss, nss, shift
+        )
+        np.testing.assert_allclose(np.asarray(d_d), d_np, **TOL)
+        assert list(np.asarray(pp2)[: int(npp2)]) == p_np
+        assert list(np.asarray(ss2)[: int(nss2)]) == s_np
+
+    def test_add_event(self):
+        pre = make_pre(min_event_gap_sec=0.1)
+        ev = make_event(2, ppks=(50,), spks=(90,))
+        cfg = make_cfg(pre)
+        u_t, u_pos, u_scale = 0.3, 0.55, 0.77
+        # scripted numpy draws computed with the SAME u->int formula
+        target = da.u2i_np(u_t, 1)
+        ppk, spk = 50, 90
+        ce = int(spk + pre.coda_ratio * (spk - ppk))
+        left, right = ce + pre.min_event_gap, L - (spk - ppk) - pre.min_event_gap
+        pos = left + da.u2i_np(u_pos, right - left)
+        d_np, p_np, s_np = pre._add_event(
+            ev["data"].copy(), [ppk], [spk], pre.min_event_gap,
+            da.ScriptedRNG(
+                [("integers", target), ("integers", pos), ("random", u_scale)]
+            ),
+        )
+        pp, npp, ss, nss = phase_arrays([ppk], [spk])
+        d_d, pp2, npp2, ss2, nss2 = da.add_event_once(
+            cfg, jnp.asarray(ev["data"]), pp, npp, ss, nss,
+            jnp.float32(u_t), jnp.float32(u_pos), jnp.float32(u_scale),
+            jnp.bool_(True),
+        )
+        np.testing.assert_allclose(np.asarray(d_d), d_np, **TOL)
+        assert list(np.asarray(pp2)[: int(npp2)]) == p_np
+        assert list(np.asarray(ss2)[: int(nss2)]) == s_np
+
+    def test_generate_noise(self):
+        pre = make_pre()
+        cfg = make_cfg(pre)
+        ev = make_event(3, ppks=(100, 220), spks=(150, 260))
+        field = np.random.default_rng(9).standard_normal((C, L)).astype(
+            np.float32
+        )
+        script = []
+        for ppk, spk in zip(ev["ppks"], ev["spks"]):
+            ce = int(np.clip(int(spk + pre.coda_ratio * (spk - ppk)), 0, L))
+            if ppk < ce:
+                script.append(("normal", field[:, ppk:ce]))
+        d_np, p_np, s_np = pre._generate_noise_data(
+            ev["data"].copy(), list(ev["ppks"]), list(ev["spks"]),
+            da.ScriptedRNG(script),
+        )
+        pp, npp, ss, nss = phase_arrays(ev["ppks"], ev["spks"])
+        d_d = da.generate_noise(
+            cfg, jnp.asarray(ev["data"]), pp, npp, ss, nss, jnp.asarray(field)
+        )
+        np.testing.assert_allclose(np.asarray(d_d), d_np, **TOL)
+        assert p_np == [] and s_np == []
+
+    def test_drop_channel_and_adjust(self):
+        ev = make_event(4)
+        u_num, u_ch = 0.9, np.array([0.1, 0.8], np.float32)
+        drop_num = 1 + da.u2i_np(u_num, C - 1)
+        cands = list(range(C))
+        script = [("choice", drop_num)]
+        for i in range(drop_num):
+            c = cands[da.u2i_np(u_ch[i], len(cands))]
+            script.append(("choice", c))
+            cands.remove(c)
+        pre = make_pre()
+        d_np = pre._adjust_amplitude(
+            pre._drop_channel(ev["data"].copy(), da.ScriptedRNG(script))
+        )
+        d_d = da.adjust_amplitude(
+            da.drop_channel(
+                jnp.asarray(ev["data"]), jnp.float32(u_num), jnp.asarray(u_ch)
+            )
+        )
+        np.testing.assert_allclose(np.asarray(d_d), d_np, **TOL)
+
+    def test_scale_pre_emphasis_noise_gaps(self):
+        pre = make_pre()
+        ev = make_event(5, ppks=(100,), spks=(200,))
+        # scale
+        d_np = pre._scale_amplitude(
+            ev["data"].copy(),
+            da.ScriptedRNG([("uniform", 0.7), ("uniform", 1.0 + 2 * 0.4)]),
+        )
+        d_d = da.scale_amplitude(
+            jnp.asarray(ev["data"]), jnp.float32(0.7), jnp.float32(0.4)
+        )
+        np.testing.assert_allclose(np.asarray(d_d), d_np, **TOL)
+        # pre-emphasis
+        d_np = pre._pre_emphasis(ev["data"].copy(), 0.97)
+        d_d = da.pre_emphasis(jnp.asarray(ev["data"]), 0.97)
+        np.testing.assert_allclose(np.asarray(d_d), d_np, **TOL)
+        # SNR noise
+        u_snr = np.array([0.2, 0.5, 0.9], np.float32)
+        field = np.random.default_rng(6).standard_normal((C, L)).astype(
+            np.float32
+        )
+        script = []
+        for c in range(C):
+            script.append(("integers", 10 + da.u2i_np(u_snr[c], 40)))
+            script.append(("normal", field[c]))
+        d_np = pre._add_noise(ev["data"].copy(), da.ScriptedRNG(script))
+        d_d = da.add_noise(
+            jnp.asarray(ev["data"]), jnp.asarray(u_snr), jnp.asarray(field)
+        )
+        np.testing.assert_allclose(np.asarray(d_d), d_np, rtol=2e-3, atol=2e-3)
+        # gaps
+        u1, u2, u3 = 0.3, 0.6, 0.8
+        phases = sorted(ev["ppks"] + ev["spks"]) + [L - 1]
+        phases = sorted(set(phases))
+        ip = da.u2i_np(u1, len(phases) - 1)
+        sgt = phases[ip] + da.u2i_np(u2, phases[ip + 1] - phases[ip])
+        egt = sgt + da.u2i_np(u3, phases[ip + 1] - sgt)
+        d_np = pre._add_gaps(
+            ev["data"].copy(), list(ev["ppks"]), list(ev["spks"]),
+            da.ScriptedRNG(
+                [("integers", ip), ("integers", sgt), ("integers", egt)]
+            ),
+        )
+        pp, npp, ss, nss = phase_arrays(ev["ppks"], ev["spks"])
+        d_d = da.add_gaps(
+            jnp.asarray(ev["data"]), pp, npp, ss, nss,
+            jnp.float32(u1), jnp.float32(u2), jnp.float32(u3),
+        )
+        np.testing.assert_allclose(np.asarray(d_d), d_np, **TOL)
+
+    def test_cut_window(self):
+        pre = make_pre()
+        cfg = make_cfg(pre)
+        ev = make_event(7, ppks=(120, 400), spks=(200, 470))
+        u = 0.63
+        bound = max(min(list(ev["ppks"]) + [L - W]) - pre.min_event_gap, 1)
+        c_l = da.u2i_np(u, bound)
+        d_np, p_np, s_np = pre._cut_window(
+            ev["data"].copy(), list(ev["ppks"]), list(ev["spks"]), W,
+            da.ScriptedRNG([("integers", c_l)]),
+        )
+        pp, npp, ss, nss = phase_arrays(ev["ppks"], ev["spks"])
+        d_d, pp2, npp2, ss2, nss2 = da.cut_window(
+            cfg, jnp.asarray(ev["data"]), pp, npp, ss, nss, jnp.float32(u)
+        )
+        np.testing.assert_allclose(np.asarray(d_d), d_np, **TOL)
+        assert list(np.asarray(pp2)[: int(npp2)]) == p_np
+        assert list(np.asarray(ss2)[: int(nss2)]) == s_np
+
+    @pytest.mark.parametrize("shape", ["gaussian", "triangle", "box"])
+    def test_soft_labels(self, shape):
+        pre = make_pre(soft_label_shape=shape)
+        cfg = make_cfg(pre)
+        from seist_tpu.data.preprocess import make_soft_window
+
+        window = jnp.asarray(make_soft_window(40, shape), jnp.float32)
+        # edge placements: left-clipped, middle, right-clipped, out-of-range
+        ev = {"data": np.zeros((C, W), np.float32),
+              "ppks": [3, 250], "spks": [40, W - 2], "snr": [20.0] * C}
+        for name in ("ppk", "spk", "non", "det"):
+            ref = pre._generate_soft_label(name, ev)
+            pp, npp, ss, nss = phase_arrays(ev["ppks"], ev["spks"])
+            proc = {"ppks": pp, "np_p": npp, "spks": ss, "np_s": nss,
+                    "win": jnp.asarray(ev["data"]), "gen_fired": jnp.bool_(False)}
+            ours = np.asarray(da._soft_item(cfg, name, proc, window))
+            np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+    def test_pad_phases_matches_reference(self):
+        from seist_tpu.data.preprocess import pad_phases
+
+        cases = [
+            ([10, 50], [30, 80]),          # matched
+            ([10, 50, 90], [30, 80]),      # trailing unmatched P
+            ([50], [30]),                  # inverted pair
+            ([], []),
+        ]
+        for ppks, spks in cases:
+            ref_p, ref_s = pad_phases(list(ppks), list(spks), 40, W)
+            pp, npp, ss, nss = phase_arrays(ppks, spks)
+            dp, ds, n = da.pad_phases_dev(pp, npp, ss, nss, 40, W)
+            n = int(n)
+            assert list(np.asarray(dp)[:n]) == ref_p, (ppks, spks)
+            assert list(np.asarray(ds)[:n]) == ref_s, (ppks, spks)
+
+
+# --------------------------------------------------------- composed parity
+_JITTED_PROCS = {}  # (cfg, names-repr) -> jitted row processor (compile once)
+
+
+def _device_outputs(cfg, pre, event, input_names, label_names, epoch, idx,
+                    augment=True):
+    row = da.host_prepare(pre, event, cfg.phase_slots)
+    row.pop("is_noise")
+    key = (cfg, repr(input_names), repr(label_names))
+    proc_fn = _JITTED_PROCS.get(key)
+    if proc_fn is None:
+        proc_fn = _JITTED_PROCS[key] = jax.jit(
+            da.make_row_processor(cfg, input_names, label_names)
+        )
+    rows = jax.tree.map(lambda a: np.asarray(a)[None], row)
+    return proc_fn(
+        rows, jnp.asarray([idx], jnp.int32),
+        jnp.asarray([augment]), jnp.int32(epoch),
+    )
+
+
+class TestComposedParity:
+    @pytest.mark.parametrize("seed,epoch,idx", [
+        (0, 1, 0), (1, 2, 3), (2, 3, 7), (3, 0, 11), (4, 5, 2),
+    ])
+    def test_dpk_end_to_end(self, seed, epoch, idx):
+        """Every-op-armed config through process() + dpk labels. One
+        shared cfg (seed=0) so the jitted processor compiles once; the
+        event and the (epoch, idx) draw stream vary per case."""
+        pre = make_pre()
+        cfg = make_cfg(pre, seed=0)
+        event = make_event(seed)
+        draws = get_draws(cfg, epoch, idx)
+
+        ev = copy.deepcopy(event)
+        rng = da.make_replay_rng(pre, ev, draws, augmentation=True)
+        ev = pre.process(ev, augmentation=True, rng=rng)
+        rng.assert_exhausted()
+        ref_in = pre.get_inputs(ev, [["z", "n", "e"]])
+        ref_y = pre.get_targets_for_loss(ev, [["det", "ppk", "spk"]])
+        ref_non = pre.get_io_item("non", ev)
+
+        inputs, targets = _device_outputs(
+            cfg, pre, event, [["z", "n", "e"]],
+            [["det", "ppk", "spk"], "non"], epoch, idx,
+        )
+        np.testing.assert_allclose(np.asarray(inputs)[0], ref_in, **TOL)
+        np.testing.assert_allclose(np.asarray(targets[0])[0], ref_y, **TOL)
+        np.testing.assert_allclose(np.asarray(targets[1])[0], ref_non, **TOL)
+
+    def test_generate_noise_branch(self):
+        pre = make_pre(generate_noise_rate=1.0)
+        cfg = make_cfg(pre, seed=5)
+        event = make_event(5)
+        draws = get_draws(cfg, 0, 0)
+        ev = copy.deepcopy(event)
+        rng = da.make_replay_rng(pre, ev, draws)
+        ev = pre.process(ev, augmentation=True, rng=rng)
+        rng.assert_exhausted()
+        ref_in = pre.get_inputs(ev, [["z", "n", "e"]])
+        inputs, targets = _device_outputs(
+            cfg, pre, event, [["z", "n", "e"]], [["det", "ppk", "spk"]], 0, 0
+        )
+        np.testing.assert_allclose(np.asarray(inputs)[0], ref_in, **TOL)
+        # labels cleared: det/ppk/spk all zero
+        assert float(np.abs(np.asarray(targets)[0]).max()) == 0.0
+
+    def test_no_augmentation_path(self):
+        """idx < size samples: crop + normalize only (2x-epoch raw half).
+        Shares the dpk test's cfg + label set so the compile is reused."""
+        pre = make_pre()
+        cfg = make_cfg(pre, seed=0)
+        event = make_event(6)
+        draws = get_draws(cfg, 2, 4)
+        ev = copy.deepcopy(event)
+        rng = da.make_replay_rng(pre, ev, draws, augmentation=False)
+        ev = pre.process(ev, augmentation=False, rng=rng)
+        rng.assert_exhausted()
+        ref_in = pre.get_inputs(ev, [["z", "n", "e"]])
+        inputs, _ = _device_outputs(
+            cfg, pre, event, [["z", "n", "e"]],
+            [["det", "ppk", "spk"], "non"], 2, 4, augment=False,
+        )
+        np.testing.assert_allclose(np.asarray(inputs)[0], ref_in, **TOL)
+
+    def test_noise_trace_cleared(self):
+        """_is_noise traces (inverted picks) lose their labels at upload."""
+        pre = make_pre()
+        cfg = make_cfg(pre, seed=0)
+        event = make_event(7, ppks=(300,), spks=(100,))  # ppk >= spk
+        draws = get_draws(cfg, 0, 1)
+        ev = copy.deepcopy(event)
+        rng = da.make_replay_rng(pre, ev, draws)
+        ev = pre.process(ev, augmentation=True, rng=rng)
+        rng.assert_exhausted()
+        ref_y = pre.get_targets_for_loss(ev, [["det", "ppk", "spk"]])
+        _, targets = _device_outputs(
+            cfg, pre, event, [["z", "n", "e"]],
+            [["det", "ppk", "spk"], "non"], 0, 1,
+        )
+        np.testing.assert_allclose(np.asarray(targets[0])[0], ref_y, **TOL)
+
+    def test_value_and_max_norm(self):
+        """VALUE labels (emg) + signed-max normalization parity."""
+        pre = make_pre(norm_mode="max", generate_noise_rate=0.0)
+        cfg = make_cfg(pre, seed=8)
+        event = make_event(8)
+        draws = get_draws(cfg, 1, 9)
+        ev = copy.deepcopy(event)
+        rng = da.make_replay_rng(pre, ev, draws)
+        ev = pre.process(ev, augmentation=True, rng=rng)
+        rng.assert_exhausted()
+        ref_in = pre.get_inputs(ev, [["z", "n", "e"]])
+        ref_emg = pre.get_targets_for_loss(ev, ["emg"])
+        row = da.host_prepare(pre, event, cfg.phase_slots)
+        row.pop("is_noise")
+        row["values"] = {"emg": np.asarray(event["emg"], np.float32)}
+        proc_fn = da.make_row_processor(cfg, [["z", "n", "e"]], ["emg"])
+        rows = jax.tree.map(lambda a: np.asarray(a)[None], row)
+        inputs, targets = jax.jit(proc_fn)(
+            rows, jnp.asarray([9], jnp.int32), jnp.asarray([True]),
+            jnp.int32(1),
+        )
+        np.testing.assert_allclose(np.asarray(inputs)[0], ref_in, **TOL)
+        np.testing.assert_allclose(np.asarray(targets)[0], ref_emg, **TOL)
+
+
+# ------------------------------------------------------ RNG / resume stability
+class TestRngStability:
+    def test_draws_are_order_free_and_stable(self):
+        pre = make_pre()
+        cfg = make_cfg(pre, seed=11)
+        a = get_draws(cfg, 3, 17)
+        # different call order / fresh process state: same values
+        _ = get_draws(cfg, 9, 1)
+        b = get_draws(cfg, 3, 17)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+    def test_distinct_keys_across_epoch_and_index(self):
+        pre = make_pre()
+        cfg = make_cfg(pre, seed=11)
+        a = get_draws(cfg, 3, 17)
+        for epoch, idx in [(4, 17), (3, 18)]:
+            other = get_draws(cfg, epoch, idx)
+            assert not np.allclose(a["gen_field"], other["gen_field"])
+
+
+# ----------------------------------------------------------- executor parity
+@pytest.fixture(scope="module")
+def tiny():
+    """Shared tiny training setup (phasenet @ 128 samples, batch 4) —
+    module-scoped so the executor tests pay the dataset/store build once."""
+    from seist_tpu.models import api
+    from seist_tpu.train import build_optimizer, create_train_state
+
+    in_samples, batch = 128, 4
+    spec = taskspec.get_task_spec("phasenet")
+    loss_fn = taskspec.make_loss("phasenet")
+    sds = pl.from_task_spec(
+        spec, "synthetic", "train", seed=3, in_samples=in_samples,
+        augmentation=True, data_split=False, shuffle=True,
+        shift_event_rate=0.5, add_noise_rate=0.5, add_gap_rate=0.5,
+        drop_channel_rate=0.5, scale_amplitude_rate=0.5,
+        pre_emphasis_rate=0.5, generate_noise_rate=0.1, add_event_rate=0.5,
+        max_event_num=2,
+        dataset_kwargs={"num_events": 8, "trace_samples": 192},
+    )
+    store = pl.RawStore.build(sds)
+    cache = pl.DeviceEpochCache(store)
+    cfg = da.AugConfig.from_preprocessor(
+        sds.preprocessor, seed=3, raw_len=store.raw_len,
+        phase_slots=store.phase_slots,
+    )
+    proc = da.make_cache_processor(
+        cfg, sds.input_names, sds.label_names,
+        n_raw=store.n_raw, augmentation=store.augmentation,
+    )
+    model = api.create_model("phasenet", in_samples=in_samples)
+    variables = api.init_variables(
+        model, in_samples=in_samples, batch_size=batch
+    )
+
+    def new_state():
+        fresh = jax.tree.map(jnp.array, variables)
+        # SGD, not Adam: the restart test compares trained params across
+        # two runs of the same program, and XLA CPU's threaded reductions
+        # can wiggle gradients at the ~1e-7 level under suite load —
+        # Adam's v-normalization amplifies that to ~1e-3 within two
+        # steps (observed in-suite), while SGD keeps it at lr*noise.
+        return create_train_state(model, fresh, build_optimizer("sgd", 1e-2))
+
+    def chunks(k, start=0, cache_=None):
+        return list(
+            (cache_ or cache).epoch_index_chunks(
+                0, seed=3, shuffle=True, batch_size=batch,
+                steps_per_call=k, start_batch=start,
+            )
+        )
+
+    return dict(
+        sds=sds, store=store, cache=cache, cfg=cfg, proc=proc,
+        spec=spec, loss_fn=loss_fn, new_state=new_state, chunks=chunks,
+        batch=batch,
+    )
+
+
+class TestCachedExecutor:
+    def test_resume_through_restart_is_bit_exact(self, tiny):
+        """Two steps of an uninterrupted run == one step, then a simulated
+        preempt/restore (store re-decoded, cache re-uploaded, epoch order
+        recomputed from the restored (epoch, batch) position), then the
+        second step: the augmentation stream must not diverge. The jitted
+        executable is reused across the restart — the XLA program is a
+        pure function of the config, so a real restart recompiles the
+        identical program; the fresh arrays prove the upload itself is
+        deterministic."""
+        from seist_tpu.train import jit_cached_call, make_cached_train_call
+
+        sds, cache, proc = tiny["sds"], tiny["cache"], tiny["proc"]
+        spec, loss_fn = tiny["spec"], tiny["loss_fn"]
+        rng = jax.random.PRNGKey(0)
+
+        call1 = jit_cached_call(
+            make_cached_train_call(spec, loss_fn, proc, steps_per_call=1),
+            None, cache.arrays,
+        )
+        chunks = tiny["chunks"](1)
+        s_a = tiny["new_state"]()
+        for c in chunks[:2]:  # uninterrupted
+            s_a, _, _ = call1(
+                s_a, cache.arrays, jnp.asarray(c), jnp.int32(0), rng
+            )
+
+        s_b = tiny["new_state"]()
+        s_b, _, _ = call1(
+            s_b, cache.arrays, jnp.asarray(chunks[0]), jnp.int32(0), rng
+        )
+        store2 = pl.RawStore.build(sds)  # the restart
+        cache2 = pl.DeviceEpochCache(store2)
+        chunk2 = tiny["chunks"](1, start=1, cache_=cache2)[0]
+        # The augmentation stream itself must be BIT-exact across the
+        # restart: same epoch order, same re-decoded store, same
+        # processed (inputs, targets) for the resumed chunk.
+        np.testing.assert_array_equal(chunk2, chunks[1])
+        for a, b in zip(
+            jax.tree.leaves(cache.arrays), jax.tree.leaves(cache2.arrays)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        x1, y1 = jax.jit(proc)(cache.arrays, jnp.asarray(chunk2[0]), jnp.int32(0))
+        x2, y2 = jax.jit(proc)(cache2.arrays, jnp.asarray(chunk2[0]), jnp.int32(0))
+        np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+        s_b, _, _ = call1(
+            s_b, cache2.arrays, jnp.asarray(chunk2), jnp.int32(0), rng
+        )
+        # Trained params: tight tolerance rather than bit-equality — XLA
+        # CPU's threaded reductions may wiggle gradients ~1e-7 under
+        # load; with SGD that stays at lr*noise, while a genuine stream
+        # divergence would show at the 1e-3 scale.
+        for a, b in zip(
+            jax.tree.leaves(s_a.params), jax.tree.leaves(s_b.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=0, atol=1e-6
+            )
+
+    def test_chunking_is_pure_reshape_of_epoch_order(self, tiny):
+        """steps_per_call only chunks the (shared) epoch order — k=2
+        chunks are exactly the k=1 chunks stacked pairwise, so packing
+        cannot change which sample lands in which step."""
+        c1 = np.concatenate([c for c in tiny["chunks"](1)])
+        c2 = np.concatenate([c for c in tiny["chunks"](2)])
+        np.testing.assert_array_equal(c1[: len(c2)], c2)
+
+    def test_cache_and_row_processors_agree(self, tiny):
+        """The cached gather path and the host-fed row path build
+        bit-identical (inputs, targets) for the same epoch indices."""
+        sds, store, cache = tiny["sds"], tiny["store"], tiny["cache"]
+        idx = tiny["chunks"](1)[0][0]
+        x_c, y_c = jax.jit(tiny["proc"])(
+            cache.arrays, jnp.asarray(idx), jnp.int32(0)
+        )
+        proc_rows = da.make_row_processor(
+            tiny["cfg"], sds.input_names, sds.label_names
+        )
+        rows, sel, aug = next(
+            pl.iter_raw_batches(
+                store, 0, seed=3, shuffle=True, batch_size=tiny["batch"]
+            )
+        )
+        np.testing.assert_array_equal(sel, idx)
+        x_r, y_r = jax.jit(proc_rows)(
+            jax.tree.map(jnp.asarray, rows), jnp.asarray(sel),
+            jnp.asarray(aug), jnp.int32(0),
+        )
+        np.testing.assert_array_equal(np.asarray(x_c), np.asarray(x_r))
+        np.testing.assert_array_equal(np.asarray(y_c), np.asarray(y_r))
+
+    def test_epoch_order_matches_host_loader(self, tiny):
+        """Device executors consume the exact global sample sequence the
+        host Loader would (pipeline.epoch_indices is shared)."""
+        loader = pl.Loader(
+            tiny["sds"], batch_size=tiny["batch"], shuffle=True, seed=3
+        )
+        loader.set_epoch(0)
+        host_order = loader._indices()
+        dev_order = np.concatenate(
+            [c.reshape(-1) for c in tiny["chunks"](1)]
+        )
+        np.testing.assert_array_equal(host_order[: len(dev_order)], dev_order)
+
+
+# ------------------------------------------------------- input-split bench
+class TestInputSplit:
+    def test_step_time_split_math(self):
+        from seist_tpu.utils.profiling import StepTimeSplit
+
+        s = StepTimeSplit(skip_first=1)
+        s.step(9.0, 9.0)  # compile step — excluded
+        s.step(0.003, 0.001)
+        s.step(0.001, 0.003)
+        out = s.summary()
+        assert out["steps"] == 2
+        assert out["host_wait_ms_per_step"] == 2.0
+        assert out["device_time_ms_per_step"] == 2.0
+        assert out["input_bound_fraction"] == 0.5
+        assert len(out["per_step_host_wait_ms"]) == 2
+        assert StepTimeSplit().summary()["input_bound_fraction"] is None
+
+    @pytest.mark.slow  # two extra jit compiles; bench.py runs this live
+    def test_measure_input_split_cached_removes_host_stacking(self):
+        """The acceptance claim on the CPU microbench: the cached
+        device-aug path's per-step host wait is measurably below the
+        host path's (which pays per-sample numpy augmentation + Python
+        stacking + device_put), in the SAME run."""
+        import bench as bench_mod
+
+        spec = taskspec.get_task_spec("phasenet")
+        loss_fn = taskspec.make_loss("phasenet")
+        cfg = {
+            "model": "phasenet",
+            "batch": 4,
+            "in_samples": 256,
+            "dtype": "fp32",
+            "steps_per_call": 1,
+            "lowering_overrides": {},
+        }
+        split = bench_mod.measure_input_split(spec, loss_fn, cfg, steps=3)
+        host = split["host_path"]
+        cached = split["device_aug_cached"]
+        assert host["input_bound_fraction"] is not None
+        assert cached["input_bound_fraction"] is not None
+        assert split["host_stack_removed"]
+        assert (
+            cached["host_wait_ms_per_step"] < host["host_wait_ms_per_step"]
+        )
+        assert len(host["per_step_host_wait_ms"]) == 3
+
+
+# ------------------------------------------------------- fallback selection
+class TestFallbackSelection:
+    def test_select_modes(self):
+        sel = da.select_device_aug_mode
+        assert sel("off", 0, 100, []) == ("off", "")
+        assert sel("cached", 50, 100, [])[0] == "cached"
+        mode, why = sel("cached", 200, 100, [])
+        assert mode == "step" and "budget" in why
+        mode, why = sel("cached", 50, 100, [], multi_process=True)
+        assert mode == "step"
+        mode, why = sel("cached", 50, 100, ["mask_percent"])
+        assert mode == "off" and "mask_percent" in why
+        mode, why = sel("step", 10**12, 100, [])
+        assert mode == "step"
+        with pytest.raises(ValueError):
+            sel("bogus", 0, 0, [])
+
+    def test_unsupported_reasons(self):
+        pre = make_pre(mask_percent=10)
+        assert da.unsupported_reasons(pre, [["z", "n", "e"]], [["det"]])
+        pre = make_pre()
+        assert da.unsupported_reasons(pre, [["z", "n", "e"]], [["det", "ppk", "spk"]]) == []
+        # generate_noise + VALUE label is the host-crash case: refused
+        pre = make_pre(generate_noise_rate=0.1)
+        assert any(
+            "emg" in r
+            for r in da.unsupported_reasons(pre, [["z", "n", "e"]], ["emg"])
+        )
+        # p_position_ratio mode is host-only
+        pre = make_pre(p_position_ratio=0.5)
+        assert da.unsupported_reasons(pre, [["z", "n", "e"]], [["det"]])
+
+    def test_hbm_budget_explicit(self):
+        assert da.hbm_budget_bytes(2.0) == 2 << 30
+        assert da.hbm_budget_bytes(0.0) > 0
+
+    def test_store_estimate_close_to_actual(self):
+        sds = pl.from_task_spec(
+            taskspec.get_task_spec("phasenet"), "synthetic", "train",
+            seed=0, in_samples=256, augmentation=False, data_split=False,
+            dataset_kwargs={"num_events": 6, "trace_samples": 300},
+        )
+        est = pl.RawStore.estimate_bytes(sds)
+        store = pl.RawStore.build(sds)
+        assert est <= store.nbytes <= est * 1.5
+
+    def test_store_rejects_ragged_lengths(self):
+        class Ragged:
+            pass
+
+        sds = pl.from_task_spec(
+            taskspec.get_task_spec("phasenet"), "synthetic", "train",
+            seed=0, in_samples=256, augmentation=False, data_split=False,
+            dataset_kwargs={"num_events": 4, "trace_samples": 300},
+        )
+        orig = sds.raw_event
+
+        def ragged(idx):
+            ev, meta = orig(idx)
+            if idx == 2:
+                ev = dict(ev, data=ev["data"][:, :-7])
+            return ev, meta
+
+        sds.raw_event = ragged
+        with pytest.raises(ValueError, match="uniform raw trace"):
+            pl.RawStore.build(sds)

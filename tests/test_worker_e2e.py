@@ -204,6 +204,57 @@ def test_train_with_grad_accum(tmp_path):
     assert np.isfinite(loss)
 
 
+@pytest.mark.parametrize("mode", ["cached", "step"])
+def test_train_with_device_aug(tmp_path, mode):
+    """--device-aug e2e: augmentation + label synthesis inside the jitted
+    step (step mode: host-fed raw rows; cached mode: HBM-resident epochs
+    + scan executor), through the full worker path to a loadable
+    checkpoint and finite test loss."""
+    from seist_tpu.train.worker import test_worker, train_worker
+
+    logger.set_logdir(str(tmp_path))
+    args = make_args(
+        mode="train_test",
+        epochs=1,
+        device_aug=mode,
+        augmentation=True,
+        shift_event_rate=0.3,
+        add_noise_rate=0.3,
+        add_gap_rate=0.3,
+        drop_channel_rate=0.3,
+        scale_amplitude_rate=0.3,
+        pre_emphasis_rate=0.3,
+        generate_noise_rate=0.05,
+        add_event_rate=0.3,
+        max_event_num=2,
+        dataset_kwargs={"num_events": 24, "trace_samples": 1536},
+    )
+    ckpt = train_worker(args)
+    assert ckpt and os.path.exists(ckpt)
+    args.checkpoint = ckpt
+    loss = test_worker(args)
+    assert np.isfinite(loss)
+
+
+def test_device_aug_unsupported_config_falls_back(tmp_path):
+    """mask_percent is host-only: the worker must fall back to the host
+    path (and still train) instead of crashing or silently changing
+    semantics."""
+    from seist_tpu.train.worker import train_worker
+
+    logger.set_logdir(str(tmp_path))
+    args = make_args(
+        mode="train",
+        epochs=1,
+        device_aug="cached",
+        augmentation=True,
+        mask_percent=10,
+        dataset_kwargs={"num_events": 16, "trace_samples": 1536},
+    )
+    ckpt = train_worker(args)
+    assert ckpt and os.path.exists(ckpt)
+
+
 def test_train_then_test_on_packed_dataset(tmp_path_factory):
     """The packed-shard dataset through the FULL worker path (train ->
     checkpoint -> test -> metrics), the integration a reference user

@@ -27,6 +27,56 @@ sys.path.insert(
 )
 
 
+def attribute_collectives(ops, param_shapes, batch: int, devices: int) -> dict:
+    """Bucket per-op collective payloads (VERDICT r3 #6 + advisor r4).
+
+    Gradient reductions are all-reduces of param-shaped tensors inside
+    the backward pass (op_name carries XLA's "transpose(jvp(...))"
+    marker). Param-shaped all-reduces WITHOUT that marker land in
+    ``unattributed`` (XLA's combiner can drop/merge metadata — silently
+    misfiling them under bn_stat would claim ~0 gradient traffic);
+    ``warn_unattributed`` is True when that bucket is nonzero while zero
+    gradient ops were found, i.e. the unattributed bytes ARE the
+    gradients. Batch-leading-dim collectives are activation traffic.
+    """
+    param_shapes = {tuple(s) for s in param_shapes}
+    grad_bytes = grad_ops = act_bytes = act_ops = other_bytes = 0
+    unattr_bytes = unattr_ops = 0
+    per_shard_batch = batch // devices
+    for op in ops:
+        dims = op["shape_dims"]
+        is_param_shaped_ar = op["kind"] == "all-reduce" and any(
+            tuple(d) in param_shapes for d in dims
+        )
+        if is_param_shaped_ar and "transpose(jvp" in op["op_name"]:
+            grad_bytes += op["bytes"]
+            grad_ops += 1
+        elif is_param_shaped_ar:
+            # Checked BEFORE the batch-leading-dim heuristic so a param
+            # with a batch-sized leading dim can't shadow it.
+            unattr_bytes += op["bytes"]
+            unattr_ops += 1
+            other_bytes += op["bytes"]
+        elif any(
+            d and d[0] in (batch, per_shard_batch) and len(d) >= 2
+            for d in dims
+        ):
+            act_bytes += op["bytes"]
+            act_ops += 1
+        else:
+            other_bytes += op["bytes"]
+    return {
+        "grad_bytes": grad_bytes,
+        "grad_ops": grad_ops,
+        "act_bytes": act_bytes,
+        "act_ops": act_ops,
+        "other_bytes": other_bytes,
+        "unattr_bytes": unattr_bytes,
+        "unattr_ops": unattr_ops,
+        "warn_unattributed": bool(grad_ops == 0 and unattr_bytes),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="seist_l_dpk")
@@ -119,36 +169,12 @@ def main() -> None:
     param_shapes = {
         tuple(np.shape(x)) for x in jax.tree.leaves(state.params)
     }
-    grad_bytes = grad_ops = act_bytes = act_ops = other_bytes = 0
-    unattr_bytes = unattr_ops = 0
-    per_shard_batch = args.batch // n
-    for op in ops:
-        dims = op["shape_dims"]
-        is_param_shaped_ar = op["kind"] == "all-reduce" and any(
-            tuple(d) in param_shapes for d in dims
-        )
-        if is_param_shaped_ar and "transpose(jvp" in op["op_name"]:
-            grad_bytes += op["bytes"]
-            grad_ops += 1
-        elif is_param_shaped_ar:
-            # Param-shaped all-reduces that LACK the backward-pass op_name
-            # marker: XLA's combiner can drop/merge metadata, and silently
-            # filing gradient bytes under the activation or scalar buckets
-            # would make the report claim ~0 gradient traffic (advisor
-            # r4). Checked BEFORE the batch-leading-dim heuristic so a
-            # param with a batch-sized leading dim can't shadow it.
-            unattr_bytes += op["bytes"]
-            unattr_ops += 1
-            other_bytes += op["bytes"]
-        elif any(
-            d and d[0] in (args.batch, per_shard_batch) and len(d) >= 2
-            for d in dims
-        ):
-            act_bytes += op["bytes"]
-            act_ops += 1
-        else:
-            other_bytes += op["bytes"]
-    if grad_ops == 0 and unattr_bytes:
+    buckets = attribute_collectives(ops, param_shapes, args.batch, n)
+    grad_bytes, grad_ops = buckets["grad_bytes"], buckets["grad_ops"]
+    act_bytes, act_ops = buckets["act_bytes"], buckets["act_ops"]
+    other_bytes = buckets["other_bytes"]
+    unattr_bytes, unattr_ops = buckets["unattr_bytes"], buckets["unattr_ops"]
+    if buckets["warn_unattributed"]:
         print(
             "WARNING: no all-reduce carries the transpose(jvp) gradient "
             f"marker, but {unattr_ops} param-shaped all-reduce op(s) "
