@@ -4,7 +4,7 @@ NATIVE_DIR := seist_tpu/native
 CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
-.PHONY: native test t1 serve-smoke clean
+.PHONY: native test t1 lint lint-baseline serve-smoke clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -13,6 +13,17 @@ $(NATIVE_DIR)/libwavekit.so: $(NATIVE_DIR)/wavekit.cpp
 
 test:
 	python -m pytest tests/ -x -q
+
+# jaxlint static-analysis gate (docs/STATIC_ANALYSIS.md): JAX hot-path
+# hazards — host syncs, PRNG key reuse, missing donate_argnums, retrace
+# hazards, wall-clock intervals, broad excepts. Fails only on findings
+# NOT grandfathered in tools/jaxlint_baseline.json.
+lint:
+	python -m tools.jaxlint seist_tpu
+
+# Re-accept the current findings (review the diff before committing!).
+lint-baseline:
+	python -m tools.jaxlint seist_tpu --update-baseline
 
 # Tier-1 verify: the exact line from ROADMAP.md (fast lane, CPU backend,
 # slow-marked kill/resume e2e excluded). Prints DOTS_PASSED for the driver.

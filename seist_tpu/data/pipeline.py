@@ -307,6 +307,9 @@ class Loader:
     def __del__(self):  # best-effort: Loaders built in loops must not leak
         try:
             self.close()
+        # __del__ runs during interpreter teardown when pool/module state
+        # may already be gone; raising here would only print an unraisable
+        # warning, so swallow everything.
         except Exception:
             pass
 

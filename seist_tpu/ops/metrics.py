@@ -260,6 +260,7 @@ class Metrics:
         self._t_thres = int(time_threshold * sampling_rate)
         self._num_samples = num_samples
         self._counters: Optional[Dict[str, jnp.ndarray]] = None
+        self._host_counters: Optional[Dict[str, np.ndarray]] = None
         self._tgts: List[np.ndarray] = []
         self._results: Optional[Dict[str, float]] = None
 
@@ -340,7 +341,13 @@ class Metrics:
                 if self._counters is not None
                 else init_counters(self._metric_names)
             )
-            self._results = finalize(self._task, self._metric_names, counters, tgts)
+            # ONE batched transfer of the whole counter dict; finalize's
+            # per-key np.asarray is then a host no-op instead of a
+            # device->host round trip per counter.
+            self._host_counters = jax.device_get(counters)
+            self._results = finalize(
+                self._task, self._metric_names, self._host_counters, tgts
+            )
         return self._results
 
     def get_metric(self, name: str) -> float:
@@ -360,12 +367,16 @@ class Metrics:
         return "  ".join(f"{k.upper()} {v:6.4f}" for k, v in self._all().items())
 
     def to_dict(self) -> dict:
+        # _all() batch-fetches every counter in one jax.device_get; the
+        # per-key loop below then walks host numpy arrays only (the old
+        # per-entry arr.item() loop was one device sync per counter).
         self._all()
         out: dict = {}
         if self._counters:
-            for k, v in self._counters.items():
-                arr = np.asarray(v)
+            for k, arr in self._host_counters.items():
+                arr = np.asarray(arr)
                 if arr.ndim == 0:
+                    # jaxlint: disable=host-sync-item-loop -- host numpy; the batched device_get in _all() already moved it
                     out[k] = arr.item()
                 else:
                     for i, vi in enumerate(arr.tolist()):

@@ -85,7 +85,9 @@ class ServeService:
         self._lock = threading.Lock()
         self._requests = {"predict": 0, "annotate": 0}
         self._annotate_windows = 0
-        self._started_at = time.time()
+        # monotonic: _started_at only ever feeds uptime_s intervals, and a
+        # wall-clock step must not make uptime jump (or go negative).
+        self._started_at = time.monotonic()
         self._draining = False
         # Readiness gate: /healthz/ready reports 503 while the pool is
         # still pre-compiling (warmup_async=True lets the HTTP socket come
@@ -268,7 +270,7 @@ class ServeService:
             "ready": self.ready(),
             "models": self.pool.names(),
             "buckets": list(self.buckets),
-            "uptime_s": round(time.time() - self._started_at, 3),
+            "uptime_s": round(time.monotonic() - self._started_at, 3),
             "warmup": self.pool.warmup_report,
         }
 
@@ -277,7 +279,7 @@ class ServeService:
             requests = dict(self._requests)
             annotate_windows = self._annotate_windows
         return {
-            "uptime_s": round(time.time() - self._started_at, 3),
+            "uptime_s": round(time.monotonic() - self._started_at, 3),
             "requests": requests,
             "annotate": {
                 "windows": annotate_windows,

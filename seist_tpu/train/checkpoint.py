@@ -390,6 +390,9 @@ def load_checkpoint(
         for meta in metas:
             try:
                 return restorer.restore(path, _restore_target(state, meta))
+            # Probing both meta layouts: orbax raises layout-specific types
+            # we can't enumerate. The first (most informative) failure is
+            # kept and re-raised below if the raw restore can't save us.
             except Exception as e:
                 first_exc = first_exc or e
         raw = restorer.restore(path)

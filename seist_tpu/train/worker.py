@@ -310,6 +310,7 @@ def validate(
         # Weight by the GLOBAL valid count so every host's running val loss
         # is identical — checkpoint/early-stop decisions must not diverge
         # across hosts (tail padding lives on one host's shard only).
+        # jaxlint: disable=host-sync-item-loop -- one scalar per VAL batch; the running meter (and the float(loss) next line) needs it now
         global_valid = int(np.asarray(jax.device_get(batch.mask.sum())))
         loss_meter.update(float(loss), max(global_valid, 1))
         results = _postprocess_batch(args, spec, outputs, fs)
@@ -855,7 +856,10 @@ def train_worker(args: Any) -> str:
             )
 
     for epoch in range(start_epoch, epochs):
-        t0 = time.time()
+        # Interval clocks (epoch time, wave/s) are monotonic: an NTP step
+        # or suspend must not corrupt ETA/throughput math on a days-long
+        # run. time.time() remains only where a real timestamp is reported.
+        t0 = time.monotonic()
         train_loader.set_epoch(epoch)
         skip = start_batch if epoch == start_epoch else 0
         if skip and kpack > 1 and skip % kpack:
@@ -879,7 +883,7 @@ def train_worker(args: Any) -> str:
         progress = ProgressMeter(
             steps_per_epoch, [loss_meter, wps_meter], prefix=f"Epoch[{epoch}] "
         )
-        t_step = time.time()
+        t_step = time.monotonic()
         # Device->host transfers are confined to every --log-step steps:
         # pulling loss/outputs every step serializes JAX's async dispatch
         # and stalls the chip on host postprocess (the per-step numbers are
@@ -938,7 +942,7 @@ def train_worker(args: Any) -> str:
                 if call % args.log_step == 0:
                     loss_f = float(loss)
                     loss_meter.update(loss_f, 1)
-                    now = time.time()
+                    now = time.monotonic()
                     calls_done = min(args.log_step, call) or 1
                     wps_meter.update(
                         global_bs * kpack * calls_done
@@ -1000,7 +1004,7 @@ def train_worker(args: Any) -> str:
                 if step % args.log_step == 0:
                     loss_f = float(loss)
                     loss_meter.update(loss_f, 1)
-                    now = time.time()
+                    now = time.monotonic()
                     steps_done = min(args.log_step, step) or 1
                     wps_meter.update(
                         global_bs * steps_done / max(now - t_step, 1e-9)
@@ -1052,7 +1056,7 @@ def train_worker(args: Any) -> str:
                 if call % args.log_step == 0:
                     loss_f = float(loss)
                     loss_meter.update(loss_f, 1)
-                    now = time.time()
+                    now = time.monotonic()
                     calls_done = min(args.log_step, call) or 1
                     wps_meter.update(
                         global_bs * kpack * calls_done
@@ -1095,7 +1099,7 @@ def train_worker(args: Any) -> str:
                 if step % args.log_step == 0:
                     loss_f = float(loss)
                     loss_meter.update(loss_f, 1)
-                    now = time.time()
+                    now = time.monotonic()
                     steps_done = min(args.log_step, step) or 1
                     wps_meter.update(
                         global_bs * steps_done / max(now - t_step, 1e-9)
@@ -1199,7 +1203,7 @@ def train_worker(args: Any) -> str:
         if preempt.triggered:  # SIGTERM during validation
             _preempt_exit(state, epoch, steps_per_epoch, epoch_end_step)
 
-        dt = time.time() - t0
+        dt = time.monotonic() - t0
         epoch_times.append(dt)
         eta = float(np.mean(epoch_times)) * (epochs - epoch - 1)
         logger.info(

@@ -25,6 +25,10 @@ class ScalarWriter:
 
             self._tb = SummaryWriter(logdir)
             mode = "tensorboard event files"
+        # tensorboard is optional: ANY import/init failure (missing
+        # package, protobuf version clash, unwritable event file) must
+        # degrade to the JSONL sink, never kill a training run over a
+        # diagnostics writer.
         except Exception:
             self._jsonl = open(os.path.join(logdir, "scalars.jsonl"), "a")
             mode = "JSONL fallback (tensorboard unavailable)"

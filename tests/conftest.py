@@ -36,17 +36,71 @@ if not _USE_TPU:
 # run — the disk cache (the same one bench.py and the CLI use) cuts a
 # repeat compile ~3x even on CPU. Threshold 2 s: catches every model
 # compile, skips trivial jits. First (cold) run pays full price.
+#
+# KNOWN HAZARD (ROADMAP open item): cache-DESERIALIZED executables can
+# intermittently corrupt donated outputs in unsynchronized donated step
+# chains on jax 0.4.37 CPU. tests/test_compile_budget.py (which asserts
+# on state after such chains) opts out via its _no_persistent_cache
+# fixture; a test that starts flaking with garbage donated outputs on
+# warm caches should do the same.
 from seist_tpu.utils.misc import enable_compile_cache  # noqa: E402
 
 enable_compile_cache(min_compile_seconds=2)
 
+import sys
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# Repo root on sys.path once, for every test/fixture importing tools.*
+# (tools.fixtures, tools.jaxlint, ...).
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
 
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# -- jaxlint runtime audit lane -----------------------------------------------
+# `pytest -m smoke --tracer-leaks` re-runs the pure-unit lane with
+# jax.check_tracer_leaks active around every test: any tracer escaping its
+# trace (closure capture, storing tracers on self, ...) becomes a hard
+# error instead of a latent use-after-trace bug. Opt-in flag because leak
+# checking disables some jit caching and roughly doubles lane wall time.
+def pytest_addoption(parser):
+    parser.addoption(
+        "--tracer-leaks",
+        action="store_true",
+        default=False,
+        help="run every test under jax.check_tracer_leaks "
+        "(jaxlint runtime audit lane; see docs/STATIC_ANALYSIS.md)",
+    )
+
+
+@pytest.fixture
+def compile_budget():
+    """Scoped compile counter (tools/jaxlint/runtime.py): everything jitted
+    inside the test is attributed by function name + abstract shape
+    signature. Assert with ``compile_budget.assert_compiles_once(name)``
+    after driving the jitted path — see tests/test_compile_budget.py."""
+    from tools.jaxlint.runtime import CompileBudget
+
+    with CompileBudget() as budget:
+        yield budget
+
+
+@pytest.fixture(autouse=True)
+def _tracer_leak_lane(request):
+    if request.config.getoption("--tracer-leaks", default=False):
+        from tools.jaxlint.runtime import tracer_leak_check
+
+        with tracer_leak_check():
+            yield
+    else:
+        yield
 
 
 # Smoke lane (`pytest -m smoke`): the pure-unit subset that verifies the
@@ -70,6 +124,7 @@ _SMOKE_FILES = {
     "test_bench_unit.py",
     "test_packed.py",
     "test_collective_report.py",
+    "test_jaxlint.py",
 }
 
 
@@ -84,11 +139,6 @@ def make_packed_dir(tmp_path_factory, n_events=24, trace_samples=1024,
     """Shared recipe: write a DiTing-light fixture, repack it with
     pack_dataset. Returns (source_dataset, packed_dir). Used by
     tests/test_packed.py and the packed worker-e2e lane."""
-    import sys
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    if repo not in sys.path:
-        sys.path.insert(0, repo)
     from tools.fixtures import write_diting_light_fixture
 
     from seist_tpu.data.packed import pack_dataset

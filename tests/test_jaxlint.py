@@ -1,0 +1,812 @@
+"""jaxlint tests: every rule catches its seeded violation and stays quiet
+on the clean twin; suppression, baseline gating, CLI exit codes, and the
+runtime audit lane (CompileBudget mechanics + tracer-leak check) on tiny
+jit programs. The real-model compile-budget regression lives in
+tests/test_compile_budget.py (model compiles are too heavy for the smoke
+lane)."""
+
+import json
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+# repo root is put on sys.path by tests/conftest.py
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from tools.jaxlint import __main__ as jaxlint_cli  # noqa: E402
+from tools.jaxlint.engine import Baseline, lint_source  # noqa: E402
+from tools.jaxlint.runtime import (  # noqa: E402
+    CompileBudget,
+    tracer_leak_check,
+)
+
+HOT = "seist_tpu/train/step.py"  # a hot-path glob match
+COLD = "seist_tpu/cli.py"
+
+
+def rules_of(src, path=COLD):
+    return [f.rule for f in lint_source(textwrap.dedent(src), path)]
+
+
+def lines_of(src, rule, path=COLD):
+    return [
+        f.line
+        for f in lint_source(textwrap.dedent(src), path)
+        if f.rule == rule
+    ]
+
+
+# ------------------------------------------------------- host-sync-hot-path
+def test_hot_path_float_in_loop_flagged():
+    src = """
+    def run(batch):
+        acc = 0.0
+        for x in batch:
+            acc += float(x)
+        return acc
+    """
+    assert rules_of(src, HOT) == ["host-sync-hot-path"]
+    # identical code off the hot path is legal
+    assert rules_of(src, COLD) == []
+
+
+def test_hot_path_item_flagged_anywhere_in_module():
+    src = """
+    def summary(loss):
+        return loss.item()
+    """
+    assert rules_of(src, HOT) == ["host-sync-hot-path"]
+
+
+def test_hot_path_traced_body_flagged():
+    src = """
+    def train_step(state, x):
+        return state, int(x.sum())
+    """
+    assert rules_of(src, HOT) == ["host-sync-hot-path"]
+
+
+def test_hot_path_oneshot_config_coercion_ok():
+    src = """
+    def setup(cfg):
+        lr = float(cfg.lr)
+        n = int(cfg.steps)
+        return lr, n
+    """
+    assert rules_of(src, HOT) == []
+
+
+def test_hot_path_asarray_in_loop_flagged():
+    src = """
+    def drain(chunks, fn):
+        out = []
+        while chunks:
+            out.append(np.asarray(fn(chunks.pop())))
+        return out
+    """
+    assert rules_of(src, HOT) == ["host-sync-hot-path"]
+
+
+# ------------------------------------------------------ host-sync-item-loop
+def test_item_in_loop_flagged_everywhere():
+    src = """
+    def to_host(counters):
+        out = {}
+        for k, v in counters.items():
+            out[k] = v.item()
+        return out
+    """
+    assert rules_of(src) == ["host-sync-item-loop"]
+
+
+def test_per_entry_device_get_flagged():
+    src = """
+    def to_host(counters):
+        out = {}
+        for k in counters:
+            out[k] = jax.device_get(counters[k])
+        return out
+    """
+    assert rules_of(src) == ["host-sync-item-loop"]
+
+
+def test_batched_device_get_in_epoch_loop_ok():
+    src = """
+    def train(epochs, losses):
+        for epoch in range(epochs):
+            host = jax.device_get(losses)
+        return host
+    """
+    assert rules_of(src) == []
+
+
+# --------------------------------------------------------- prng-key-reuse
+def test_key_dual_use_flagged():
+    src = """
+    def f(key):
+        a = jax.random.normal(key, (3,))
+        b = jax.random.uniform(key, (3,))
+        return a + b
+    """
+    assert rules_of(src) == ["prng-key-reuse"]
+    assert lines_of(src, "prng-key-reuse") == [4]  # the SECOND consumption
+
+
+def test_key_split_between_uses_ok():
+    src = """
+    def f(key):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, (3,))
+        b = jax.random.uniform(k2, (3,))
+        return a + b
+    """
+    assert rules_of(src) == []
+
+
+def test_key_reassigned_between_uses_ok():
+    src = """
+    def f(key):
+        a = jax.random.normal(key, (3,))
+        key = jax.random.fold_in(key, 1)
+        b = jax.random.uniform(key, (3,))
+        return a + b
+    """
+    assert rules_of(src) == []
+
+
+def test_key_reuse_across_loop_iterations_flagged():
+    src = """
+    def f(key, n):
+        out = []
+        for i in range(n):
+            out.append(jax.random.normal(key, (3,)))
+        return out
+    """
+    assert rules_of(src) == ["prng-key-reuse"]
+
+
+def test_key_folded_per_iteration_ok():
+    src = """
+    def f(key, n):
+        out = []
+        for i in range(n):
+            k = jax.random.fold_in(key, i)
+            out.append(jax.random.normal(k, (3,)))
+        return out
+    """
+    assert rules_of(src) == []
+
+
+def test_split_iteration_ok():
+    src = """
+    def f(key, xs):
+        for x, k in zip(xs, jax.random.split(key, len(xs))):
+            yield jax.random.normal(k, x.shape)
+    """
+    assert rules_of(src) == []
+
+
+def test_key_draws_on_exclusive_branches_ok():
+    # at most one branch executes per call — not a reuse
+    src = """
+    def f(key, cond):
+        if cond:
+            x = jax.random.uniform(key, (3,))
+        else:
+            x = jax.random.normal(key, (3,))
+        return x
+    """
+    assert rules_of(src) == []
+
+
+def test_key_ternary_branches_ok_but_third_use_flagged():
+    src = """
+    def f(key, cond):
+        x = jax.random.uniform(key, (3,)) if cond else jax.random.normal(key, (3,))
+        y = jax.random.bernoulli(key)
+        return x, y
+    """
+    # the ternary arms are exclusive; the draw AFTER the ternary is reuse
+    assert rules_of(src) == ["prng-key-reuse"]
+    assert lines_of(src, "prng-key-reuse") == [4]
+
+
+def test_key_alias_import_tracked():
+    src = """
+    import jax.random as jr
+
+    def f(key):
+        a = jr.normal(key, (3,))
+        b = jr.uniform(key, (3,))
+        return a + b
+    """
+    assert rules_of(src) == ["prng-key-reuse"]
+
+
+# ---------------------------------------------------------- jit-no-donate
+def test_jit_state_step_without_donate_flagged():
+    src = """
+    def train_step(state, batch, rng):
+        return state
+
+    f = jax.jit(train_step)
+    """
+    assert rules_of(src) == ["jit-no-donate"]
+
+
+def test_jit_with_donate_ok():
+    src = """
+    def train_step(state, batch, rng):
+        return state
+
+    f = jax.jit(train_step, donate_argnums=(0,))
+    """
+    assert rules_of(src) == []
+
+
+def test_bare_jit_decorator_on_state_fn_flagged():
+    src = """
+    @jax.jit
+    def update_step(state, grads):
+        return state
+    """
+    assert rules_of(src) == ["jit-no-donate"]
+
+
+def test_eval_step_without_donate_ok():
+    # eval must NOT donate: the state is reused by the caller
+    src = """
+    def eval_step(state, batch):
+        return state.apply_fn(batch)
+
+    f = jax.jit(eval_step)
+    """
+    assert rules_of(src) == []
+
+
+# ------------------------------------------------------- impure-call-in-jit
+def test_wallclock_in_traced_step_flagged():
+    src = """
+    def train_step(state, x):
+        started = time.time()
+        return state, started
+    """
+    assert rules_of(src) == ["impure-call-in-jit"]
+
+
+def test_np_random_in_jitted_fn_flagged():
+    src = """
+    @jax.jit
+    def noisy(x):
+        return x + np.random.rand()
+    """
+    assert rules_of(src) == ["impure-call-in-jit"]
+
+
+def test_wallclock_in_host_fn_ok():
+    src = """
+    def report():
+        return time.time()
+    """
+    assert rules_of(src) == []
+
+
+# ------------------------------------------------------------- jit-in-loop
+def test_jit_inside_loop_flagged():
+    src = """
+    def serve(models, x):
+        for m in models:
+            y = jax.jit(m)(x)
+        return y
+    """
+    assert rules_of(src) == ["jit-in-loop"]
+
+
+def test_jit_hoisted_ok():
+    src = """
+    def serve(model, xs):
+        f = jax.jit(model)
+        return [f(x) for x in xs]
+    """
+    assert rules_of(src) == []
+
+
+# ------------------------------------------------------- nonhashable-static
+def test_static_list_default_flagged():
+    src = """
+    def apply(x, dims=[0, 1]):
+        return x
+
+    f = jax.jit(apply, static_argnums=(1,))
+    """
+    assert rules_of(src) == ["nonhashable-static"]
+
+
+def test_static_argnames_dict_default_flagged():
+    src = """
+    def apply(x, opts={}):
+        return x
+
+    f = jax.jit(apply, static_argnames=("opts",))
+    """
+    assert rules_of(src) == ["nonhashable-static"]
+
+
+def test_static_tuple_default_ok():
+    src = """
+    def apply(x, dims=(0, 1)):
+        return x
+
+    f = jax.jit(apply, static_argnums=(1,))
+    """
+    assert rules_of(src) == []
+
+
+# ------------------------------------------------------- wallclock-interval
+def test_time_time_interval_flagged():
+    src = """
+    def run(work):
+        t0 = time.time()
+        work()
+        return time.time() - t0
+    """
+    assert rules_of(src) == ["wallclock-interval"]
+
+
+def test_wallclock_name_reassigned_to_monotonic_ok():
+    # last-assignment taint: a wall-clock timestamp earlier in the scope
+    # must not poison later monotonic interval math on the same name
+    src = """
+    def run(record, work):
+        t0 = time.time()
+        record["started_at"] = t0
+        t0 = time.monotonic()
+        work()
+        return time.monotonic() - t0
+    """
+    assert rules_of(src) == []
+
+
+def test_wallclock_name_reassigned_to_wallclock_flagged():
+    src = """
+    def run(work):
+        t0 = time.monotonic()
+        t0 = time.time()
+        work()
+        return time.time() - t0
+    """
+    assert rules_of(src) == ["wallclock-interval"]
+
+
+def test_monotonic_interval_ok():
+    src = """
+    def run(work):
+        t0 = time.monotonic()
+        work()
+        return time.monotonic() - t0
+    """
+    assert rules_of(src) == []
+
+
+def test_time_time_timestamp_ok():
+    src = """
+    def stamp(record):
+        record["ts"] = time.time()
+        return record
+    """
+    assert rules_of(src) == []
+
+
+# ------------------------------------------------------------ broad-except
+def test_broad_except_without_rationale_flagged():
+    src = """
+    def f():
+        try:
+            risky()
+        except Exception:
+            pass
+    """
+    assert rules_of(src) == ["broad-except"]
+
+
+def test_bare_except_flagged():
+    src = """
+    def f():
+        try:
+            risky()
+        except:
+            pass
+    """
+    assert rules_of(src) == ["broad-except"]
+
+
+def test_broad_except_with_rationale_ok():
+    src = """
+    def f():
+        try:
+            risky()
+        # best-effort cleanup: failure here must not mask the real error
+        except Exception:
+            pass
+    """
+    assert rules_of(src) == []
+
+
+def test_broad_except_reraise_ok():
+    src = """
+    def f():
+        try:
+            risky()
+        except Exception:
+            cleanup()
+            raise
+    """
+    assert rules_of(src) == []
+
+
+def test_narrow_except_ok():
+    src = """
+    def f():
+        try:
+            risky()
+        except ValueError:
+            pass
+    """
+    assert rules_of(src) == []
+
+
+# ------------------------------------------------------------- suppression
+def test_suppression_with_rationale_silences():
+    src = """
+    def f(key):
+        a = jax.random.normal(key, (3,))
+        b = jax.random.uniform(key, (3,))  # jaxlint: disable=prng-key-reuse -- fixture wants correlated draws
+        return a + b
+    """
+    assert rules_of(src) == []
+
+
+def test_suppression_above_line_silences():
+    src = """
+    def f(key):
+        a = jax.random.normal(key, (3,))
+        # jaxlint: disable=prng-key-reuse -- fixture wants correlated draws
+        b = jax.random.uniform(key, (3,))
+        return a + b
+    """
+    assert rules_of(src) == []
+
+
+def test_suppression_rationale_wrapping_onto_second_comment_line():
+    # the standalone comment must cover the next CODE line, skipping the
+    # wrapped continuation comment in between
+    src = """
+    def f(key):
+        a = jax.random.normal(key, (3,))
+        # jaxlint: disable=prng-key-reuse -- fixture wants correlated draws
+        # (see docs/STATIC_ANALYSIS.md for why this is safe)
+        b = jax.random.uniform(key, (3,))
+        return a + b
+    """
+    assert rules_of(src) == []
+
+
+def test_suppression_above_blank_line_still_covers():
+    src = """
+    def f(key):
+        a = jax.random.normal(key, (3,))
+        # jaxlint: disable=prng-key-reuse -- fixture wants correlated draws
+
+        b = jax.random.uniform(key, (3,))
+        return a + b
+    """
+    assert rules_of(src) == []
+
+
+def test_suppression_without_rationale_is_void_and_flagged():
+    src = """
+    def f(key):
+        a = jax.random.normal(key, (3,))
+        b = jax.random.uniform(key, (3,))  # jaxlint: disable=prng-key-reuse
+        return a + b
+    """
+    assert sorted(rules_of(src)) == [
+        "prng-key-reuse",
+        "suppression-missing-rationale",
+    ]
+
+
+def test_suppression_of_other_rule_does_not_silence():
+    src = """
+    def f(key):
+        a = jax.random.normal(key, (3,))
+        b = jax.random.uniform(key, (3,))  # jaxlint: disable=broad-except -- wrong rule on purpose
+        return a + b
+    """
+    # the original finding survives AND the pointless suppression is called out
+    assert sorted(rules_of(src)) == ["prng-key-reuse", "unused-suppression"]
+
+
+# ---------------------------------------------------------------- baseline
+_VIOLATION = """
+def f(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))
+    return a + b
+"""
+
+
+def test_baseline_grandfathers_then_catches_new():
+    findings = lint_source(_VIOLATION, "pkg/mod.py")
+    assert len(findings) == 1
+    base = Baseline.from_findings(findings)
+    assert base.new_findings(findings) == []
+
+    # a SECOND violation of the same kind on a new line is caught
+    doubled = _VIOLATION + textwrap.dedent(
+        """
+        def g(key):
+            c = jax.random.normal(key, (4,))
+            d = jax.random.uniform(key, (4,))
+            return c + d
+        """
+    )
+    new = base.new_findings(lint_source(doubled, "pkg/mod.py"))
+    assert [f.rule for f in new] == ["prng-key-reuse"]
+    assert new[0].line > findings[0].line
+
+
+def test_baseline_keys_survive_line_shifts():
+    shifted = "\n\n\n\n" + _VIOLATION  # everything moves 4 lines down
+    base = Baseline.from_findings(lint_source(_VIOLATION, "pkg/mod.py"))
+    assert base.new_findings(lint_source(shifted, "pkg/mod.py")) == []
+
+
+def test_baseline_reports_stale_entries():
+    base = Baseline.from_findings(lint_source(_VIOLATION, "pkg/mod.py"))
+    clean = lint_source("def f():\n    return 0\n", "pkg/mod.py")
+    assert clean == []
+    assert len(base.stale_keys(clean)) == 1
+
+
+def test_repo_baseline_is_green():
+    """The shipped gate: the package must be clean vs the checked-in
+    baseline (this is exactly what `make lint` runs)."""
+    rc = jaxlint_cli.main(["seist_tpu", "--root", _REPO])
+    assert rc == 0
+
+
+# --------------------------------------------------------------------- CLI
+def test_cli_flags_seeded_violation(tmp_path, capsys):
+    mod = tmp_path / "bad.py"
+    mod.write_text(_VIOLATION)
+    rc = jaxlint_cli.main(
+        ["bad.py", "--root", str(tmp_path), "--no-baseline", "--format", "json"]
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["total"] == 1
+    assert out["new"][0]["rule"] == "prng-key-reuse"
+    assert out["new"][0]["file"] == "bad.py"
+
+
+def test_cli_update_baseline_roundtrip(tmp_path, capsys):
+    mod = tmp_path / "bad.py"
+    mod.write_text(_VIOLATION)
+    baseline = tmp_path / "baseline.json"
+    rc = jaxlint_cli.main(
+        ["bad.py", "--root", str(tmp_path), "--baseline", str(baseline),
+         "--update-baseline"]
+    )
+    assert rc == 0 and baseline.exists()
+    capsys.readouterr()
+    rc = jaxlint_cli.main(
+        ["bad.py", "--root", str(tmp_path), "--baseline", str(baseline)]
+    )
+    assert rc == 0  # grandfathered
+
+
+def test_cli_nonexistent_path_exits_2(tmp_path, capsys):
+    rc = jaxlint_cli.main(["no_such_pkg", "--root", str(tmp_path)])
+    assert rc == 2
+    assert "does not exist" in capsys.readouterr().err
+
+
+def test_cli_update_baseline_with_select_refused(tmp_path):
+    (tmp_path / "x.py").write_text("x = 1\n")
+    with pytest.raises(SystemExit):
+        jaxlint_cli.main(
+            ["x.py", "--root", str(tmp_path), "--select", "broad-except",
+             "--update-baseline"]
+        )
+
+
+def test_cli_subset_update_preserves_other_files(tmp_path, capsys):
+    (tmp_path / "a.py").write_text(_VIOLATION)
+    (tmp_path / "b.py").write_text(_VIOLATION)
+    baseline = tmp_path / "baseline.json"
+    args = ["--root", str(tmp_path), "--baseline", str(baseline)]
+    assert jaxlint_cli.main(["a.py", "b.py", *args, "--update-baseline"]) == 0
+    # re-accepting only a.py must NOT drop b.py's accepted entry
+    assert jaxlint_cli.main(["a.py", *args, "--update-baseline"]) == 0
+    capsys.readouterr()
+    assert jaxlint_cli.main(["a.py", "b.py", *args]) == 0
+
+
+def test_update_baseline_never_accepts_suppression_hygiene(tmp_path, capsys):
+    # a rationale-less suppression must keep failing the gate even after
+    # a blanket `make lint-baseline`
+    (tmp_path / "a.py").write_text(
+        "def f(key):\n"
+        "    a = jax.random.normal(key, (3,))\n"
+        "    b = jax.random.uniform(key, (3,))  # jaxlint: disable=prng-key-reuse\n"
+        "    return a + b\n"
+    )
+    baseline = tmp_path / "baseline.json"
+    args = ["a.py", "--root", str(tmp_path), "--baseline", str(baseline)]
+    assert jaxlint_cli.main([*args, "--update-baseline"]) == 0
+    capsys.readouterr()
+    rc = jaxlint_cli.main(args)
+    out = capsys.readouterr().out
+    assert rc == 1  # the hygiene finding still gates
+    assert "suppression-missing-rationale" in out
+
+
+def test_unused_suppression_reported():
+    src = """
+    def f(key):
+        a = jax.random.normal(key, (3,))  # jaxlint: disable=prng-key-reuse -- nothing to excuse here
+        return a
+    """
+    assert rules_of(src) == ["unused-suppression"]
+    # under --select-style partial runs, un-run rules must not look unused
+    from tools.jaxlint.rules import RULES_BY_NAME
+
+    partial = lint_source(
+        textwrap.dedent(src), COLD, rules=[RULES_BY_NAME["broad-except"]]
+    )
+    assert partial == []
+
+
+def test_cli_partial_runs_do_not_report_unchecked_entries_stale(
+    tmp_path, capsys
+):
+    (tmp_path / "a.py").write_text(_VIOLATION)
+    (tmp_path / "b.py").write_text(_VIOLATION)
+    baseline = tmp_path / "baseline.json"
+    args = ["--root", str(tmp_path), "--baseline", str(baseline)]
+    assert jaxlint_cli.main(["a.py", "b.py", *args, "--update-baseline"]) == 0
+    capsys.readouterr()
+    # subset path: b.py's entry was not looked for, so it is not stale
+    assert jaxlint_cli.main(["a.py", *args]) == 0
+    assert "no longer observed" not in capsys.readouterr().out
+    # subset rules: un-run rules' entries are not stale either
+    assert (
+        jaxlint_cli.main(["a.py", "b.py", *args, "--select", "broad-except"])
+        == 0
+    )
+    assert "no longer observed" not in capsys.readouterr().out
+    # a REAL stale entry (violation removed) is still reported on full runs
+    (tmp_path / "b.py").write_text("x = 1\n")
+    assert jaxlint_cli.main(["a.py", "b.py", *args]) == 0
+    assert "no longer observed" in capsys.readouterr().out
+
+
+def test_cli_overlapping_paths_lint_each_file_once(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(_VIOLATION)
+    baseline = tmp_path / "baseline.json"
+    args = ["--root", str(tmp_path), "--baseline", str(baseline)]
+    assert jaxlint_cli.main(["pkg", *args, "--update-baseline"]) == 0
+    capsys.readouterr()
+    # overlapping args must not double-count vs the accepted count of 1
+    rc = jaxlint_cli.main(["pkg", "pkg/mod.py", str(pkg / "mod.py"), *args])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "1 grandfathered" in out
+
+
+def test_cli_parse_error_exits_2(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    rc = jaxlint_cli.main(["broken.py", "--root", str(tmp_path)])
+    assert rc == 2
+
+
+def test_cli_select_unknown_rule_errors(tmp_path):
+    with pytest.raises(SystemExit):
+        jaxlint_cli.main(
+            ["x.py", "--root", str(tmp_path), "--select", "no-such-rule"]
+        )
+
+
+# ------------------------------------------------- runtime: compile budget
+def test_compile_budget_counts_one_compile_per_shape():
+    def tiny_step(x):
+        return x * 2.0
+
+    f = jax.jit(tiny_step)
+    with CompileBudget() as budget:
+        f(jnp.ones((4,)))
+        f(jnp.ones((4,)))  # cache hit — no new trace
+        f(jnp.ones((8,)))  # second shape bucket
+    assert budget.total("tiny_step") == 2
+    assert len(budget.signatures("tiny_step")) == 2
+    budget.assert_compiles_once("tiny_step")
+    with pytest.raises(AssertionError, match="shape buckets"):
+        budget.assert_compiles_once("tiny_step", max_signatures=1)
+
+
+def test_compile_budget_catches_identical_shape_retrace():
+    x = jnp.ones((4,))
+
+    def make(scale):
+        def rebuilt_step(v):
+            return v * scale
+
+        return rebuilt_step
+
+    with CompileBudget() as budget:
+        for _ in range(3):
+            jax.jit(make(2.0))(x)  # fresh closure: retrace per call
+    with pytest.raises(AssertionError, match="retrace on identical shapes"):
+        budget.assert_compiles_once("rebuilt_step")
+
+
+def test_compile_budget_requires_activity():
+    with CompileBudget() as budget:
+        pass
+    with pytest.raises(AssertionError, match="saw no compiles"):
+        budget.assert_compiles_once("never_ran")
+
+
+def test_compile_budget_restores_log_compiles_flag():
+    before = bool(jax.config.jax_log_compiles)
+    with CompileBudget():
+        assert bool(jax.config.jax_log_compiles) is True
+    assert bool(jax.config.jax_log_compiles) is before
+
+
+def test_conftest_compile_budget_fixture(compile_budget):
+    """The conftest fixture variant: active for the whole test body."""
+
+    def fixture_probe(x):
+        return x + 1
+
+    f = jax.jit(fixture_probe)
+    f(jnp.ones((2,)))
+    f(jnp.ones((2,)))
+    compile_budget.assert_compiles_once("fixture_probe")
+
+
+# --------------------------------------------------- runtime: tracer leaks
+def test_tracer_leak_check_catches_seeded_leak():
+    leaked = []
+
+    @jax.jit
+    def leaky(x):
+        leaked.append(x)  # tracer escapes the trace
+        return x * 2
+
+    with pytest.raises(Exception, match="[Ll]eak"):
+        with tracer_leak_check():
+            leaky(jnp.ones((3,)))
+    leaked.clear()
+
+
+def test_tracer_leak_check_passes_clean_fn():
+    @jax.jit
+    def clean(x):
+        return x * 2
+
+    with tracer_leak_check():
+        out = clean(jnp.ones((3,)))
+    assert out.shape == (3,)
+
+
+def test_tracer_leak_check_disabled_is_noop():
+    with tracer_leak_check(enabled=False):
+        pass

@@ -1,0 +1,28 @@
+"""jaxlint — JAX-aware static analysis for the seist_tpu stack.
+
+Ordinary linters can't see the bug classes that cost a TPU training stack
+the most: silent retraces, host syncs in hot paths, PRNG key reuse,
+non-donated train state. jaxlint is an AST pass with a repo-tuned rule
+catalog for exactly those hazards (see tools/jaxlint/rules.py for the
+catalog, docs/STATIC_ANALYSIS.md for the workflow).
+
+Usage:
+    python -m tools.jaxlint seist_tpu                 # lint the package
+    python -m tools.jaxlint --list-rules              # rule catalog
+    python -m tools.jaxlint seist_tpu --update-baseline
+
+A checked-in baseline (tools/jaxlint_baseline.json) grandfathers accepted
+findings; the gate (``make lint``) fails only on NEW violations. Inline
+suppression requires a rationale:
+
+    x = arr.item()  # jaxlint: disable=host-sync-item-loop -- one scalar, cold path
+"""
+
+from tools.jaxlint.engine import (  # noqa: F401
+    Finding,
+    Baseline,
+    lint_paths,
+    lint_source,
+)
+from tools.jaxlint.rules import RULES, Rule  # noqa: F401
+from tools.jaxlint.runtime import CompileBudget, tracer_leak_check  # noqa: F401

@@ -1,0 +1,192 @@
+"""jaxlint CLI.
+
+    python -m tools.jaxlint seist_tpu                    # gate vs baseline
+    python -m tools.jaxlint seist_tpu --no-baseline      # everything
+    python -m tools.jaxlint seist_tpu --update-baseline  # re-grandfather
+    python -m tools.jaxlint --list-rules
+
+Exit codes: 0 clean (vs baseline), 1 new findings, 2 usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.jaxlint.engine import (
+    META_RULES,
+    Baseline,
+    iter_python_files,
+    lint_paths,
+)
+from tools.jaxlint.rules import RULES, RULES_BY_NAME
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools", "jaxlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="JAX-aware static analysis (see docs/STATIC_ANALYSIS.md)",
+    )
+    ap.add_argument("paths", nargs="*", default=[], help="files/dirs to lint")
+    ap.add_argument(
+        "--baseline",
+        default=_DEFAULT_BASELINE,
+        help="grandfather list (default tools/jaxlint_baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    ap.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="accept all current findings into the baseline and exit 0",
+    )
+    ap.add_argument(
+        "--select",
+        default="",
+        help="comma-separated rule names to run (default: all)",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument(
+        "--root",
+        default=_REPO_ROOT,
+        help="path findings are reported relative to (baseline keys)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES:
+            print(f"{rule.name}\n    {rule.summary}\n    fix: {rule.hint}")
+        return 0
+
+    if not args.paths:
+        ap.error("no paths given (try: python -m tools.jaxlint seist_tpu)")
+
+    rules = None
+    if args.select:
+        if args.update_baseline:
+            ap.error(
+                "--update-baseline with --select would record only the "
+                "selected rules' findings and drop every other accepted "
+                "entry for the linted files; update with the full catalog"
+            )
+        names = [n.strip() for n in args.select.split(",") if n.strip()]
+        unknown = [n for n in names if n not in RULES_BY_NAME]
+        if unknown:
+            ap.error(
+                f"unknown rule(s) {unknown}; see --list-rules"
+            )
+        rules = [RULES_BY_NAME[n] for n in names]
+
+    try:
+        findings = lint_paths(args.paths, root=args.root, rules=rules)
+    except FileNotFoundError as e:
+        print(f"jaxlint: {e}", file=sys.stderr)
+        return 2
+    if any(f.rule == "parse-error" for f in findings):
+        for f in findings:
+            if f.rule == "parse-error":
+                print(f.render(), file=sys.stderr)
+        return 2
+
+    linted = {
+        os.path.relpath(os.path.abspath(p), os.path.abspath(args.root))
+        .replace(os.sep, "/")
+        for p in iter_python_files(args.paths, os.path.abspath(args.root))
+    }
+
+    if args.update_baseline:
+        # Merge, don't overwrite: accepted entries for files OUTSIDE this
+        # invocation's paths are preserved, so a subset run (e.g.
+        # `tools.jaxlint seist_tpu/train --update-baseline`) can't
+        # silently drop the rest of the grandfather list.
+        old = Baseline.load(args.baseline)
+        kept = {
+            k: v
+            for k, v in old.counts.items()
+            if k.split("::", 1)[0] not in linted
+        }
+        # Meta-findings (void/stale suppressions) are about the lint
+        # annotations themselves — accepting them would disable the
+        # suppression-hygiene checks forever, so they stay gating.
+        acceptable = [f for f in findings if f.rule not in META_RULES]
+        merged = Baseline(kept)
+        merged.counts.update(Baseline.from_findings(acceptable).counts)
+        merged.save(args.baseline)
+        print(
+            f"baseline updated: {len(acceptable)} accepted finding(s) from "
+            f"{len(linted)} linted file(s), {len(kept)} entr(ies) for "
+            "unlinted files preserved -> "
+            f"{os.path.relpath(args.baseline, args.root)}"
+        )
+        skipped = len(findings) - len(acceptable)
+        if skipped:
+            print(
+                f"jaxlint: {skipped} suppression-hygiene finding(s) NOT "
+                "accepted (fix the annotations instead)"
+            )
+        return 0
+
+    baseline = (
+        Baseline() if args.no_baseline else Baseline.load(args.baseline)
+    )
+    new = baseline.new_findings(findings)
+    # Staleness is only decidable for keys this run actually checked: an
+    # entry for an unlinted file or an un-run rule was not observed
+    # because it was not looked for, not because the code changed.
+    selected = {r.name for r in rules} if rules is not None else None
+    stale = (
+        []
+        if args.no_baseline
+        else [
+            k
+            for k in baseline.stale_keys(findings)
+            if k.split("::", 2)[0] in linted
+            and (selected is None or k.split("::", 2)[1] in selected)
+        ]
+    )
+
+    if args.fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "total": len(findings),
+                    "new": [f.__dict__ for f in new],
+                    "stale_baseline_keys": stale,
+                },
+                indent=1,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        grandfathered = len(findings) - len(new)
+        print(
+            f"jaxlint: {len(new)} new finding(s), "
+            f"{grandfathered} grandfathered (baseline: "
+            f"{os.path.relpath(args.baseline, args.root)})"
+        )
+        if stale:
+            print(
+                f"jaxlint: note — {len(stale)} baseline entr(ies) no longer "
+                "observed; tighten with --update-baseline:"
+            )
+            for k in stale:
+                print(f"    {k}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

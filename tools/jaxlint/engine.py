@@ -1,0 +1,440 @@
+"""jaxlint core: module analysis, suppression handling, baseline gate.
+
+The engine is rule-agnostic: it parses each file once into a
+:class:`ModuleInfo` (AST + parent links + comment map + jit registry) and
+hands it to every rule in the catalog. Findings are identified for
+baseline purposes by ``(file, rule, stripped-source-line)`` — NOT by line
+number — so unrelated edits that shift code don't invalidate the
+grandfather list.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+# Comment grammar:  # jaxlint: disable=rule-a,rule-b -- rationale text
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,-]+)\s*(?:--\s*(.*))?$"
+)
+
+# Findings about the lint annotations themselves — never eligible for the
+# baseline: grandfathering a rationale-less or stale suppression would
+# permanently disable the suppression-hygiene checks.
+META_RULES = frozenset(
+    ("suppression-missing-rationale", "unused-suppression", "parse-error")
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    file: str  # posix relpath from the lint root
+    line: int
+    col: int
+    rule: str
+    message: str
+    hint: str = ""
+    text: str = ""  # stripped source line: the baseline identity
+
+    @property
+    def key(self) -> str:
+        return f"{self.file}::{self.rule}::{self.text}"
+
+    def render(self) -> str:
+        out = f"{self.file}:{self.line}:{self.col}: {self.rule}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: Tuple[str, ...]  # ("all",) is a wildcard
+    rationale: str
+    used: bool = False
+
+    def covers(self, rule: str) -> bool:
+        return "all" in self.rules or rule in self.rules
+
+
+class ModuleInfo:
+    """One parsed file plus the cross-rule analysis every rule needs."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path  # posix relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.comments = self._collect_comments(source)
+        self.jax_random_aliases = self._collect_jax_random_aliases()
+        self.functions = [
+            n
+            for n in ast.walk(self.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        self.defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+        for fn in self.functions:
+            self.defs_by_name.setdefault(fn.name, []).append(fn)
+        self.jitted_defs = self._collect_jitted_defs()
+
+    # -- generic helpers ---------------------------------------------------
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> Optional[ast.FunctionDef]:
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return None
+
+    def enclosing_loop(self, node: ast.AST) -> Optional[ast.AST]:
+        """Nearest For/While ancestor WITHIN the same function scope."""
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
+                return a
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None
+        return None
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def dotted_name(self, node: ast.AST) -> str:
+        """'jax.random.normal' for nested Attributes, '' if not a plain
+        dotted chain."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return ""
+
+    # -- analysis passes ---------------------------------------------------
+    @staticmethod
+    def _collect_comments(source: str) -> Dict[int, str]:
+        comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass
+        return comments
+
+    def _collect_jax_random_aliases(self) -> set:
+        """Names that refer to the jax.random module in this file."""
+        aliases = {"jax.random"}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax.random" and a.asname:
+                        aliases.add(a.asname)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax" and node.level == 0:
+                    for a in node.names:
+                        if a.name == "random":
+                            aliases.add(a.asname or "random")
+        return aliases
+
+    def is_jit_call(self, node: ast.AST) -> bool:
+        """Call node that wraps a function in jax.jit/pjit (including
+        functools.partial(jax.jit, ...))."""
+        if not isinstance(node, ast.Call):
+            return False
+        name = self.dotted_name(node.func)
+        if name in ("jax.jit", "jit", "jax.pjit", "pjit"):
+            return True
+        if name in ("partial", "functools.partial") and node.args:
+            return self.dotted_name(node.args[0]) in (
+                "jax.jit",
+                "jit",
+                "jax.pjit",
+                "pjit",
+            )
+        return False
+
+    def _collect_jitted_defs(self) -> List[ast.FunctionDef]:
+        """Defs whose body runs under trace: decorated with jax.jit (or
+        partial(jax.jit, ...)), or passed by name to a jax.jit(...) call
+        anywhere in the module (the factory idiom: ``def step_fn(...): ...;
+        return jax.jit(step_fn, ...)``)."""
+        jitted: List[ast.FunctionDef] = []
+        jitted_names: set = set()
+        for node in ast.walk(self.tree):
+            if self.is_jit_call(node):
+                args = node.args
+                # partial(jax.jit, fn) puts the wrapped fn at args[1]
+                wrapped = None
+                if self.dotted_name(node.func) in ("partial", "functools.partial"):
+                    if len(args) > 1:
+                        wrapped = args[1]
+                elif args:
+                    wrapped = args[0]
+                if isinstance(wrapped, ast.Name):
+                    jitted_names.add(wrapped.id)
+        for fn in self.functions:
+            if fn.name in jitted_names:
+                jitted.append(fn)
+                continue
+            for dec in fn.decorator_list:
+                if self.is_jit_call(dec) or self.dotted_name(dec) in (
+                    "jax.jit",
+                    "jit",
+                    "jax.pjit",
+                    "pjit",
+                ):
+                    jitted.append(fn)
+                    break
+        return jitted
+
+    def in_jitted_body(self, node: ast.AST) -> Optional[ast.FunctionDef]:
+        """The jitted def whose body contains ``node`` (nested defs count:
+        a closure inside a jitted fn still traces)."""
+        for a in self.ancestors(node):
+            if a in self.jitted_defs:
+                return a
+        return None
+
+
+# --------------------------------------------------------------- suppressions
+def parse_suppressions(
+    info: ModuleInfo,
+) -> Tuple[Dict[int, Suppression], List[Finding]]:
+    """Line -> suppression. A suppression covers its own line; a comment
+    alone on its line also covers the next source line (comment-above
+    idiom). A missing ``-- rationale`` voids the suppression and is itself
+    a finding."""
+    by_line: Dict[int, Suppression] = {}
+    problems: List[Finding] = []
+    for lineno, comment in info.comments.items():
+        m = _SUPPRESS_RE.search(comment)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        rationale = (m.group(2) or "").strip()
+        if not rationale:
+            problems.append(
+                Finding(
+                    file=info.path,
+                    line=lineno,
+                    col=0,
+                    rule="suppression-missing-rationale",
+                    message=(
+                        "jaxlint suppression without a rationale is ignored"
+                    ),
+                    hint=(
+                        "write `# jaxlint: disable=<rule> -- <why this is "
+                        "safe here>`"
+                    ),
+                    text=info.line_text(lineno),
+                )
+            )
+            continue
+        sup = Suppression(line=lineno, rules=rules, rationale=rationale)
+        by_line[lineno] = sup
+        line_body = info.lines[lineno - 1][: info.lines[lineno - 1].find("#")]
+        if not line_body.strip():
+            # Standalone comment: covers the next CODE line — skipping any
+            # further comment lines (a wrapped rationale) and blank lines,
+            # so neither silently voids the suppression.
+            nxt = lineno + 1
+            while nxt <= len(info.lines) and (
+                not info.lines[nxt - 1].strip()
+                or info.lines[nxt - 1].lstrip().startswith("#")
+            ):
+                nxt += 1
+            by_line.setdefault(nxt, sup)
+    return by_line, problems
+
+
+# -------------------------------------------------------------------- baseline
+class Baseline:
+    """Grandfather list. Findings are counted per ``(file, rule, line-text)``
+    key; the gate fails only when an observed count exceeds the accepted
+    count for that key (i.e. a NEW violation, even of an old kind)."""
+
+    def __init__(self, counts: Optional[Dict[str, int]] = None):
+        self.counts: Dict[str, int] = dict(counts or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            data = json.load(f)
+        return cls(data.get("accepted", {}))
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        counts: Dict[str, int] = {}
+        for f in findings:
+            counts[f.key] = counts.get(f.key, 0) + 1
+        return cls(counts)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "comment": (
+                        "jaxlint grandfather list — regenerate with "
+                        "`python -m tools.jaxlint <paths> --update-baseline`. "
+                        "Keys are file::rule::source-line; the gate fails "
+                        "only on findings beyond these counts."
+                    ),
+                    "accepted": dict(sorted(self.counts.items())),
+                },
+                f,
+                indent=1,
+                sort_keys=False,
+            )
+            f.write("\n")
+
+    def new_findings(self, findings: Sequence[Finding]) -> List[Finding]:
+        """Findings beyond the accepted count for their key. When N are
+        accepted and N+k observed, the LAST k (by position) are reported."""
+        seen: Dict[str, int] = {}
+        out: List[Finding] = []
+        for f in sorted(findings, key=lambda f: (f.file, f.line, f.col)):
+            seen[f.key] = seen.get(f.key, 0) + 1
+            if seen[f.key] > self.counts.get(f.key, 0):
+                out.append(f)
+        return out
+
+    def stale_keys(self, findings: Sequence[Finding]) -> List[str]:
+        """Accepted keys no longer observed at their accepted count —
+        candidates for tightening the baseline."""
+        seen: Dict[str, int] = {}
+        for f in findings:
+            seen[f.key] = seen.get(f.key, 0) + 1
+        return sorted(
+            k for k, n in self.counts.items() if seen.get(k, 0) < n
+        )
+
+
+# ------------------------------------------------------------------- frontend
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Sequence] = None,
+) -> List[Finding]:
+    """Lint one source blob. ``path`` should be the posix relpath used in
+    baseline keys."""
+    from tools.jaxlint.rules import RULES
+
+    try:
+        info = ModuleInfo(path, source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                file=path,
+                line=e.lineno or 0,
+                col=e.offset or 0,
+                rule="parse-error",
+                message=f"file does not parse: {e.msg}",
+                text="",
+            )
+        ]
+    suppressions, problems = parse_suppressions(info)
+    findings: List[Finding] = list(problems)
+    for rule in rules if rules is not None else RULES:
+        for f in rule.check(info):
+            sup = suppressions.get(f.line)
+            if sup is not None and sup.covers(f.rule):
+                sup.used = True
+                continue
+            findings.append(f)
+    if rules is None:
+        # A suppression that no longer silences anything is stale noise —
+        # report it like a stale baseline key. Only meaningful with the
+        # full catalog: under --select, un-run rules would look "unused".
+        reported = set()
+        for sup in suppressions.values():
+            if id(sup) in reported or sup.used:
+                continue
+            reported.add(id(sup))
+            findings.append(
+                Finding(
+                    file=path,
+                    line=sup.line,
+                    col=0,
+                    rule="unused-suppression",
+                    message=(
+                        "suppression matches no finding "
+                        f"(rules: {', '.join(sup.rules)}) — the code it "
+                        "excused is gone or the rule name is wrong"
+                    ),
+                    hint="delete the stale `# jaxlint: disable` comment",
+                    text=info.line_text(sup.line),
+                )
+            )
+    findings.sort(key=lambda f: (f.file, f.line, f.col))
+    return findings
+
+
+def iter_python_files(paths: Sequence[str], root: str) -> Iterator[str]:
+    # Dedup across overlapping path args (`seist_tpu seist_tpu/serve`):
+    # linting a file twice would double its counts against the baseline.
+    seen: set = set()
+
+    def emit(path: str) -> Iterator[str]:
+        key = os.path.abspath(path)
+        if key not in seen:
+            seen.add(key)
+            yield path
+
+    for p in paths:
+        ap = os.path.join(root, p) if not os.path.isabs(p) else p
+        if not os.path.exists(ap):
+            # A typo'd/renamed path must fail the gate loudly — os.walk on
+            # a missing dir is silently empty, which would turn the lint
+            # gate into a no-op that exits 0 forever.
+            raise FileNotFoundError(f"lint path does not exist: {ap}")
+        if os.path.isfile(ap):
+            yield from emit(ap)
+        else:
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [
+                    d
+                    for d in sorted(dirnames)
+                    if d != "__pycache__" and not d.startswith(".")
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield from emit(os.path.join(dirpath, fn))
+
+
+def lint_paths(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    rules: Optional[Sequence] = None,
+) -> List[Finding]:
+    root = os.path.abspath(root or os.getcwd())
+    findings: List[Finding] = []
+    for fpath in iter_python_files(paths, root):
+        rel = os.path.relpath(os.path.abspath(fpath), root).replace(
+            os.sep, "/"
+        )
+        with open(fpath, encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(lint_source(source, rel, rules))
+    return findings

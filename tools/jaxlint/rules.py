@@ -1,0 +1,767 @@
+"""jaxlint rule catalog — JAX hot-path hazards, repo-tuned.
+
+Every rule is a pure function of one :class:`~tools.jaxlint.engine.ModuleInfo`.
+Static analysis cannot prove a value lives on device, so the catalog trades
+soundness for signal with two repo-tuned knobs:
+
+* ``HOT_PATH_GLOBS`` — modules on the step/serve/stream hot path, where ANY
+  host materialization (``.item()``, ``float()``/``int()``, ``np.asarray``)
+  is presumed guilty until suppressed with a rationale.
+* ``TRACED_NAME_RE`` — the factory idiom (``make_train_step`` returning a
+  local ``train_step`` that a *different* module jits) hides the jit wrap
+  from a single-file pass, so defs named like step functions are treated
+  as traced bodies too.
+
+False positives are expected to be rare and cheap: suppress inline with a
+rationale or accept into tools/jaxlint_baseline.json. See
+docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.jaxlint.engine import Finding, ModuleInfo
+
+# Modules where a host sync stalls the accelerator pipeline (train step
+# dispatch, serving forward, stream annotate).
+HOT_PATH_GLOBS = (
+    "seist_tpu/train/step.py",
+    "seist_tpu/ops/stream.py",
+    "seist_tpu/ops/postprocess.py",
+    "seist_tpu/serve/pool.py",
+)
+
+# Local defs with these names are traced even when the jax.jit call lives
+# in another module (factory idiom).
+TRACED_NAME_RE = re.compile(
+    r"(_step|_fn)$|^(train|eval|multi|device_aug|cached)_step$|^step_fn$"
+)
+
+# jax.random callees that CONSUME a key (single-use). Deriving functions
+# (split/fold_in/...) are exempt: they mint fresh keys.
+_KEY_DERIVING = {
+    "split",
+    "fold_in",
+    "PRNGKey",
+    "key",
+    "key_data",
+    "wrap_key_data",
+    "clone",
+}
+
+_STATE_PARAM_NAMES = {"state", "train_state", "opt_state"}
+_EVALISH_RE = re.compile(r"eval|infer|predict|forward|apply|val")
+
+_IMPURE_EXACT = {
+    "time.time",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.process_time",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.now",
+    "os.urandom",
+    "uuid.uuid4",
+}
+_IMPURE_PREFIXES = ("np.random.", "numpy.random.", "random.")
+
+
+class Rule:
+    """Base: subclasses set ``name``/``summary``/``hint`` and implement
+    ``check``."""
+
+    name: str = ""
+    summary: str = ""
+    hint: str = ""
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self,
+        info: ModuleInfo,
+        node: ast.AST,
+        message: str,
+        hint: Optional[str] = None,
+    ) -> Finding:
+        return Finding(
+            file=info.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            rule=self.name,
+            message=message,
+            hint=self.hint if hint is None else hint,
+            text=info.line_text(getattr(node, "lineno", 0)),
+        )
+
+
+def _is_hot(path: str) -> bool:
+    return any(fnmatch.fnmatch(path, g) for g in HOT_PATH_GLOBS)
+
+
+def _call_name(info: ModuleInfo, node: ast.Call) -> str:
+    return info.dotted_name(node.func)
+
+
+def _is_item_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("item", "tolist")
+        and not node.args
+    )
+
+
+class HostSyncHotPath(Rule):
+    name = "host-sync-hot-path"
+    summary = (
+        "host materialization (.item()/float()/int()/np.asarray) in a "
+        "hot-path module"
+    )
+    hint = (
+        "keep device values on device; if a host copy is required, batch it "
+        "into ONE jax.device_get outside the per-step/per-request path, or "
+        "suppress with a rationale"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        if not _is_hot(info.path):
+            return
+        traced = set(info.jitted_defs) | {
+            fn for fn in info.functions if TRACED_NAME_RE.search(fn.name)
+        }
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_item_call(node):
+                yield self.finding(
+                    info,
+                    node,
+                    f".{node.func.attr}() forces a device->host sync",
+                )
+                continue
+            # float()/int()/np.asarray are only presumed-guilty where they
+            # repeat (a loop: one sync per iteration) or where they cannot
+            # work at all (a traced body: concretization error / baked
+            # constant). One-shot coercions of host config stay legal.
+            repeated = info.enclosing_loop(node) is not None
+            in_traced = any(a in traced for a in info.ancestors(node))
+            if not repeated and not in_traced:
+                continue
+            where = "a traced body" if in_traced else "a loop"
+            name = _call_name(info, node)
+            if name in ("float", "int", "bool") and (
+                len(node.args) == 1
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                yield self.finding(
+                    info,
+                    node,
+                    f"{name}() in {where} on the hot path blocks on the "
+                    "accelerator",
+                )
+            elif name in ("np.asarray", "np.array", "numpy.asarray", "numpy.array"):
+                yield self.finding(
+                    info,
+                    node,
+                    f"{name}() in {where} on the hot path materializes its "
+                    "argument on host",
+                )
+
+
+class HostSyncItemLoop(Rule):
+    name = "host-sync-item-loop"
+    summary = ".item()/jax.device_get inside a loop — one sync per entry"
+    hint = (
+        "hoist to a single batched jax.device_get of the whole "
+        "container before the loop"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_item = _is_item_call(node) and node.func.attr == "item"
+            is_get = _call_name(info, node) == "jax.device_get"
+            if not (is_item or is_get):
+                continue
+            loop = info.enclosing_loop(node)
+            if loop is None:
+                continue
+            if is_get and not self._arg_uses_loop_var(info, node, loop):
+                # A batched device_get that merely SITS inside an outer
+                # (e.g. per-epoch) loop is the recommended pattern — only
+                # per-entry gets (argument indexed by the loop variable)
+                # are the hazard.
+                continue
+            what = ".item()" if is_item else "jax.device_get"
+            yield self.finding(
+                info,
+                node,
+                f"{what} inside a loop: one device->host round trip "
+                "per iteration",
+            )
+
+    @staticmethod
+    def _arg_uses_loop_var(
+        info: ModuleInfo, call: ast.Call, loop: ast.AST
+    ) -> bool:
+        targets: set = set()
+        cur: Optional[ast.AST] = loop
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor)):
+                targets |= {
+                    n.id
+                    for n in ast.walk(cur.target)
+                    if isinstance(n, ast.Name)
+                }
+            cur = next(
+                (
+                    a
+                    for a in info.ancestors(cur)
+                    if isinstance(
+                        a,
+                        (ast.For, ast.AsyncFor, ast.While, ast.FunctionDef),
+                    )
+                ),
+                None,
+            )
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        arg_names = {
+            n.id
+            for a in call.args
+            for n in ast.walk(a)
+            if isinstance(n, ast.Name)
+        }
+        return bool(targets & arg_names)
+
+
+class PrngKeyReuse(Rule):
+    name = "prng-key-reuse"
+    summary = "the same PRNG key consumed by more than one jax.random call"
+    hint = (
+        "keys are single-use: jax.random.split the key (or fold_in a "
+        "counter) so each draw gets a fresh key"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for fn in info.functions:
+            yield from self._check_scope(info, fn)
+
+    def _key_use(
+        self, info: ModuleInfo, node: ast.AST
+    ) -> Optional[Tuple[str, str]]:
+        """(key_var, callee) when node consumes a key held in a bare Name."""
+        if not isinstance(node, ast.Call):
+            return None
+        name = _call_name(info, node)
+        for alias in info.jax_random_aliases:
+            if name.startswith(alias + "."):
+                callee = name[len(alias) + 1 :]
+                if callee in _KEY_DERIVING or "." in callee:
+                    return None
+                if node.args and isinstance(node.args[0], ast.Name):
+                    return node.args[0].id, callee
+        return None
+
+    def _check_scope(
+        self, info: ModuleInfo, fn: ast.FunctionDef
+    ) -> Iterator[Finding]:
+        uses: List[Tuple[int, int, str, str, ast.AST]] = []
+        assigns: List[Tuple[int, int, str, ast.AST]] = []
+
+        def record_target(t: ast.AST, node: ast.AST) -> None:
+            # Record the Name node itself (not the statement): its ancestor
+            # chain includes the For/comprehension, so a loop's own target
+            # counts as assigned INSIDE that loop.
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    assigns.append(
+                        (sub.lineno, sub.col_offset, sub.id, sub)
+                    )
+
+        for node in ast.walk(fn):
+            if node is not fn and info.enclosing_function(node) is not fn:
+                continue  # nested function scopes get their own pass
+            use = self._key_use(info, node)
+            if use is not None:
+                uses.append(
+                    (node.lineno, node.col_offset, use[0], use[1], node)
+                )
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    record_target(t, node)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                record_target(node.target, node)
+            elif isinstance(node, ast.NamedExpr):
+                record_target(node.target, node)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                record_target(node.target, node)
+            elif isinstance(node, ast.comprehension):
+                record_target(node.target, node)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                record_target(node.optional_vars, node)
+
+        # Linear dual-use: a second consumption of the same name with no
+        # reassignment in between. At most one finding per use site (the
+        # loop check below skips already-flagged sites).
+        flagged: Dict[Tuple[int, int], Finding] = {}
+        events = sorted(
+            [(u[0], u[1], "use", u) for u in uses]
+            + [(a[0], a[1], "assign", a) for a in assigns],
+            key=lambda e: (e[0], e[1]),
+        )
+        consumed: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        for _, _, kind, payload in events:
+            if kind == "assign":
+                consumed.pop(payload[2], None)
+            else:
+                _, _, key_var, callee, node = payload
+                prior = consumed.setdefault(key_var, [])
+                live = [
+                    c
+                    for c, n in prior
+                    if not _exclusive_branches(info, n, node)
+                ]
+                if live:
+                    # Draws on mutually exclusive if/else branches are NOT
+                    # reuse — exactly one executes per call.
+                    flagged[(node.lineno, node.col_offset)] = self.finding(
+                        info,
+                        node,
+                        f"key `{key_var}` was already consumed by "
+                        f"jax.random.{live[0]}; reusing it makes "
+                        "correlated random draws",
+                    )
+                prior.append((callee, node))
+
+        # Cross-iteration reuse: a key consumed inside a loop with no
+        # refresh of that name anywhere inside the same loop body.
+        for lineno, col, key_var, callee, node in uses:
+            if (lineno, col) in flagged:
+                continue
+            loop = info.enclosing_loop(node)
+            if loop is None:
+                continue
+            refreshed = any(
+                a_name == key_var and loop in set(info.ancestors(a_node))
+                for _, _, a_name, a_node in assigns
+            )
+            if not refreshed:
+                flagged[(lineno, col)] = self.finding(
+                    info,
+                    node,
+                    f"key `{key_var}` consumed by jax.random.{callee} "
+                    "inside a loop without per-iteration split/fold_in: "
+                    "every iteration draws the same randomness",
+                )
+        yield from flagged.values()
+
+
+def _in_field(node: ast.AST, owner: ast.AST, field: str) -> bool:
+    """Is ``node`` within ``owner.<field>`` (a stmt list or single expr)?"""
+    val = getattr(owner, field, None)
+    parts = val if isinstance(val, list) else [val] if val is not None else []
+    for part in parts:
+        if part is node or any(d is node for d in ast.walk(part)):
+            return True
+    return False
+
+
+def _exclusive_branches(info: ModuleInfo, a: ast.AST, b: ast.AST) -> bool:
+    """True when a and b sit on opposite arms of a common if/else (or
+    ternary) — at most one of them executes per call."""
+    a_ancestors = set(info.ancestors(a))
+    for anc in info.ancestors(b):
+        if anc in a_ancestors and isinstance(anc, (ast.If, ast.IfExp)):
+            if (
+                _in_field(a, anc, "body")
+                and _in_field(b, anc, "orelse")
+            ) or (
+                _in_field(a, anc, "orelse")
+                and _in_field(b, anc, "body")
+            ):
+                return True
+    return False
+
+
+def _jit_wrapped(info: ModuleInfo, call: ast.Call) -> Optional[ast.AST]:
+    if info.dotted_name(call.func) in ("partial", "functools.partial"):
+        return call.args[1] if len(call.args) > 1 else None
+    return call.args[0] if call.args else None
+
+
+def _resolve_def(
+    info: ModuleInfo, node: Optional[ast.AST]
+) -> Optional[ast.FunctionDef]:
+    if isinstance(node, ast.Name):
+        defs = info.defs_by_name.get(node.id)
+        if defs:
+            return defs[0]
+    return None
+
+
+def _has_kwarg(call: ast.Call, *names: str) -> bool:
+    return any(kw.arg in names for kw in call.keywords)
+
+
+def _first_param(fn: ast.FunctionDef) -> Optional[str]:
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    return params[0] if params else None
+
+
+def _carries_state(fn: ast.FunctionDef) -> bool:
+    return (
+        _first_param(fn) in _STATE_PARAM_NAMES
+        and not _EVALISH_RE.search(fn.name)
+    )
+
+
+class JitNoDonate(Rule):
+    name = "jit-no-donate"
+    summary = (
+        "jax.jit of a state-carrying step function without donate_argnums"
+    )
+    hint = (
+        "donate the state argument (donate_argnums=(0,)) so XLA reuses its "
+        "buffers — without it every step holds two copies of params + "
+        "optimizer state in HBM"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if info.is_jit_call(node) and not any(
+                node is dec
+                for fn in info.functions
+                for dec in fn.decorator_list
+            ):
+                fn = _resolve_def(info, _jit_wrapped(info, node))
+                if (
+                    fn is not None
+                    and _carries_state(fn)
+                    and not _has_kwarg(
+                        node, "donate_argnums", "donate_argnames"
+                    )
+                ):
+                    yield self.finding(
+                        info,
+                        node,
+                        f"jax.jit({fn.name}) updates `{_first_param(fn)}` "
+                        "but does not donate it",
+                    )
+        for fn in info.functions:
+            if not _carries_state(fn):
+                continue
+            for dec in fn.decorator_list:
+                bare = info.dotted_name(dec) in ("jax.jit", "jit")
+                call_no_donate = (
+                    isinstance(dec, ast.Call)
+                    and info.is_jit_call(dec)
+                    and not _has_kwarg(dec, "donate_argnums", "donate_argnames")
+                )
+                if bare or call_no_donate:
+                    yield self.finding(
+                        info,
+                        dec,
+                        f"@jax.jit on `{fn.name}` updates "
+                        f"`{_first_param(fn)}` but does not donate it",
+                    )
+
+
+class ImpureCallInJit(Rule):
+    name = "impure-call-in-jit"
+    summary = (
+        "wall-clock / host-RNG call inside a traced function body"
+    )
+    hint = (
+        "the call runs ONCE at trace time and its result is baked into the "
+        "compiled program as a constant — move it outside the jitted "
+        "function or pass the value in as an argument"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        traced = set(info.jitted_defs) | {
+            fn for fn in info.functions if TRACED_NAME_RE.search(fn.name)
+        }
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(info, node)
+            if not name:
+                continue
+            impure = name in _IMPURE_EXACT or any(
+                name.startswith(p) for p in _IMPURE_PREFIXES
+            )
+            if not impure:
+                continue
+            owner = None
+            for a in info.ancestors(node):
+                if a in traced:
+                    owner = a
+                    break
+            if owner is not None:
+                yield self.finding(
+                    info,
+                    node,
+                    f"{name}() inside traced function `{owner.name}` is "
+                    "evaluated once at trace time, not per step",
+                )
+
+
+class JitInLoop(Rule):
+    name = "jit-in-loop"
+    summary = "fresh jax.jit wrap inside a loop — recompiles every iteration"
+    hint = (
+        "hoist the jax.jit call out of the loop (or cache the jitted "
+        "callable) so the XLA program compiles once"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if (
+                isinstance(node, ast.Call)
+                and info.is_jit_call(node)
+                and info.enclosing_loop(node) is not None
+            ):
+                yield self.finding(
+                    info,
+                    node,
+                    "jax.jit(...) constructed inside a loop: each iteration "
+                    "builds a fresh cache entry and recompiles",
+                )
+
+
+_NONHASHABLE_DEFAULTS = (ast.List, ast.Dict, ast.Set)
+
+
+class NonHashableStatic(Rule):
+    name = "nonhashable-static"
+    summary = "static jit argument whose default is a list/dict/set"
+    hint = (
+        "static args are hashed to key the compile cache; pass a tuple / "
+        "frozen structure instead (an unhashable static arg raises, and a "
+        "mutable one silently retraces on every new object)"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Call) and info.is_jit_call(node)):
+                continue
+            static_nums: List[int] = []
+            static_names: List[str] = []
+            for kw in node.keywords:
+                if kw.arg == "static_argnums":
+                    static_nums = _const_ints(kw.value)
+                elif kw.arg == "static_argnames":
+                    static_names = _const_strs(kw.value)
+            if not static_nums and not static_names:
+                continue
+            fn = _resolve_def(info, _jit_wrapped(info, node))
+            if fn is None:
+                # decorator form: the def this call decorates
+                for f in info.functions:
+                    if node in f.decorator_list:
+                        fn = f
+                        break
+            if fn is None:
+                continue
+            for pname, default in _param_defaults(fn, static_nums, static_names):
+                if isinstance(default, _NONHASHABLE_DEFAULTS):
+                    yield self.finding(
+                        info,
+                        node,
+                        f"static arg `{pname}` of `{fn.name}` defaults to a "
+                        f"{type(default).__name__.lower()} — not hashable",
+                    )
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def _param_defaults(
+    fn: ast.FunctionDef, nums: List[int], names: List[str]
+) -> Iterator[Tuple[str, ast.AST]]:
+    args = fn.args.posonlyargs + fn.args.args
+    defaults = fn.args.defaults
+    offset = len(args) - len(defaults)
+    by_name = {
+        a.arg: defaults[i - offset]
+        for i, a in enumerate(args)
+        if i >= offset
+    }
+    for kwarg, kwdef in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if kwdef is not None:
+            by_name[kwarg.arg] = kwdef
+    wanted = set(names) | {
+        args[i].arg for i in nums if 0 <= i < len(args)
+    }
+    for pname in wanted:
+        if pname in by_name:
+            yield pname, by_name[pname]
+
+
+class WallClockInterval(Rule):
+    name = "wallclock-interval"
+    summary = "time.time() used for interval arithmetic"
+    hint = (
+        "wall clock jumps (NTP slew, suspend); use time.monotonic() for "
+        "durations and keep time.time() only for reported timestamps"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        # Per scope, EVERY assignment to a name is recorded with its
+        # position and whether the value is time.time(): taint at a
+        # subtraction follows the LAST assignment before it, so
+        # `t0 = time.time()` (timestamp) followed by `t0 = time.monotonic()`
+        # doesn't poison later monotonic interval math.
+        scopes: Dict[
+            Optional[ast.AST], Dict[str, List[Tuple[int, int, bool]]]
+        ] = {}
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            scope = info.enclosing_function(node)
+            is_wall = self._is_time_call(info, node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    scopes.setdefault(scope, {}).setdefault(t.id, []).append(
+                        (node.lineno, node.col_offset, is_wall)
+                    )
+        for per_name in scopes.values():
+            for entries in per_name.values():
+                entries.sort()
+
+        def tainted(scope, name: str, pos: Tuple[int, int]) -> bool:
+            for s in (scope, None):
+                entries = scopes.get(s, {}).get(name)
+                if entries:
+                    before = [e for e in entries if (e[0], e[1]) < pos]
+                    if before:
+                        return before[-1][2]
+            return False
+
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
+                continue
+            scope = info.enclosing_function(node)
+            pos = (node.lineno, node.col_offset)
+            for side in (node.left, node.right):
+                if self._is_time_call(info, side) or (
+                    isinstance(side, ast.Name)
+                    and tainted(scope, side.id, pos)
+                ):
+                    yield self.finding(
+                        info,
+                        node,
+                        "interval computed from time.time(): save/heartbeat "
+                        "math breaks when the wall clock steps",
+                    )
+                    break
+
+    @staticmethod
+    def _is_time_call(info: ModuleInfo, node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and info.dotted_name(node.func) == "time.time"
+        )
+
+
+_BROAD = {"Exception", "BaseException"}
+
+
+class BroadExcept(Rule):
+    name = "broad-except"
+    summary = "broad `except Exception` without a rationale"
+    hint = (
+        "narrow the exception type, re-raise, or add a comment on/above "
+        "the except line saying why swallowing everything is safe here"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(info, node.type):
+                continue
+            if self._has_rationale(info, node) or self._reraises(node):
+                continue
+            label = (
+                "bare `except:`"
+                if node.type is None
+                else f"`except {info.dotted_name(node.type) or 'Exception'}`"
+            )
+            yield self.finding(
+                info,
+                node,
+                f"{label} swallows every failure (including bugs) with no "
+                "stated rationale",
+            )
+
+    @staticmethod
+    def _is_broad(info: ModuleInfo, type_node: Optional[ast.AST]) -> bool:
+        if type_node is None:
+            return True
+        if isinstance(type_node, ast.Tuple):
+            return any(
+                info.dotted_name(e).split(".")[-1] in _BROAD
+                for e in type_node.elts
+            )
+        return info.dotted_name(type_node).split(".")[-1] in _BROAD
+
+    @staticmethod
+    def _has_rationale(info: ModuleInfo, node: ast.ExceptHandler) -> bool:
+        candidates = {node.lineno, node.lineno - 1}
+        if node.body:
+            candidates.add(node.body[0].lineno)
+            candidates.add(node.body[0].lineno - 1)
+        return any(line in info.comments for line in candidates)
+
+    @staticmethod
+    def _reraises(node: ast.ExceptHandler) -> bool:
+        return any(
+            isinstance(n, ast.Raise) and n.exc is None
+            for n in ast.walk(node)
+        )
+
+
+RULES: Tuple[Rule, ...] = (
+    HostSyncHotPath(),
+    HostSyncItemLoop(),
+    PrngKeyReuse(),
+    JitNoDonate(),
+    ImpureCallInJit(),
+    JitInLoop(),
+    NonHashableStatic(),
+    WallClockInterval(),
+    BroadExcept(),
+)
+
+RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULES}

@@ -1,0 +1,181 @@
+"""jaxlint runtime audit lane: compile budgets and tracer-leak checks.
+
+Static rules catch hazards that are visible in source; this module catches
+the ones that only materialize at run time:
+
+* :class:`CompileBudget` — counts every jit trace/compile while active,
+  attributed to the jitted function's name and argument-shape signature
+  (via the ``jax_log_compiles`` log stream; ``jax.monitoring`` backend
+  compile events are tallied as a cross-check). The train-step invariant
+  "compiles once per shape bucket" becomes an assertion instead of a
+  mysterious slowdown.
+* :func:`tracer_leak_check` — scoped ``jax.check_tracer_leaks`` for the
+  smoke lane (`pytest -m smoke --tracer-leaks`).
+
+Counting traces (not just backend compiles) is deliberate: with the
+persistent XLA compile cache enabled (tests/conftest.py), a retrace can hit
+the disk cache and skip the expensive backend compile while still burning
+seconds of lowering per step — the budget must catch that too.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax
+
+# "Compiling <fn> with global shapes and types [...]. Argument mapping: ..."
+# (jax._src.interpreters.pxla, emitted at WARNING when jax_log_compiles is
+# on — one record per trace+lower, including persistent-cache hits).
+_COMPILING_RE = re.compile(
+    r"Compiling ([^\s]+) with global shapes and types (.*?)\.\s*Argument"
+)
+
+_active_budgets: List["CompileBudget"] = []
+_monitoring_installed = False
+
+
+def _install_monitoring() -> None:
+    """One process-wide listener (jax.monitoring has no unregister API);
+    it fans out to whichever budgets are currently active."""
+    global _monitoring_installed
+    if _monitoring_installed:
+        return
+    _monitoring_installed = True
+    import jax.monitoring
+
+    def _on_duration(name: str, duration: float, **kw) -> None:
+        if name == "/jax/core/compile/backend_compile_duration":
+            for budget in _active_budgets:
+                budget.backend_compiles += 1
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+class _CompileLogHandler(logging.Handler):
+    def __init__(self, budget: "CompileBudget"):
+        super().__init__(level=logging.DEBUG)
+        self._budget = budget
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            m = _COMPILING_RE.search(record.getMessage())
+        except Exception:  # defensive: never let logging break the run
+            return
+        if m:
+            self._budget._record(m.group(1), m.group(2))
+
+
+class CompileBudget:
+    """Track (function name, shape signature) of every jit compile.
+
+    >>> with CompileBudget() as budget:
+    ...     for _ in range(5):
+    ...         state, loss = train_step(state, batch)   # jitted
+    >>> budget.assert_compiles_once("train_step")
+
+    ``assert_compiles_once`` fails when any shape signature of a matching
+    function compiled more than once (a retrace on identical shapes —
+    e.g. a non-hashable static arg rebuilt per call, or a fresh jax.jit
+    wrap per step) or when it never compiled at all (the budget saw
+    nothing — miswired test). ``max_signatures`` bounds how many shape
+    buckets are allowed (padding/bucketing regressions).
+    """
+
+    def __init__(self) -> None:
+        # (name, signature) -> count
+        self.compiles: Dict[Tuple[str, str], int] = {}
+        self.backend_compiles = 0
+        self._handler: Optional[_CompileLogHandler] = None
+        self._saved_log_compiles: Optional[bool] = None
+
+    # -- recording ---------------------------------------------------------
+    def _record(self, name: str, signature: str) -> None:
+        key = (name, signature)
+        self.compiles[key] = self.compiles.get(key, 0) + 1
+
+    def __enter__(self) -> "CompileBudget":
+        _install_monitoring()
+        self._saved_log_compiles = bool(jax.config.jax_log_compiles)
+        jax.config.update("jax_log_compiles", True)
+        self._handler = _CompileLogHandler(self)
+        logging.getLogger("jax._src.interpreters.pxla").addHandler(
+            self._handler
+        )
+        _active_budgets.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _active_budgets.remove(self)
+        logging.getLogger("jax._src.interpreters.pxla").removeHandler(
+            self._handler
+        )
+        jax.config.update("jax_log_compiles", self._saved_log_compiles)
+
+    # -- queries -----------------------------------------------------------
+    def names(self) -> List[str]:
+        return sorted({name for name, _ in self.compiles})
+
+    def total(self, name_substr: str = "") -> int:
+        return sum(
+            n
+            for (name, _), n in self.compiles.items()
+            if name_substr in name
+        )
+
+    def signatures(self, name_substr: str = "") -> List[str]:
+        return sorted(
+            {sig for (name, sig) in self.compiles if name_substr in name}
+        )
+
+    def retraces(self, name_substr: str = "") -> List[Tuple[str, str, int]]:
+        """(name, signature, count) entries that compiled more than once —
+        i.e. retraces on IDENTICAL shapes."""
+        return sorted(
+            (name, sig, n)
+            for (name, sig), n in self.compiles.items()
+            if name_substr in name and n > 1
+        )
+
+    # -- assertions --------------------------------------------------------
+    def assert_compiles_once(
+        self, name_substr: str, max_signatures: Optional[int] = None
+    ) -> None:
+        if self.total(name_substr) == 0:
+            raise AssertionError(
+                f"compile budget saw no compiles matching {name_substr!r} "
+                f"(observed: {self.names()}) — is the budget active around "
+                "the first call?"
+            )
+        retraced = self.retraces(name_substr)
+        if retraced:
+            detail = "; ".join(
+                f"{name} x{n} for shapes {sig}" for name, sig, n in retraced
+            )
+            raise AssertionError(
+                f"retrace on identical shapes: {detail}. The step function "
+                "must compile once per shape bucket — look for non-hashable "
+                "statics, fresh jax.jit wraps per call, or weak_type churn."
+            )
+        if max_signatures is not None:
+            sigs = self.signatures(name_substr)
+            if len(sigs) > max_signatures:
+                raise AssertionError(
+                    f"{name_substr!r} compiled {len(sigs)} shape buckets "
+                    f"(budget {max_signatures}): {sigs}"
+                )
+
+
+@contextmanager
+def tracer_leak_check(enabled: bool = True) -> Iterator[None]:
+    """Scoped ``jax.check_tracer_leaks``: raises if a traced value escapes
+    its trace (closure capture of a tracer, storing tracers on self, ...).
+    No-op when ``enabled`` is false so callers can wire it to a CLI flag."""
+    if not enabled:
+        yield
+        return
+    with jax.checking_leaks():
+        yield
