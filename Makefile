@@ -4,7 +4,7 @@ NATIVE_DIR := seist_tpu/native
 CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
-.PHONY: native test t1 lint lint-baseline serve-smoke clean
+.PHONY: native test t1 lint lint-baseline serve-smoke chaos clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -36,6 +36,13 @@ t1:
 	rc=$${PIPESTATUS[0]}; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
+
+# Fault-injection suite (docs/FAULT_TOLERANCE.md): the faults unit lane
+# plus the chaos e2e lane — real training runs under injected NaN/kill/
+# SIGTERM/flaky-read/corrupt-sample/loader-stall faults.
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'chaos or faults' \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Checkpoint-free serving smoke: warm-compile, micro-batch 24 requests,
 # print a BENCH-style latency/throughput/fill-ratio JSON line.

@@ -737,6 +737,129 @@ def _measure_input_split(spec, loss_fn, cfg: dict, steps: int) -> dict:
     }
 
 
+def measure_data_plane(spec, cfg: dict, batches: int) -> dict:
+    """Clean-path overhead of the data-plane I/O guard (data/io_guard.py):
+    the same per-sample pipeline is timed with the guard active (retry
+    wrapper + ingest validation + quarantine bookkeeping) and bypassed
+    (``io_guard.disabled()``), on an already-warm synthetic dataset so
+    both passes price the *pipeline*, not wavelet synthesis. Reported as
+    per-batch loader-stage medians + ``overhead_frac`` — the BENCH
+    evidence that self-healing reads cost a negligible slice of loader
+    stage time on the fault-free path. Counters ride along so a bench run
+    that DID hit faults (retries/quarantines > 0) is self-describing."""
+    from seist_tpu.utils.logger import logger as _logger
+
+    _logger.enable_console(False)
+    try:
+        return _measure_data_plane(spec, cfg, batches)
+    finally:
+        _logger.enable_console(True)
+
+
+def _measure_data_plane(spec, cfg: dict, passes: int) -> dict:
+    from seist_tpu.data import io_guard
+    from seist_tpu.data import pipeline as pl
+
+    in_samples = cfg["in_samples"]
+    batch = min(cfg["batch"], 32)  # stage cost is per-sample; keep it cheap
+
+    def make_sds(cache: bool):
+        return pl.from_task_spec(
+            spec,
+            "synthetic",
+            "train",
+            seed=0,
+            in_samples=in_samples,
+            augmentation=True,
+            data_split=False,
+            shuffle=True,
+            dataset_kwargs={
+                "num_events": 2 * batch,
+                "trace_samples": in_samples + in_samples // 2,
+                "cache": cache,
+            },
+        )
+
+    # Stage denominator: UNCACHED events — a cached synthetic "read" is a
+    # ~10 us memcpy, two orders cheaper than any real dataset's decode
+    # (data/base.py profile: ~1 ms/sample read stage), which would
+    # overstate the guard's share of stage time ~100x; wavelet synthesis
+    # is the same order as a real read and stands in for it.
+    sds = make_sds(cache=False)
+    n = len(sds)
+    for i in range(n):  # one warm pass (page cache, numpy internals)
+        sds[i]
+
+    def full_pass_s() -> float:
+        t0 = time.perf_counter()
+        for i in range(n):
+            sds[i]
+        return time.perf_counter() - t0
+
+    # min-of-passes, order alternated per round: a full pipeline pass is
+    # hundreds of us/sample with >10% run-to-run scheduler noise, and
+    # back-to-back passes over the same objects warm CPU caches for
+    # whichever runs second — min() over alternated rounds strips both
+    # biases and compares the two paths at their respective best.
+    on_s, off_s = [], []
+    for r in range(max(passes, 2)):
+        if r % 2 == 0:
+            on_s.append(full_pass_s())
+            with io_guard.disabled():
+                off_s.append(full_pass_s())
+        else:
+            with io_guard.disabled():
+                off_s.append(full_pass_s())
+            on_s.append(full_pass_s())
+    stage_us = min(off_s) / n * 1e6
+
+    # The guard delta itself, measured directly (read+validate vs bare
+    # read, no preprocessing in the loop) on a CACHED clone — cheap
+    # (~10 us) raw reads make the subtraction precise, where an uncached
+    # read's synthesis noise would drown a microsecond-scale delta. This
+    # is the number the <2%-of-stage claim rests on.
+    micro = make_sds(cache=True)
+    for i in range(micro.raw_size):
+        micro._fetch_event(i, idx=i)  # warm: event cache + guard internals
+
+    def micro_pass_us(guarded: bool) -> float:
+        t0 = time.perf_counter()
+        if guarded:
+            for i in range(micro.raw_size):
+                micro._fetch_event(i, idx=i)
+        else:
+            for i in range(micro.raw_size):
+                micro._dataset[i]
+        return (time.perf_counter() - t0) / micro.raw_size * 1e6
+
+    # min over alternating rounds, same reasoning as the stage passes: a
+    # single pass over a small dataset is one scheduler hiccup away from
+    # a 5x-overstated delta.
+    g_us, r_us = [], []
+    for r in range(8):
+        if r % 2 == 0:
+            g_us.append(micro_pass_us(True))
+            r_us.append(micro_pass_us(False))
+        else:
+            r_us.append(micro_pass_us(False))
+            g_us.append(micro_pass_us(True))
+    guard_us = max(min(g_us) - min(r_us), 0.0)
+
+    return {
+        "passes": max(passes, 2),
+        "samples_per_pass": n,
+        "stage_us_per_sample": round(stage_us, 1),
+        "guard_us_per_sample": round(guard_us, 2),
+        "overhead_frac_of_stage": round(guard_us / max(stage_us, 1e-9), 4),
+        # Whole-pipeline cross-check (min-of-passes); negative = below
+        # measurement noise. The claim is "small", not "exactly zero".
+        "pass_overhead_frac": round(
+            (min(on_s) - min(off_s)) / max(min(off_s), 1e-9), 4
+        ),
+        "counters": io_guard.COUNTERS.snapshot(),
+    }
+
+
 def bench_train(device_kind: str) -> None:
     import jax
 
@@ -847,11 +970,24 @@ def bench_train(device_kind: str) -> None:
         except Exception as e:  # noqa: BLE001 - split is diagnostics only
             _eprint(f"input-split measurement failed: {e!r}")
 
+    # Data-plane guard overhead (BENCH_DATA_PLANE_BATCHES=0 disables):
+    # guarded vs bypassed loader stage time, measured in THIS run.
+    data_plane = None
+    dp_batches = int(os.environ.get("BENCH_DATA_PLANE_BATCHES", 6))
+    if dp_batches > 0:
+        t_dp = time.time()
+        try:
+            data_plane = measure_data_plane(spec, cfg, dp_batches)
+            _eprint(f"data-plane overhead measured in {time.time() - t_dp:.1f}s")
+        except Exception as e:  # noqa: BLE001 - diagnostics only
+            _eprint(f"data-plane measurement failed: {e!r}")
+
     payload = {
         "metric": metric,
         "value": round(wfs, 2),
         "unit": unit,
         "input_pipeline": split,
+        "data_plane": data_plane,
         "input_bound_fraction": (
             (split or {}).get("host_path", {}).get("input_bound_fraction")
         ),

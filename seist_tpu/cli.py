@@ -200,6 +200,26 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         help="consecutive guard-skipped updates before "
                         "rolling back to the last checkpoint. 0 disables "
                         "rollback (skips only). Default 3")
+    parser.add_argument("--max-quarantine-frac", default=0.05, type=float,
+                        dest="max_quarantine_frac",
+                        help="abort the run once more than this fraction "
+                        "of the dataset has been quarantined by the "
+                        "data-plane guard (corrupt samples are benched "
+                        "and deterministically replaced; past this "
+                        "threshold the dataset is considered rotted and "
+                        "training on fallbacks would be silent garbage). "
+                        "Default 0.05")
+    parser.add_argument("--data-watchdog-sec", default=600.0, type=float,
+                        dest="data_watchdog_sec",
+                        help="pipeline stall watchdog: if the train loop "
+                        "waits longer than this for the next host batch "
+                        "(loader wedged or a worker thread dead), dump "
+                        "all thread stacks and exit with the clean-"
+                        "preempt code (75) so tools/supervise.py "
+                        "relaunches from the newest checkpoint. Only "
+                        "time spent BLOCKED on the data plane counts — "
+                        "step compute/compiles/validation do not. "
+                        "0 disables. Default 600")
     parser.add_argument("--use-lr-scheduler", default=True, type=bool_)
     parser.add_argument("--lr-scheduler-mode", default="exp_range", type=str,
                         help="'triangular', 'triangular2' or 'exp_range'")

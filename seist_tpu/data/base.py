@@ -74,6 +74,29 @@ def open_h5(path: str, group: Optional[str] = None):
     return g
 
 
+def evict_h5(path: str) -> bool:
+    """Close and drop the calling thread's cached handle (and group cache)
+    for ``path``. Readers call this when a read through the cached handle
+    fails: an h5py ``File`` object stays truthy even when its backing fd
+    has gone stale (NFS timeout, file replaced under us), so without
+    eviction :func:`open_h5` would keep serving the dead handle forever
+    and every retry would fail identically. After eviction the next
+    ``open_h5`` reopens from scratch. Returns whether a handle was
+    actually dropped."""
+    cache = _h5_local.handles
+    entry = cache.pop(path, None)
+    if entry is None:
+        return False
+    try:
+        entry[0].close()
+    except Exception:  # noqa: BLE001 - handle already broken; dropping it is the point
+        pass
+    from seist_tpu.data.io_guard import COUNTERS
+
+    COUNTERS.inc("reopens")
+    return True
+
+
 class DatasetBase:
     _name: str = ""
     _part_range: Optional[tuple] = None

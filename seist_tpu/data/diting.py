@@ -19,7 +19,8 @@ from typing import Tuple
 import numpy as np
 import pandas as pd
 
-from seist_tpu.data.base import DatasetBase, Event, open_h5
+from seist_tpu.data.base import DatasetBase, Event, evict_h5, open_h5
+from seist_tpu.data.io_guard import CorruptSampleError
 from seist_tpu.registry import register_dataset
 
 _META_DTYPES = {
@@ -105,28 +106,55 @@ class DiTing(DatasetBase):
         key = normalize_key(str(row["key"]))
         path = os.path.join(self._data_dir, f"DiTing330km_part_{row['part']}.hdf5")
 
-        grp = open_h5(path, group="earthquake")
-        data = np.array(grp.get(key)).astype(np.float32).T
+        # Fault classification (data/io_guard.py): an OSError anywhere in
+        # the open/lookup/decode is transient — evict the cached handle so
+        # the pipeline-level retry reopens instead of re-hitting a stale
+        # fd; a missing trace key or a broken file layout is permanent
+        # (CorruptSampleError -> quarantine).
+        try:
+            grp = open_h5(path, group="earthquake")
+            node = grp.get(key)
+            if node is None:
+                raise CorruptSampleError(
+                    f"diting part {row['part']}: trace key {key!r} missing"
+                )
+            data = np.array(node).astype(np.float32).T
+        except OSError:
+            evict_h5(path)
+            raise
+        except KeyError as e:  # no 'earthquake' group: structurally broken
+            raise CorruptSampleError(
+                f"diting part {row['part']}: bad file layout ({e})"
+            ) from e
 
-        motion = row["p_motion"]
-        if pd.notnull(motion) and str(motion).lower() not in ("", "n"):
-            motion = {"u": 0, "c": 0, "r": 1, "d": 1}[str(motion).lower()]
-        clarity = row["p_clarity"]
-        if pd.notnull(clarity):
-            clarity = 0 if str(clarity).lower() == "i" else 1
-        baz = row["baz"]
-        if pd.notnull(baz):
-            baz = float(baz) % 360
+        # Metadata decode is part of the sample read: an undecodable row
+        # (unknown polarity letter, garbage magnitude string, unknown
+        # mag_type) is per-sample corruption to quarantine, not a bug to
+        # crash (or preempt-relaunch-loop) the run on.
+        try:
+            motion = row["p_motion"]
+            if pd.notnull(motion) and str(motion).lower() not in ("", "n"):
+                motion = {"u": 0, "c": 0, "r": 1, "d": 1}[str(motion).lower()]
+            clarity = row["p_clarity"]
+            if pd.notnull(clarity):
+                clarity = 0 if str(clarity).lower() == "i" else 1
+            baz = row["baz"]
+            if pd.notnull(baz):
+                baz = float(baz) % 360
 
-        evmag, stmag = row["evmag"], row["st_mag"]
-        if pd.notnull(evmag):
-            evmag = np.clip(
-                convert_to_ml(float(evmag), row["mag_type"]), 0, 8
-            ).astype(np.float32)
-        if pd.notnull(stmag):
-            stmag = np.clip(
-                convert_to_ml(float(stmag), row["mag_type"]), 0, 8
-            ).astype(np.float32)
+            evmag, stmag = row["evmag"], row["st_mag"]
+            if pd.notnull(evmag):
+                evmag = np.clip(
+                    convert_to_ml(float(evmag), row["mag_type"]), 0, 8
+                ).astype(np.float32)
+            if pd.notnull(stmag):
+                stmag = np.clip(
+                    convert_to_ml(float(stmag), row["mag_type"]), 0, 8
+                ).astype(np.float32)
+        except (KeyError, ValueError, TypeError) as e:
+            raise CorruptSampleError(
+                f"diting: undecodable metadata for trace {key!r} ({e})"
+            ) from e
 
         snr = np.array(
             [row["Z_P_power_snr"], row["N_S_power_snr"], row["E_S_power_snr"]]

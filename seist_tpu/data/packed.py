@@ -39,6 +39,7 @@ import numpy as np
 import pandas as pd
 
 from seist_tpu.data.base import DatasetBase, Event
+from seist_tpu.data.io_guard import COUNTERS, CorruptSampleError
 from seist_tpu.registry import register_dataset
 from seist_tpu.utils.logger import logger
 
@@ -209,7 +210,23 @@ class PackedDataset(DatasetBase):
         row = self._row_dict(idx)
         c, length = int(row["n_ch"]), int(row["n_samp"])
         off = int(row["offset"])
-        raw = self._mmap(int(row["shard"]))[off : off + c * length * 4]
+        shard = int(row["shard"])
+        nbytes = c * length * 4
+        # OSError on the mmap (shard vanished / page-in failure on a
+        # network mount) is transient: drop the cached map so the retry
+        # re-mmaps a fresh fd. A slice that comes back short means the
+        # shard file is truncated — permanent corruption of this sample.
+        try:
+            raw = self._mmap(shard)[off : off + nbytes]
+        except OSError:
+            if self._mmaps.pop(shard, None) is not None:
+                COUNTERS.inc("reopens")  # same telemetry as evict_h5
+            raise
+        if raw.size != nbytes:
+            raise CorruptSampleError(
+                f"packed: short read in shard {shard} (sample {idx}: want "
+                f"{nbytes} bytes at {off}, got {raw.size} — truncated shard?)"
+            )
         data = np.frombuffer(raw, dtype=np.float32).reshape(c, length).copy()
 
         def scalar(field):

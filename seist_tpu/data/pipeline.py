@@ -33,8 +33,10 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from seist_tpu import taskspec
+from seist_tpu.data import io_guard
 from seist_tpu.data.preprocess import DataPreprocessor, pad_phases
 from seist_tpu.registry import DATASETS
+from seist_tpu.utils import faults as faults_lib
 from seist_tpu.utils.logger import logger
 
 Batch = collections.namedtuple(
@@ -63,6 +65,7 @@ class SeismicDataset:
         train_size: float = 0.8,
         val_size: float = 0.1,
         max_event_num: int = 1,
+        max_quarantine_frac: float = 0.05,
         dataset_kwargs: Optional[dict] = None,
         **preprocessor_kwargs,
     ) -> None:
@@ -92,6 +95,17 @@ class SeismicDataset:
         )
         logger.info(repr(self._dataset))
         self._dataset_size = len(self._dataset)
+        # Data-plane self-healing (data/io_guard.py): per-dataset
+        # quarantine registry + the env-driven chaos injector, both
+        # captured at construction so tests can set SEIST_FAULT_IO_* /
+        # --max-quarantine-frac deterministically.
+        self._quarantine = io_guard.Quarantine(
+            self._dataset_size, max_frac=float(max_quarantine_frac)
+        )
+        self._io_faults = faults_lib.IoFaultInjector.from_env()
+        # Immutable after construction: lets the clean read path skip the
+        # injector entirely (guard fast path in _fetch_event).
+        self._io_faults_enabled = self._io_faults.enabled
         if self._augmentation:
             logger.warning(
                 f"Data augmentation: Dataset size -> {self._dataset_size * 2}"
@@ -128,10 +142,72 @@ class SeismicDataset:
     def label_names(self) -> list:
         return list(self._label_names)
 
+    @property
+    def quarantine(self) -> io_guard.Quarantine:
+        return self._quarantine
+
+    @property
+    def io_faults(self) -> faults_lib.IoFaultInjector:
+        return self._io_faults
+
+    def quarantine_report(self) -> Dict[str, Any]:
+        """Epoch-end quarantine report (logged by train/worker.py)."""
+        return self._quarantine.report()
+
     def raw_event(self, idx: int):
         """One UNprocessed event + meta — the device-aug upload path reads
         raw traces here and runs augmentation/labels on device."""
         return self._dataset[idx % self._dataset_size]
+
+    def _fetch_event(self, raw_idx: int, *, idx: int) -> Tuple[Event, dict]:
+        """Guarded sample read (data/io_guard.py): transient faults are
+        retried (with injected flakiness riding the same loop); a sample
+        that is permanently corrupt — failed ingest validation or an
+        exhausted retry budget — is quarantined and deterministically
+        replaced by the first cleanly-reading candidate of the
+        ``(seed, epoch, idx)``-keyed fallback sequence, so batch shapes
+        and the global sample order stay fixed and resume-stable.
+
+        Fast path (no quarantined samples, no injected faults): one
+        direct read + ingest validation — a try frame, a counter bump and
+        one ``np.isfinite`` pass per sample (benched ~1% of loader stage
+        time; the BENCH ``data_plane`` section re-measures it every run).
+        Any failure falls through to the full retry/quarantine ladder."""
+        if not (self._quarantine.active or self._io_faults_enabled):
+            try:
+                event, meta = self._dataset[raw_idx]
+                io_guard.validate_event(event)
+                io_guard.COUNTERS.inc("reads")
+                return event, meta
+            except (OSError, io_guard.CorruptSampleError):
+                pass  # enter the retrying/quarantining ladder below
+        return self._fetch_event_slow(raw_idx, idx=idx)
+
+    def _fetch_event_slow(
+        self, raw_idx: int, *, idx: int
+    ) -> Tuple[Event, dict]:
+        for cand in self._quarantine.candidates(
+            raw_idx, seed=self._seed, epoch=self._epoch, idx=idx
+        ):
+            try:
+                event, meta = io_guard.guarded_event_read(
+                    lambda c=cand: self._dataset[c],
+                    key=cand,
+                    desc=f"{self._dataset.name()}[{cand}]",
+                    injector=self._io_faults,
+                )
+            except io_guard.CorruptSampleError as e:
+                # Covers RetriesExhaustedError too; add() raises
+                # QuarantineOverflowError past --max-quarantine-frac.
+                self._quarantine.add(cand, repr(e))
+                continue
+            if cand != raw_idx:
+                io_guard.COUNTERS.inc("fallback_reads")
+            return event, meta
+        raise io_guard.CorruptSampleError(
+            f"no clean fallback found for sample {raw_idx} "
+            f"(quarantined: {len(self._quarantine)}/{self._dataset_size})"
+        )
 
     def sampling_rate(self) -> int:
         return self._dataset.sampling_rate()
@@ -152,7 +228,11 @@ class SeismicDataset:
         return 2 * self._dataset_size if self._augmentation else self._dataset_size
 
     def __getitem__(self, idx: int) -> Tuple[Any, Any, Dict[str, np.ndarray], str]:
-        event, meta_data = self._dataset[idx % self._dataset_size]
+        raw_idx = idx % self._dataset_size
+        if io_guard.enabled():
+            event, meta_data = self._fetch_event(raw_idx, idx=int(idx))
+        else:
+            event, meta_data = self._dataset[raw_idx]
         rng = np.random.default_rng(
             np.random.SeedSequence([self._seed, self._epoch, int(idx)])
         )
@@ -278,6 +358,27 @@ class Loader:
         self._start_batch = 0
         self._pool: Optional[ThreadPoolExecutor] = None
         self._proc_pool = None
+        if self.worker_processes and io_guard.enabled():
+            # Each process-pool worker holds its own pickled dataset copy:
+            # quarantine state and io_guard counters accumulate PER WORKER
+            # (replacement content stays deterministic — the fallback rule
+            # depends only on the data), so the parent's epoch report and
+            # counter logs understate faults and --max-quarantine-frac is
+            # enforced per worker rather than globally. Thread workers
+            # (the default) share one registry and report exactly.
+            logger.warning(
+                "worker_processes > 0: data-plane quarantine/counters are "
+                "tracked per worker process; parent-side epoch reports "
+                "undercount and the --max-quarantine-frac abort applies "
+                "per worker (docs/FAULT_TOLERANCE.md)"
+            )
+        # One injector per pipeline: reuse the dataset's (so a
+        # programmatic fault plan reaches the stall hook too); fall back
+        # to env parsing only for bare-dataset callers.
+        self._io_faults = (
+            getattr(dataset, "io_faults", None)
+            or faults_lib.IoFaultInjector.from_env()
+        )
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = int(epoch)
@@ -330,7 +431,32 @@ class Loader:
         return (n + self.batch_size - 1) // self.batch_size
 
     def _fetch(self, chunk: np.ndarray) -> List[Any]:
-        """Fetch one batch's samples via the configured worker pool."""
+        """Fetch one batch's samples via the configured worker pool.
+
+        Sample-level faults never reach here (the guarded read in
+        SeismicDataset retries transients and quarantines corruption);
+        anything a worker still raises is a loader-thread death — a bug
+        or an environment failure the retry ladder cannot absorb — and is
+        wrapped as LoaderDeathError so the train worker can checkpoint
+        and preempt-exit instead of crashing opaquely. The deliberate
+        aborts (QuarantineOverflowError, CorruptSampleError's
+        no-clean-fallback) pass through untouched: those must kill the
+        run loudly, not trigger a relaunch loop.
+        """
+        try:
+            return self._fetch_inner(chunk)
+        except (io_guard.QuarantineOverflowError, io_guard.CorruptSampleError):
+            raise
+        # Not swallowed — re-raised as the typed loader-death signal the
+        # train worker turns into a checkpoint + clean-preempt exit.
+        except Exception as e:
+            io_guard.COUNTERS.inc("loader_deaths")
+            raise io_guard.LoaderDeathError(
+                f"loader worker died fetching batch chunk "
+                f"[{int(chunk[0])}..{int(chunk[-1])}]: {e!r}"
+            ) from e
+
+    def _fetch_inner(self, chunk: np.ndarray) -> List[Any]:
         if self.worker_processes:
             if self._proc_pool is None:
                 import multiprocessing
@@ -390,6 +516,10 @@ class Loader:
         nb = len(self)
         start, self._start_batch = self._start_batch, 0  # one-shot
         for b in range(start, nb):
+            # Chaos hook: SEIST_FAULT_IO_STALL_BATCH wedges the loader
+            # here — the stall-watchdog e2e's stand-in for a deadlocked
+            # worker pool or a hung filesystem.
+            self._io_faults.maybe_stall(b)
             chunk = indices[b * self.batch_size : (b + 1) * self.batch_size]
             pad = self.batch_size - len(chunk)
             if pad:
@@ -491,6 +621,29 @@ def prefetch_to_device(
     yield from _double_buffer(iterator, put, prefetch)
 
 
+def _guarded_raw_event(sds: SeismicDataset, i: int) -> dict:
+    """RawStore ingest read: transient faults retried like the host path;
+    a permanently-corrupt sample raises ValueError — the device store
+    holds EVERY sample resident for the whole run, so it refuses rather
+    than bake a fallback in; the worker catches the ValueError and falls
+    back to the host path, whose per-read quarantine handles it."""
+    if not io_guard.enabled():
+        return sds.raw_event(i)[0]
+    try:
+        event, _ = io_guard.guarded_event_read(
+            lambda: sds.raw_event(i),
+            key=i,
+            desc=f"{sds.name()}.raw[{i}]",
+            injector=sds.io_faults,
+        )
+        return event
+    except io_guard.CorruptSampleError as e:
+        raise ValueError(
+            f"sample {i} is permanently corrupt ({e}); --device-aug "
+            "falls back to the host path, which quarantines it"
+        ) from e
+
+
 class RawStore:
     """Host-side fixed-shape raw arrays for the device-aug paths
     (``--device-aug step|cached``): every raw trace decoded ONCE, the
@@ -537,8 +690,11 @@ class RawStore:
     def estimate_bytes(cls, sds: SeismicDataset) -> int:
         """Resident-cache size estimate WITHOUT decoding the dataset:
         one sample's raw waveform bytes x dataset size (phase/value
-        sidecars are noise next to the waveforms)."""
-        event, _ = sds.raw_event(0)
+        sidecars are noise next to the waveforms). The probe read goes
+        through the guarded path — a transient fault at setup time must
+        not crash device-aug selection when the same fault one call
+        later (inside build) would be retried."""
+        event = _guarded_raw_event(sds, 0)
         return int(
             np.asarray(event["data"]).astype(np.float32, copy=False).nbytes
             * sds.raw_size
@@ -571,7 +727,7 @@ class RawStore:
         raw_len = None
         max_phases = 1
         for i in range(n):
-            event, _ = sds.raw_event(i)
+            event = _guarded_raw_event(sds, i)
             length = int(np.asarray(event["data"]).shape[-1])
             if raw_len is None:
                 raw_len = length

@@ -20,7 +20,9 @@ from typing import Tuple
 import numpy as np
 import pandas as pd
 
-from seist_tpu.data.base import DatasetBase, Event, open_h5
+from seist_tpu.data import io_guard
+from seist_tpu.data.base import DatasetBase, Event, evict_h5, open_h5
+from seist_tpu.data.io_guard import CorruptSampleError
 from seist_tpu.registry import register_dataset
 
 
@@ -61,18 +63,65 @@ class PNW(DatasetBase):
         bucket, n = parse_trace_name(row["trace_name"])
 
         path = os.path.join(self._data_dir, "comcat_waveforms.hdf5")
-        f = open_h5(path)
-        data = np.nan_to_num(np.array(f.get(f"data/{bucket}")[n], dtype=np.float32))
+        # Same classification as the DiTing reader: OSError = transient
+        # (evict so the retry reopens); missing bucket / out-of-range row
+        # = permanent corruption of this sample's reference.
+        try:
+            f = open_h5(path)
+            node = f.get(f"data/{bucket}")
+            if node is None:
+                raise CorruptSampleError(
+                    f"pnw: bucket dataset 'data/{bucket}' missing"
+                )
+            raw = np.array(node[n], dtype=np.float32)
+            # Reference parity (ref pnw.py:110): sparse NaNs are zeroed,
+            # NOT quarantined — this masking predates the io_guard and is
+            # how the reference trains on PNW. A trace that is MOSTLY
+            # non-finite is rotted, though, and zero-filling it would
+            # manufacture a silent all-zeros sample; classify that as
+            # permanent corruption before the repair. Gated on the guard:
+            # SEIST_IO_GUARD=0 restores the raw reference behavior
+            # (zero-fill and train) instead of introducing a new crash.
+            finite = np.isfinite(raw)
+            if (
+                io_guard.enabled()
+                and not finite.all()
+                and finite.mean() < 0.5
+            ):
+                raise CorruptSampleError(
+                    f"pnw: trace {row['trace_name']!r} is "
+                    f"{100 * (1 - finite.mean()):.0f}% non-finite"
+                )
+            data = np.nan_to_num(raw)
+        except OSError:
+            evict_h5(path)
+            raise
+        except (IndexError, ValueError) as e:  # row n outside the bucket
+            raise CorruptSampleError(
+                f"pnw: bad trace ref {row['trace_name']!r} ({e})"
+            ) from e
 
-        motion = {"positive": 0, "negative": 1, "undecidable": 2, "": 3}[
-            str(row["trace_P_polarity"]).lower()
-        ]
         mag_type = str(row["preferred_source_magnitude_type"]).lower()
         if mag_type != "ml":
+            # Deliberately NOT sample-corruption: a non-ml magnitude type
+            # means the wrong catalog was pointed at — fail the run.
             raise AssertionError(f"PNW magnitudes must be ml, got '{mag_type}'")
-        evmag = np.clip(row["preferred_source_magnitude"], 0, 8).astype(np.float32)
-        snrs = [s.strip() for s in str(row["trace_snr_db"]).split("|")]
-        snr = np.array([float(s) if s != "nan" else 0.0 for s in snrs])
+        # Undecodable per-row metadata (a polarity word outside the map, a
+        # garbage snr cell) is sample corruption to quarantine, not a bug
+        # to crash/preempt-loop on.
+        try:
+            motion = {"positive": 0, "negative": 1, "undecidable": 2, "": 3}[
+                str(row["trace_P_polarity"]).lower()
+            ]
+            evmag = np.clip(row["preferred_source_magnitude"], 0, 8).astype(
+                np.float32
+            )
+            snrs = [s.strip() for s in str(row["trace_snr_db"]).split("|")]
+            snr = np.array([float(s) if s != "nan" else 0.0 for s in snrs])
+        except (KeyError, ValueError, TypeError) as e:
+            raise CorruptSampleError(
+                f"pnw: undecodable metadata for {row['trace_name']!r} ({e})"
+            ) from e
 
         ppk = row["trace_P_arrival_sample"]
         spk = row["trace_S_arrival_sample"]

@@ -16,6 +16,7 @@ import numpy as np
 import pandas as pd
 
 from seist_tpu.data.base import DatasetBase, Event
+from seist_tpu.data.io_guard import CorruptSampleError
 from seist_tpu.registry import register_dataset
 from seist_tpu.utils.logger import logger
 from seist_tpu.utils.misc import cal_snr
@@ -41,9 +42,28 @@ class SOS(DatasetBase):
     def _load_event_data(self, idx: int) -> Tuple[Event, dict]:
         row = self._meta_data.iloc[idx]
         fpath = os.path.join(self._data_dir, self._mode, row["fname"])
-        npz = np.load(fpath)
-        data = np.stack(npz["data"].astype(np.float32), axis=1)
-        ppk, spk = int(row["itp"]), int(row["its"])
+        # OSError (incl. FileNotFoundError on a flaky mount) propagates as
+        # a transient fault and is retried by the pipeline guard (no
+        # cached handle to evict here — np.load opens fresh each time);
+        # a file that unzips/decodes wrong is permanent corruption
+        # (data/io_guard.py classification).
+        import zipfile
+
+        try:
+            npz = np.load(fpath)
+            data = np.stack(npz["data"].astype(np.float32), axis=1)
+        except (zipfile.BadZipFile, KeyError, ValueError) as e:
+            raise CorruptSampleError(
+                f"sos: undecodable trace file {row['fname']!r} ({e})"
+            ) from e
+        # Unparseable pick columns are per-sample corruption (quarantine),
+        # same classification as an undecodable waveform.
+        try:
+            ppk, spk = int(row["itp"]), int(row["its"])
+        except (ValueError, TypeError) as e:
+            raise CorruptSampleError(
+                f"sos: undecodable picks for {row['fname']!r} ({e})"
+            ) from e
         event: Event = {
             "data": data,
             "ppks": [ppk] if ppk > 0 else [],

@@ -12,5 +12,11 @@ from seist_tpu.ops.postprocess import (  # noqa: F401
     process_outputs,
     PAD_VALUE,
 )
-from seist_tpu.ops.metrics import Metrics, batch_counters, finalize, merge  # noqa: F401
+from seist_tpu.ops.metrics import (  # noqa: F401
+    Metrics,
+    batch_counters,
+    data_plane_counters,
+    finalize,
+    merge,
+)
 from seist_tpu.ops.results import ResultSaver  # noqa: F401

@@ -383,3 +383,14 @@ class Metrics:
                         out[f"{k}.{i}"] = vi
         out.update(self._all())
         return out
+
+
+def data_plane_counters() -> Dict[str, int]:
+    """Snapshot of the data-plane guard counters (reads, retries,
+    handle reopens, quarantined samples, fallback reads, stall trips,
+    loader deaths) — the ops-facing view of
+    ``seist_tpu.data.io_guard.COUNTERS``. Train-worker epoch logs and
+    the BENCH ``data_plane`` section (bench.py) read the same source."""
+    from seist_tpu.data.io_guard import COUNTERS
+
+    return COUNTERS.snapshot()

@@ -14,6 +14,7 @@ program so e.g. the baz (cos,sin) encoding costs nothing extra.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Callable, Optional, Tuple
 
@@ -30,6 +31,52 @@ from seist_tpu.train.precision import (
     resolve_dtype,
 )
 from seist_tpu.train.state import TrainState
+
+
+_donation_gate_logged = False
+
+
+def resolve_donation(donate: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Donation/compile-cache correctness gate (ROADMAP open item).
+
+    On jax 0.4.37's CPU backend, an executable DESERIALIZED from the
+    persistent XLA compile cache intermittently (~20-40% of processes)
+    corrupts donated outputs in unsynchronized donated step chains —
+    after a few back-to-back train steps ``state.step`` reads back
+    another buffer's bits and repeated reads of the same Array differ
+    (use-after-reuse of an aliased input). Freshly compiled executables
+    are always correct, as are chains synchronized per step. Donation is
+    a memory optimization, never a semantic one, so when BOTH hazard
+    ingredients are present — the disk cache enabled AND the CPU backend
+    — the donation request is dropped: the cache keeps its multi-minute
+    compile savings and the step chain keeps its correctness
+    (tests/test_donation_cache.py runs the repro chain under exactly this
+    config).
+
+    Env overrides: ``SEIST_DONATE_WITH_CACHE=1`` restores donation (for
+    a jaxlib where the aliasing serialization is fixed), ``=0`` gates it
+    on every backend (if the hazard is ever seen off-CPU).
+    """
+    if not donate:
+        return donate
+    force = os.environ.get("SEIST_DONATE_WITH_CACHE", "")
+    if force == "1":
+        return donate
+    cache_on = bool(jax.config.jax_compilation_cache_dir)
+    if cache_on and (force == "0" or jax.default_backend() == "cpu"):
+        global _donation_gate_logged
+        if not _donation_gate_logged:
+            _donation_gate_logged = True
+            from seist_tpu.utils.logger import logger
+
+            logger.warning(
+                "persistent compile cache active on the CPU backend: "
+                "dropping step-state donation (deserialized executables "
+                "can corrupt donated outputs — ROADMAP; "
+                "SEIST_DONATE_WITH_CACHE=1 overrides)"
+            )
+        return ()
+    return donate
 
 
 def _apply_transforms(spec: TaskSpec, outputs, targets):
@@ -268,7 +315,7 @@ def jit_device_aug_step(step_fn: Callable, mesh: Optional[Mesh]) -> Callable:
     pinned replicated — without the pin GSPMD is free to hand back
     data-sharded state leaves, which then clash with the replicated
     in_shardings of the next consumer (the eval step)."""
-    donate = (0,)
+    donate = resolve_donation((0,))
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=donate)
     repl = NamedSharding(mesh, P())
@@ -338,7 +385,7 @@ def jit_cached_call(call_fn: Callable, mesh: Optional[Mesh], cache) -> Callable:
     pipeline.DeviceEpochCache's upload placement); the (k, B) index array
     shards its batch axis; state/epoch/rng replicate. ``cache`` is only
     consulted for its pytree structure."""
-    donate = (0,)
+    donate = resolve_donation((0,))
     if mesh is None:
         return jax.jit(call_fn, donate_argnums=donate)
     import jax.tree_util as jtu
@@ -491,7 +538,7 @@ def jit_step(
     (rng, ...) are replicated. Without a mesh this is a plain jit (single
     device).
     """
-    donate = (0,) if donate_state else ()
+    donate = resolve_donation((0,)) if donate_state else ()
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=donate)
     repl = NamedSharding(mesh, P())
@@ -510,7 +557,7 @@ def jit_multi_step(
     would wrongly shard the micro-step axis (see make_multi_train_step's
     sharding caveat).
     """
-    donate = (0,) if donate_state else ()
+    donate = resolve_donation((0,)) if donate_state else ()
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=donate)
     repl = NamedSharding(mesh, P())

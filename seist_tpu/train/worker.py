@@ -27,7 +27,7 @@ import jax
 import numpy as np
 
 from seist_tpu import taskspec
-from seist_tpu.data import pipeline
+from seist_tpu.data import io_guard, pipeline
 from seist_tpu.models import api
 from seist_tpu.ops import Metrics, ResultSaver, process_outputs
 from seist_tpu.parallel import mesh as mesh_lib
@@ -205,6 +205,14 @@ def _build_loader(args: Any, spec: taskspec.TaskSpec, mode: str) -> pipeline.Loa
         soft_label_shape=args.label_shape,
         label_width=args.label_width,
         dataset_kwargs=getattr(args, "dataset_kwargs", None),
+        # Forwarded only when set: SeismicDataset owns the single default,
+        # and an explicit 0 means zero tolerance (abort on the first
+        # quarantined sample) so no `or`-coercion.
+        **(
+            {"max_quarantine_frac": float(args.max_quarantine_frac)}
+            if getattr(args, "max_quarantine_frac", None) is not None
+            else {}
+        ),
     )
     return pipeline.Loader(
         sds,
@@ -289,9 +297,12 @@ def validate(
     *,
     testing: bool = False,
     save_results: bool = False,
+    watchdog: Optional[io_guard.StallWatchdog] = None,
 ) -> Tuple[float, Dict[str, Metrics]]:
     """Eval loop (ref validate.py:10-134): loss + per-task metrics; at test
-    time optionally accumulate the results CSV."""
+    time optionally accumulate the results CSV. ``watchdog`` (the train
+    worker's data-plane stall watchdog) is armed while blocked on val
+    batches — a wedged val loader preempts instead of hanging the run."""
     tasks = list(spec.eval)
     fs = val_loader.dataset.sampling_rate()
     metrics_merged = _make_metrics(args, tasks, fs)
@@ -301,7 +312,9 @@ def validate(
     )
 
     for step, batch in enumerate(
-        pipeline.prefetch_to_device(iter(val_loader), mesh)
+        io_guard.watch(
+            pipeline.prefetch_to_device(iter(val_loader), mesh), watchdog
+        )
     ):
         loss, outputs = eval_step(
             state, batch.inputs, batch.loss_targets, batch.mask
@@ -545,11 +558,18 @@ def train_worker(args: Any) -> str:
         # the budget comparison is PER-DEVICE bytes vs per-device HBM —
         # comparing the raw total would downgrade a 40 GiB dataset on an
         # 8-chip mesh (5 GiB/chip) that actually fits.
-        est = (
-            pipeline.RawStore.estimate_bytes(sds_train) // max(data_axis, 1)
-            if not reasons
-            else 0
-        )
+        est = 0
+        if not reasons:
+            try:
+                est = pipeline.RawStore.estimate_bytes(
+                    sds_train
+                ) // max(data_axis, 1)
+            except ValueError as e:
+                # The size probe reads raw sample 0 through the guarded
+                # path; a permanently-corrupt sample refuses the device
+                # store — same fallback as a build-time refusal: host
+                # path, whose quarantine machinery handles it.
+                reasons = [str(e)]
         device_mode, why = da.select_device_aug_mode(
             device_req, est, budget, reasons, jax.process_count() > 1
         )
@@ -729,6 +749,18 @@ def train_worker(args: Any) -> str:
     if faults.enabled:
         logger.warning(f"Fault injection ACTIVE: {faults.plan}")
 
+    # Data-plane stall watchdog (--data-watchdog-sec; data/io_guard.py):
+    # armed only while the loop is blocked waiting for a host batch
+    # (io_guard.watch), so step compute, jit compiles, validation and
+    # checkpoint saves never count toward the budget. A trip dumps every
+    # thread's stack and hard-exits with the clean-preempt code —
+    # tools/supervise.py relaunches from the newest checkpoint instead of
+    # the run hanging forever.
+    wd_timeout = float(getattr(args, "data_watchdog_sec", 0.0) or 0.0)
+    watchdog = (
+        io_guard.StallWatchdog(wd_timeout).start() if wd_timeout > 0 else None
+    )
+
     def _step_out(ret):
         """Normalize (state, loss, outputs[, diag]) across guard on/off."""
         if len(ret) == 4:
@@ -777,10 +809,19 @@ def train_worker(args: Any) -> str:
         monitor.reset()
         return restore_into_state(state, restored)
 
-    def _preempt_exit(state, epoch, batches_done, gstep):
+    def _preempt_exit(state, epoch, batches_done, gstep, hard=False):
         """Step-boundary preemption: make the final checkpoint durable
         (wait=True barriers the async write), then exit with the
-        documented preempt code for tools/supervise.py."""
+        documented preempt code for tools/supervise.py.
+
+        ``hard=True`` (the loader-death path) ends in ``os._exit``: the
+        data plane is known-wedged and its pool threads are non-daemon,
+        so ``sys.exit`` would hang forever in ``threading._shutdown``
+        joining a thread stuck inside a dead read — the exact hang this
+        machinery exists to eliminate. The watchdog is left armed as the
+        escalation if even the final save wedges."""
+        if watchdog is not None and not hard:
+            watchdog.stop()
         d_epoch, d_off = _interval_save(
             state, epoch, batches_done, gstep, wait=True
         )
@@ -793,7 +834,30 @@ def train_worker(args: Any) -> str:
         train_loader.close()
         val_loader.close()
         ckpt_mgr.close()
+        if hard:
+            io_guard.hard_exit(PREEMPT_EXIT_CODE)
         sys.exit(PREEMPT_EXIT_CODE)
+
+    def _loader_death_exit(e, state, epoch, batches_done):
+        """Loader-thread death (data/io_guard.py LoaderDeathError): the
+        device and params are healthy — checkpoint the current position
+        and preempt-exit so the supervisor relaunches with a fresh data
+        plane rather than the run dying opaquely (or, pre-watchdog,
+        hanging forever)."""
+        logger.error(
+            f"Loader worker death: {e}; dumping thread stacks and "
+            "preempt-exiting for supervised relaunch"
+        )
+        io_guard.dump_thread_stacks()
+        if watchdog is not None:
+            # Escalation: the data plane is wedged; if the final save
+            # below hangs too, the watchdog's os._exit still gets us out.
+            watchdog.arm()
+        _preempt_exit(
+            state, epoch, batches_done,
+            epoch * steps_per_epoch + batches_done,
+            hard=True,
+        )
 
     best_loss = float("inf")
     best_ckpt_path = ""
@@ -891,6 +955,14 @@ def train_worker(args: Any) -> str:
         # losses are kept as device scalars and fetched once per epoch.
         deferred_losses: List[Any] = []
         global_bs = args.batch_size * jax.process_count()
+        # Loader-death handling (io_guard.watch on_death): checkpoint at
+        # the last completed batch and preempt-exit. `batches_done` is
+        # kept current by every loop body; the closure reads the latest
+        # `state` at fire time.
+        batches_done = skip
+
+        def _on_loader_death(e: io_guard.LoaderDeathError) -> None:
+            _loader_death_exit(e, state, epoch, batches_done)
 
         if device_mode == "cached":
             # HBM-resident path: one jitted call = kpack scanned updates;
@@ -970,21 +1042,25 @@ def train_worker(args: Any) -> str:
             import jax.numpy as jnp
 
             for step, (rows, idx, aug) in enumerate(
-                pipeline.prefetch_raw_to_device(
-                    pipeline.iter_raw_batches(
-                        dev_store,
-                        epoch,
-                        seed=args.seed,
-                        shuffle=args.shuffle,
-                        batch_size=args.batch_size,
-                        num_shards=jax.process_count(),
-                        shard_index=jax.process_index(),
-                        start_batch=skip,
+                io_guard.watch(
+                    pipeline.prefetch_raw_to_device(
+                        pipeline.iter_raw_batches(
+                            dev_store,
+                            epoch,
+                            seed=args.seed,
+                            shuffle=args.shuffle,
+                            batch_size=args.batch_size,
+                            num_shards=jax.process_count(),
+                            shard_index=jax.process_index(),
+                            start_batch=skip,
+                        ),
+                        mesh,
                     ),
-                    mesh,
+                    watchdog,
                 ),
                 start=skip,
             ):
+                batches_done = step + 1
                 gstep = epoch * steps_per_epoch + step
                 faults.on_step(gstep)
                 state, loss, _, diag = _step_out(
@@ -1023,8 +1099,12 @@ def train_worker(args: Any) -> str:
             # accumulated update (--grad-accum-steps). The per-call loss is
             # already the mean over its micro-batches.
             for call, (xk, yk) in enumerate(
-                pipeline.prefetch_packed_to_device(
-                    iter(train_loader), mesh, kpack
+                io_guard.watch(
+                    pipeline.prefetch_packed_to_device(
+                        iter(train_loader), mesh, kpack
+                    ),
+                    watchdog,
+                    on_death=_on_loader_death,
                 ),
                 start=skip // kpack,
             ):
@@ -1077,9 +1157,14 @@ def train_worker(args: Any) -> str:
 
         else:
             for step, batch in enumerate(
-                pipeline.prefetch_to_device(iter(train_loader), mesh),
+                io_guard.watch(
+                    pipeline.prefetch_to_device(iter(train_loader), mesh),
+                    watchdog,
+                    on_death=_on_loader_death,
+                ),
                 start=skip,
             ):
+                batches_done = step + 1
                 gstep = epoch * steps_per_epoch + step
                 faults.on_step(gstep)
                 inputs = faults.corrupt_inputs(gstep, batch.inputs)
@@ -1152,10 +1237,29 @@ def train_worker(args: Any) -> str:
         for m in metrics_merged.values():
             m.synchronize_between_processes()
 
+        # -- data-plane epoch report (docs/FAULT_TOLERANCE.md) ----------------
+        # Quarantined samples and guard counters, logged every epoch so a
+        # slowly-rotting dataset is visible long before the
+        # --max-quarantine-frac abort trips.
+        q_report = train_loader.dataset.quarantine_report()
+        if q_report["quarantined"]:
+            logger.warning(
+                f"[data-plane] epoch {epoch} quarantine report: "
+                f"{json.dumps(q_report)}"
+            )
+        if io_guard.COUNTERS.any_faults():
+            logger.info(
+                f"[data-plane] counters: {io_guard.COUNTERS.snapshot()}"
+            )
+
         # -- validate + checkpoint (ref train.py:402-415) ---------------------
-        val_loss, val_metrics = validate(
-            args, state, eval_step, spec, val_loader, mesh
-        )
+        try:
+            val_loss, val_metrics = validate(
+                args, state, eval_step, spec, val_loader, mesh,
+                watchdog=watchdog,
+            )
+        except io_guard.LoaderDeathError as e:
+            _loader_death_exit(e, state, epoch, steps_per_epoch)
         val_losses.append(val_loss)
         if writer is not None:
             writer.add_scalar("train-loss/epoch", epoch_train_loss, epoch)
@@ -1213,6 +1317,12 @@ def train_worker(args: Any) -> str:
         )
 
     preempt.__exit__()
+    if watchdog is not None:
+        watchdog.stop()
+    if io_guard.COUNTERS.any_faults():
+        logger.info(
+            f"[data-plane] run counters: {io_guard.COUNTERS.snapshot()}"
+        )
     if monitor.total_skipped:
         logger.warning(
             f"Bad-update guard skipped {monitor.total_skipped} non-finite "
@@ -1267,16 +1377,29 @@ def test_worker(args: Any) -> float:
         ),
         mesh,
     )
-    loss, metrics_merged = validate(
-        args,
-        state,
-        eval_step,
-        spec,
-        test_loader,
-        mesh,
-        testing=True,
-        save_results=args.save_test_results,
+    # Same stall protection as training (--data-watchdog-sec): a wedged
+    # test loader exits with the preempt code instead of hanging. A
+    # loader death here simply propagates — there is no training state
+    # to checkpoint, and a loud crash beats a silent hang.
+    wd_timeout = float(getattr(args, "data_watchdog_sec", 0.0) or 0.0)
+    watchdog = (
+        io_guard.StallWatchdog(wd_timeout).start() if wd_timeout > 0 else None
     )
+    try:
+        loss, metrics_merged = validate(
+            args,
+            state,
+            eval_step,
+            spec,
+            test_loader,
+            mesh,
+            testing=True,
+            save_results=args.save_test_results,
+            watchdog=watchdog,
+        )
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
     if is_main_process():
         # Structured metrics artifact beside the log/CSV (the reference only
         # logs a formatted string, test.py:83-88); consumed by

@@ -29,6 +29,27 @@ step numbering, so "kill at step k" and "resume loses at most
                               exactly what a test wants (supervise retry
                               budget) and sometimes not (resume e2e).
 
+I/O-plane knobs (consumed by the data plane via
+``seist_tpu/data/io_guard.py``; sample indices are RAW dataset indices —
+the post-split index space the Loader shuffles over)::
+
+    SEIST_FAULT_IO_FLAKY_P      probability in [0, 1] that a sample read
+                                raises a transient OSError; deterministic
+                                per sample (hash of the index), so a flaky
+                                sample is flaky on EVERY epoch/attempt
+                                window — and always succeeds once the
+                                retry budget outlasts IO_FLAKY_FAILS
+    SEIST_FAULT_IO_FLAKY_FAILS  consecutive attempts that fail for a
+                                flaky-selected read (default 1; keep it
+                                below the retry budget for the
+                                transient-faults-are-invisible contract)
+    SEIST_FAULT_IO_CORRUPT      comma list of raw sample indices whose
+                                decoded waveform turns non-finite
+                                (permanent corruption -> quarantine)
+    SEIST_FAULT_IO_STALL_BATCH  the Loader sleeps before producing this
+                                batch index (stall-watchdog e2e)
+    SEIST_FAULT_IO_STALL_SEC    stall duration in seconds (default 3600)
+
 The injector is deliberately dependency-free above numpy/jax tree utils:
 it must be importable (and inert) in every entry point that might train.
 """
@@ -95,6 +116,102 @@ class FaultPlan:
             or self.sigterm_step >= 0
             or self.slow_ms > 0
         )
+
+
+@dataclass(frozen=True)
+class IoFaultPlan:
+    """Parsed data-plane fault schedule (all inert by default)."""
+
+    flaky_p: float = 0.0
+    flaky_fails: int = 1
+    corrupt: frozenset = frozenset()
+    stall_batch: int = -1
+    stall_sec: float = 3600.0
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "IoFaultPlan":
+        env = os.environ if env is None else env
+        raw_corrupt = env.get("SEIST_FAULT_IO_CORRUPT", "")
+        try:
+            corrupt = frozenset(
+                int(tok) for tok in raw_corrupt.split(",") if tok.strip()
+            )
+        except ValueError as e:
+            raise ValueError(
+                "SEIST_FAULT_IO_CORRUPT must be a comma list of ints, got "
+                f"{raw_corrupt!r}"
+            ) from e
+        return cls(
+            flaky_p=_env_float(env, "SEIST_FAULT_IO_FLAKY_P", 0.0),
+            flaky_fails=max(1, _env_int(env, "SEIST_FAULT_IO_FLAKY_FAILS", 1)),
+            corrupt=corrupt,
+            stall_batch=_env_int(env, "SEIST_FAULT_IO_STALL_BATCH", -1),
+            stall_sec=_env_float(env, "SEIST_FAULT_IO_STALL_SEC", 3600.0),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return (
+            self.flaky_p > 0 or bool(self.corrupt) or self.stall_batch >= 0
+        )
+
+
+class IoFaultInjector:
+    """Data-plane fault driver, consulted by the guarded read path
+    (``io_guard.read_with_retry``) and the Loader.
+
+    Flakiness is a pure function of the sample index — NOT of wall clock
+    or call order — so a run with injected transient faults consumes the
+    exact same byte stream as a clean run once retries succeed (the
+    bit-identical-params chaos contract), regardless of worker-thread
+    scheduling."""
+
+    def __init__(self, plan: Optional[IoFaultPlan] = None):
+        self.plan = plan or IoFaultPlan()
+        self._stalled = False
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "IoFaultInjector":
+        return cls(IoFaultPlan.from_env(env))
+
+    @property
+    def enabled(self) -> bool:
+        return self.plan.enabled
+
+    def _is_flaky(self, key: int) -> bool:
+        p = self.plan.flaky_p
+        if p <= 0:
+            return False
+        u = np.random.default_rng(
+            np.random.SeedSequence([0x10FA_17, int(key)])
+        ).random()
+        return bool(u < p)
+
+    def maybe_flaky_read(self, key: int, attempt: int) -> None:
+        """Raise a transient OSError when sample ``key`` is flaky-selected
+        and ``attempt`` (0-based) is still within the injected failure
+        run. The retry loop calls this before every real read attempt."""
+        if attempt < self.plan.flaky_fails and self._is_flaky(key):
+            raise OSError(
+                f"[faults] injected flaky read (sample {key}, "
+                f"attempt {attempt})"
+            )
+
+    def is_corrupt(self, key: int) -> bool:
+        return int(key) in self.plan.corrupt
+
+    def maybe_stall(self, batch_index: int) -> None:
+        """Sleep (once) before producing batch ``stall_batch`` — simulates
+        a wedged loader for the pipeline stall watchdog."""
+        if self.plan.stall_batch < 0 or self._stalled:
+            return
+        if batch_index >= self.plan.stall_batch:
+            self._stalled = True
+            logger.warning(
+                f"[faults] loader stall injected at batch {batch_index} "
+                f"({self.plan.stall_sec}s)"
+            )
+            time.sleep(self.plan.stall_sec)
 
 
 class FaultInjector:

@@ -125,6 +125,7 @@ _SMOKE_FILES = {
     "test_packed.py",
     "test_collective_report.py",
     "test_jaxlint.py",
+    "test_io_guard.py",
 }
 
 

@@ -85,11 +85,15 @@ def test_train_step_steady_state_never_recompiles(rng):
         for _ in range(4):
             x, y = _batch(rng)  # fresh values, identical shapes/dtypes
             state, loss, _ = step(state, x, y, key)
-        # Block on the STATE too, not just the loss: its buffers are
-        # donation-aliased chain-wise across the 4 steps, and reading
-        # .step below before full materialization has (rarely) returned
-        # another output's bits on the CPU backend.
-        jax.block_until_ready((state, loss))
+            # Per-step sync: the guarded property here is the COMPILE
+            # count, which blocking between calls cannot change — while
+            # an UNsynchronized donated chain is exposed to the open
+            # donation/use-after-reuse hazard (ROADMAP; state.step has
+            # read back another buffer's float bits even on a
+            # fresh-compiled executable, observed once in PR 5's runs).
+            # Synced chains are always correct, so sync keeps this test
+            # about retraces, not about that bug.
+            jax.block_until_ready((state, loss))
     # No identical-shape retrace, and at most one stray re-lowering
     # (observed once under heavy concurrent load; a real regression —
     # e.g. a fresh wrap per call — traces every step and trips both).

@@ -1,0 +1,126 @@
+"""Donation/compile-cache correctness gate (train/step.py resolve_donation).
+
+The ROADMAP open item from the PR 4 audit lane: on jax 0.4.37 CPU, an
+executable DESERIALIZED from the persistent XLA compile cache
+intermittently corrupts donated outputs in unsynchronized donated step
+chains (state.step reads back float bits; repeated reads differ). The
+mitigation gates donation out of exactly that configuration — disk cache
+active AND CPU backend — so cached executables never carry input/output
+aliasing. These tests pin the gate's decision table and run the original
+repro chain under the previously-hazardous config, where it is now
+deterministic instead of a 20-40% coin flip.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import seist_tpu
+from seist_tpu import taskspec
+from seist_tpu.models import api
+from seist_tpu.train import (
+    build_optimizer,
+    create_train_state,
+    jit_step,
+    make_train_step,
+    resolve_donation,
+)
+
+seist_tpu.load_all()
+
+L = 256
+BATCH = 4
+
+
+@pytest.fixture
+def warm_cache_dir(tmp_path, monkeypatch):
+    """A fresh persistent compile cache with no compile-time threshold, so
+    the test's small programs are serialized (and deserialized on a
+    re-wrap) exactly like production-sized ones."""
+    monkeypatch.delenv("SEIST_DONATE_WITH_CACHE", raising=False)
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    cache = str(tmp_path / "xla_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    yield cache
+    jax.config.update("jax_compilation_cache_dir", prev_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+
+
+def _setup():
+    model = api.create_model("phasenet", in_samples=L)
+    variables = api.init_variables(model, in_samples=L, batch_size=BATCH)
+    tx = build_optimizer("adam", 1e-3)
+    state = create_train_state(model, variables, tx)
+    spec = taskspec.get_task_spec("phasenet")
+    return state, spec, taskspec.make_loss("phasenet")
+
+
+def _batch(rng):
+    import jax.numpy as jnp
+
+    x = rng.standard_normal((BATCH, L, 3)).astype(np.float32)
+    ppk = np.zeros((BATCH, L), np.float32)
+    ppk[:, 64] = 1.0
+    spk = np.zeros((BATCH, L), np.float32)
+    spk[:, 128] = 1.0
+    y = np.stack([1.0 - ppk - spk, ppk, spk], axis=-1)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+# ------------------------------------------------------------ decision table
+def test_gate_drops_donation_with_cache_on_cpu(warm_cache_dir):
+    assert jax.default_backend() == "cpu"
+    assert resolve_donation((0,)) == ()
+
+
+def test_gate_keeps_donation_without_cache(monkeypatch):
+    monkeypatch.delenv("SEIST_DONATE_WITH_CACHE", raising=False)
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        assert resolve_donation((0,)) == (0,)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_gate_env_overrides(warm_cache_dir, monkeypatch):
+    monkeypatch.setenv("SEIST_DONATE_WITH_CACHE", "1")
+    assert resolve_donation((0,)) == (0,)
+    monkeypatch.setenv("SEIST_DONATE_WITH_CACHE", "0")
+    assert resolve_donation((0,)) == ()
+
+
+def test_gate_passes_empty_through(warm_cache_dir):
+    assert resolve_donation(()) == ()
+
+
+# ------------------------------------------------------------- repro mirror
+def test_deserialized_step_chain_is_correct(warm_cache_dir, rng):
+    """The test_compile_budget repro, run WITH the persistent cache (the
+    config that module must opt out of): warm the disk cache, re-wrap the
+    step so the next call DESERIALIZES the executable, then run 4
+    back-to-back unsynchronized steps. Under the donation gate the
+    deserialized executable carries no aliasing, so the chain's state is
+    exact every time — previously this flaked in 20-40% of processes."""
+    state, spec, loss_fn = _setup()
+    key = jax.random.PRNGKey(0)
+    x, y = _batch(rng)
+
+    step1 = jit_step(make_train_step(spec, loss_fn))
+    state, loss, _ = step1(state, x, y, key)
+    jax.block_until_ready((state, loss))  # executable now in the disk cache
+
+    # Fresh wrap of an identical program: lowering runs again, the
+    # compile is a persistent-cache hit -> deserialization path.
+    step2 = jit_step(make_train_step(spec, loss_fn))
+    for _ in range(4):
+        state, loss, _ = step2(state, x, y, key)
+    # No pre-read synchronization on purpose (the repro's trigger).
+    first_read = int(state.step)
+    second_read = int(state.step)
+    assert first_read == second_read == 5
+    leaf = jax.tree.leaves(state.params)[0]
+    np.testing.assert_array_equal(np.asarray(leaf), np.asarray(leaf))
+    assert np.isfinite(float(loss))

@@ -25,9 +25,9 @@ from seist_tpu.utils.logger import logger
 
 # Whole-file slow: every test here is a real (or in-process) training run
 # dominated by jit compiles — the tier-1 fast lane stays fast (ISSUE
-# satellite); `pytest -m slow tests/test_fault_tolerance_e2e.py` runs the
-# acceptance checks.
-pytestmark = pytest.mark.slow
+# satellite); `pytest -m slow tests/test_fault_tolerance_e2e.py` (or
+# `make chaos`) runs the acceptance checks.
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
 
 seist_tpu.load_all()
 

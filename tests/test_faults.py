@@ -24,6 +24,8 @@ from seist_tpu.utils.faults import FaultInjector, FaultPlan
 
 seist_tpu.load_all()
 
+pytestmark = pytest.mark.faults  # `make chaos` lane (-m 'chaos or faults')
+
 L = 64
 
 

@@ -1,0 +1,448 @@
+"""Units for the self-healing data plane (seist_tpu/data/io_guard.py +
+the SEIST_FAULT_IO_* injector in utils/faults.py): retry/backoff
+classification, ingest validation, deterministic quarantine fallback,
+h5-handle eviction, the stall watchdog, and the Loader's death wrapping.
+Chaos e2e (real training runs under injected faults) lives in
+tests/test_data_plane_chaos.py."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import seist_tpu
+from seist_tpu import taskspec
+from seist_tpu.data import io_guard, pipeline
+from seist_tpu.utils.faults import IoFaultInjector, IoFaultPlan
+
+seist_tpu.load_all()
+
+pytestmark = pytest.mark.faults
+
+
+# ------------------------------------------------------------ exit-code pin
+def test_preempt_code_matches_trainer():
+    """io_guard duplicates PREEMPT_EXIT_CODE (importing train.checkpoint
+    would drag orbax into every data-plane import); pin them together."""
+    from seist_tpu.train.checkpoint import PREEMPT_EXIT_CODE
+
+    assert io_guard.PREEMPT_EXIT_CODE == PREEMPT_EXIT_CODE == 75
+
+
+# ------------------------------------------------------------------- retries
+def _policy(attempts=3):
+    return io_guard.RetryPolicy(
+        attempts=attempts, backoff_base_s=0.01, backoff_cap_s=0.08
+    )
+
+
+def test_retry_succeeds_after_transient_failures():
+    naps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("blip")
+        return "payload"
+
+    before = io_guard.COUNTERS.snapshot()["retries"]
+    out = io_guard.read_with_retry(
+        flaky, policy=_policy(), sleep=naps.append
+    )
+    assert out == "payload" and calls["n"] == 3
+    assert io_guard.COUNTERS.snapshot()["retries"] - before == 2
+    # Exponential backoff with jitter: each sleep within [0.5, 1.5]x of
+    # min(base * 2^k, cap).
+    assert len(naps) == 2
+    for k, s in enumerate(naps):
+        base = min(0.01 * 2**k, 0.08)
+        assert 0.5 * base <= s <= 1.5 * base
+
+
+def test_retry_backoff_is_capped():
+    p = _policy(attempts=10)
+    assert p.sleep_s(9) <= 0.08 * 1.5
+
+
+def test_corrupt_sample_is_not_retried():
+    calls = {"n": 0}
+
+    def corrupt():
+        calls["n"] += 1
+        raise io_guard.CorruptSampleError("bad bytes")
+
+    with pytest.raises(io_guard.CorruptSampleError):
+        io_guard.read_with_retry(corrupt, policy=_policy(), sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_unexpected_exception_is_not_absorbed():
+    calls = {"n": 0}
+
+    def bug():
+        calls["n"] += 1
+        raise RuntimeError("a bug, not a fault")
+
+    with pytest.raises(RuntimeError):
+        io_guard.read_with_retry(bug, policy=_policy(), sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_exhausted_retries_promote_to_permanent():
+    def always_down():
+        raise OSError("still down")
+
+    with pytest.raises(io_guard.RetriesExhaustedError) as ei:
+        io_guard.read_with_retry(
+            always_down, policy=_policy(), sleep=lambda s: None
+        )
+    # Quarantine treats it like corruption.
+    assert isinstance(ei.value, io_guard.CorruptSampleError)
+
+
+def test_injected_flakiness_rides_the_retry_loop():
+    """The injector fails attempt 0 of a flaky-selected key; the retry
+    loop absorbs it and the payload is unchanged."""
+    inj = IoFaultInjector(IoFaultPlan(flaky_p=1.0, flaky_fails=1))
+    out = io_guard.read_with_retry(
+        lambda: "payload", fault_key=7, injector=inj,
+        policy=_policy(), sleep=lambda s: None,
+    )
+    assert out == "payload"
+    # Deterministic per key: the same key is flaky on every call.
+    with pytest.raises(OSError):
+        inj.maybe_flaky_read(7, attempt=0)
+    inj.maybe_flaky_read(7, attempt=1)  # past flaky_fails: clean
+
+
+# ---------------------------------------------------------------- validation
+def _event(data):
+    return {"data": data}
+
+
+def test_validate_event_accepts_clean_and_int_data():
+    io_guard.validate_event(_event(np.random.randn(3, 64).astype(np.float32)))
+    io_guard.validate_event(_event(np.zeros((1, 8), np.int32)))
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        np.full((3, 16), np.nan, np.float32),
+        np.r_[np.zeros(15, np.float32), np.inf].reshape(1, 16),
+        np.zeros((16,), np.float32),  # wrong ndim
+        np.zeros((3, 0), np.float32),  # empty
+        np.array([[None, "x"]], dtype=object),  # non-numeric
+    ],
+)
+def test_validate_event_rejects_corruption(bad):
+    with pytest.raises(io_guard.CorruptSampleError):
+        io_guard.validate_event(_event(bad))
+
+
+def test_validate_event_rejects_missing_data_field():
+    with pytest.raises(io_guard.CorruptSampleError):
+        io_guard.validate_event({"ppks": [1]})
+    with pytest.raises(io_guard.CorruptSampleError):
+        io_guard.validate_event(None)
+
+
+# ---------------------------------------------------------------- quarantine
+def test_quarantine_candidates_deterministic_and_exclusive():
+    q1 = io_guard.Quarantine(100, max_frac=0.5)
+    q2 = io_guard.Quarantine(100, max_frac=0.5)
+    a = list(q1.candidates(7, seed=3, epoch=2, idx=107))
+    b = list(q2.candidates(7, seed=3, epoch=2, idx=107))
+    assert a == b  # pure function of (seed, epoch, idx)
+    assert a[0] == 7  # the sample itself first
+    assert 7 not in a[1:]  # never falls back to itself
+    # Different key -> different fallback stream (overwhelmingly).
+    c = list(q1.candidates(7, seed=3, epoch=3, idx=107))
+    assert a[1:] != c[1:]
+
+
+def test_quarantine_skips_known_bad_candidates():
+    q = io_guard.Quarantine(50, max_frac=0.5)
+    seq = list(q.candidates(5, seed=0, epoch=0, idx=5))
+    q.add(5, "corrupt")
+    q.add(seq[1], "also corrupt")
+    seq2 = list(q.candidates(5, seed=0, epoch=0, idx=5))
+    assert seq2[0] == seq[2]  # self and first fallback both benched
+    assert 5 not in seq2 and seq[1] not in seq2
+
+
+def test_quarantine_overflow_aborts():
+    q = io_guard.Quarantine(10, max_frac=0.1)
+    q.add(0, "bad")  # 1/10 == max, not over
+    with pytest.raises(io_guard.QuarantineOverflowError):
+        q.add(1, "bad")  # 2/10 > 0.1
+
+
+def test_quarantine_report_and_pickle_roundtrip():
+    import pickle
+
+    q = io_guard.Quarantine(20, max_frac=0.5)
+    q.add(3, "nan burst")
+    r = q.report()
+    assert r["quarantined"] == [3] and r["n_total"] == 20
+    assert r["frac"] == pytest.approx(0.05)
+    q2 = pickle.loads(pickle.dumps(q))
+    assert 3 in q2 and q2.active and q2.max_frac == 0.5
+
+
+# ------------------------------------------------------------- injector plan
+def test_io_fault_plan_parsing_and_defaults():
+    assert not IoFaultPlan.from_env({}).enabled
+    plan = IoFaultPlan.from_env({
+        "SEIST_FAULT_IO_FLAKY_P": "0.25",
+        "SEIST_FAULT_IO_FLAKY_FAILS": "2",
+        "SEIST_FAULT_IO_CORRUPT": "3, 7",
+        "SEIST_FAULT_IO_STALL_BATCH": "5",
+        "SEIST_FAULT_IO_STALL_SEC": "12.5",
+    })
+    assert plan.enabled and plan.flaky_p == 0.25 and plan.flaky_fails == 2
+    assert plan.corrupt == frozenset({3, 7})
+    assert plan.stall_batch == 5 and plan.stall_sec == 12.5
+    with pytest.raises(ValueError):
+        IoFaultPlan.from_env({"SEIST_FAULT_IO_CORRUPT": "soon"})
+
+
+def test_injector_stall_fires_once(monkeypatch):
+    import seist_tpu.utils.faults as faults_mod
+
+    naps = []
+    monkeypatch.setattr(faults_mod.time, "sleep", lambda s: naps.append(s))
+    inj = IoFaultInjector(IoFaultPlan(stall_batch=2, stall_sec=9.0))
+    inj.maybe_stall(0)
+    inj.maybe_stall(1)
+    assert naps == []
+    inj.maybe_stall(2)
+    assert naps == [9.0]
+    inj.maybe_stall(3)  # once only
+    assert naps == [9.0]
+
+
+# ----------------------------------------------- dataset-level wiring (fast)
+def _make_sds(monkeypatch=None, **over):
+    kwargs = dict(
+        seed=1,
+        in_samples=256,
+        augmentation=False,
+        dataset_kwargs={"num_events": 20, "trace_samples": 1024},
+    )
+    kwargs.update(over)
+    return pipeline.from_task_spec(
+        taskspec.get_task_spec("phasenet"), "synthetic", "train", **kwargs
+    )
+
+
+def test_corrupt_injection_quarantines_exactly_and_deterministically(
+    monkeypatch,
+):
+    monkeypatch.setenv("SEIST_FAULT_IO_CORRUPT", "2,5")
+    a = _make_sds(max_quarantine_frac=0.5)
+    items_a = [a[i][0] for i in range(len(a))]
+    assert a.quarantine_report()["quarantined"] == [2, 5]
+    # Same faults, fresh dataset -> same replacement content.
+    b = _make_sds(max_quarantine_frac=0.5)
+    items_b = [b[i][0] for i in range(len(b))]
+    for x, y in zip(items_a, items_b):
+        np.testing.assert_array_equal(x, y)
+    # Quarantined indices were replaced, not dropped: shapes intact.
+    assert all(x.shape == items_a[0].shape for x in items_a)
+
+
+def test_flaky_reads_are_invisible_after_retries(monkeypatch):
+    clean = [_make_sds()[i][0] for i in range(16)]
+    monkeypatch.setenv("SEIST_FAULT_IO_FLAKY_P", "0.5")
+    before = io_guard.COUNTERS.snapshot()["retries"]
+    flaky_sds = _make_sds()
+    flaky = [flaky_sds[i][0] for i in range(16)]
+    assert io_guard.COUNTERS.snapshot()["retries"] - before > 0
+    for x, y in zip(clean, flaky):
+        np.testing.assert_array_equal(x, y)
+    assert len(flaky_sds.quarantine) == 0  # transient != corrupt
+
+
+def test_guard_disabled_bypasses_wrapping(monkeypatch):
+    sds = _make_sds()
+    with io_guard.disabled():
+        x = sds[0][0]
+    np.testing.assert_array_equal(x, sds[0][0])
+
+
+def test_epoch_keyed_fallback_changes_across_epochs(monkeypatch):
+    """The replacement is keyed by (seed, epoch, idx): a new epoch draws a
+    fresh fallback for the same quarantined index (no sample is
+    permanently over-represented)."""
+    monkeypatch.setenv("SEIST_FAULT_IO_CORRUPT", "2")
+    sds = _make_sds(max_quarantine_frac=0.5)
+    sds.set_epoch(0)
+    e0 = sds[2][0]
+    sds.set_epoch(1)
+    e1 = sds[2][0]
+    assert not np.array_equal(e0, e1)
+
+
+def test_raw_store_probe_refuses_corrupt_sample_zero(monkeypatch):
+    """estimate_bytes probes raw sample 0 through the guarded path: a
+    permanently-corrupt first sample must surface as the ValueError the
+    worker's device-aug selection catches (-> host-path fallback), not
+    crash with an unclassified error."""
+    monkeypatch.setenv("SEIST_FAULT_IO_CORRUPT", "0")
+    sds = _make_sds(max_quarantine_frac=0.5)
+    with pytest.raises(ValueError, match="host path"):
+        pipeline.RawStore.estimate_bytes(sds)
+    with pytest.raises(ValueError, match="host path"):
+        pipeline.RawStore.build(sds)
+
+
+def test_loader_reuses_dataset_injector():
+    sds = _make_sds()
+    loader = pipeline.Loader(sds, batch_size=4)
+    assert loader._io_faults is sds.io_faults
+    loader.close()
+
+
+# ------------------------------------------------------------- h5 eviction
+def test_evict_h5_closes_and_reopens(tmp_path):
+    import h5py
+
+    from seist_tpu.data import base
+
+    p = str(tmp_path / "f.h5")
+    with h5py.File(p, "w") as f:
+        f.create_dataset("g/x", data=[1, 2, 3])
+
+    result = {}
+
+    def run():
+        f1 = base.open_h5(p)
+        result["evicted"] = base.evict_h5(p)
+        result["closed"] = not bool(f1)
+        result["evict_empty"] = base.evict_h5(p)  # nothing cached now
+        f2 = base.open_h5(p, group="g")
+        result["reopened"] = bool(f2)
+
+    t = threading.Thread(target=run)  # fresh thread-local cache
+    t.start()
+    t.join()
+    assert result["evicted"] is True
+    assert result["closed"] is True
+    assert result["evict_empty"] is False
+    assert result["reopened"] is True
+
+
+# ---------------------------------------------------------- stall watchdog
+def test_watchdog_trips_on_armed_timeout():
+    exits = []
+    wd = io_guard.StallWatchdog(
+        0.05, exit_fn=exits.append, poll_s=0.01
+    ).start()
+    try:
+        wd.arm()
+        deadline = time.monotonic() + 2.0
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert exits == [io_guard.PREEMPT_EXIT_CODE]
+        assert wd.tripped
+    finally:
+        wd.stop()
+
+
+def test_watchdog_disarmed_never_trips():
+    exits = []
+    wd = io_guard.StallWatchdog(
+        0.05, exit_fn=exits.append, poll_s=0.01
+    ).start()
+    try:
+        for _ in range(6):  # repeatedly armed but always fed in time
+            wd.arm()
+            time.sleep(0.01)
+            wd.disarm()
+        time.sleep(0.15)  # disarmed: idle time never counts
+        assert exits == [] and not wd.tripped
+    finally:
+        wd.stop()
+
+
+def test_watchdog_rejects_nonpositive_timeout():
+    with pytest.raises(ValueError):
+        io_guard.StallWatchdog(0)
+
+
+def test_watch_passthrough_and_on_death():
+    assert list(io_guard.watch(iter([1, 2, 3]), None)) == [1, 2, 3]
+
+    def dying():
+        yield 1
+        raise io_guard.LoaderDeathError("thread gone")
+
+    seen = []
+    with pytest.raises(io_guard.LoaderDeathError):
+        for item in io_guard.watch(dying(), None, on_death=seen.append):
+            assert item == 1
+    assert len(seen) == 1
+
+
+# ------------------------------------------------- loader death (satellite)
+def test_loader_worker_raise_surfaces_as_loader_death():
+    """A worker thread raising mid-epoch (a bug, not a sample fault) was
+    previously undefined behavior; it must now surface as
+    LoaderDeathError — the signal train/worker.py converts into a
+    checkpoint + clean-preempt exit instead of a hang or opaque crash."""
+    sds = _make_sds()
+    calls = {"n": 0}
+    orig = type(sds).__getitem__
+
+    def dying(self, idx):
+        calls["n"] += 1
+        if calls["n"] > 6:
+            raise RuntimeError("loader bug")
+        return orig(self, idx)
+
+    sds.__class__ = type("DyingSDS", (type(sds),), {"__getitem__": dying})
+    loader = pipeline.Loader(sds, batch_size=4, num_workers=2)
+    before = io_guard.COUNTERS.snapshot()["loader_deaths"]
+    try:
+        with pytest.raises(io_guard.LoaderDeathError):
+            list(loader)
+    finally:
+        loader.close()
+    assert io_guard.COUNTERS.snapshot()["loader_deaths"] - before == 1
+
+
+def test_loader_passes_quarantine_overflow_through():
+    """The deliberate abort must NOT be converted into a preemptable
+    loader death (a relaunch loop would burn the supervise budget on a
+    rotted dataset)."""
+    sds = _make_sds()
+
+    def overflowing(self, idx):
+        raise io_guard.QuarantineOverflowError("rotted")
+
+    sds.__class__ = type(
+        "OverflowSDS", (type(sds),), {"__getitem__": overflowing}
+    )
+    loader = pipeline.Loader(sds, batch_size=4, num_workers=2)
+    try:
+        with pytest.raises(io_guard.QuarantineOverflowError):
+            list(loader)
+    finally:
+        loader.close()
+
+
+# ------------------------------------------------------------ ops surfacing
+def test_counters_surface_through_ops_metrics():
+    from seist_tpu.ops import data_plane_counters
+
+    before = data_plane_counters()
+    io_guard.COUNTERS.inc("retries")
+    after = data_plane_counters()
+    assert after["retries"] == before["retries"] + 1
+    assert set(after) >= {
+        "reads", "retries", "reopens", "quarantined",
+        "fallback_reads", "stall_trips", "loader_deaths",
+    }

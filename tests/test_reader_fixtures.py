@@ -270,6 +270,38 @@ class TestPNW:
         assert len(ev["snr"]) == 3 and ev["snr"][1] == 0.0  # 'nan' -> 0
         assert np.isfinite(ev["data"]).all()
 
+    def test_mostly_nan_trace_is_corrupt_not_zeroed(self, tmp_path):
+        """Sparse NaNs are zeroed (reference parity, ref pnw.py:110 —
+        covered above); a trace that is MOSTLY non-finite is rotted and
+        must classify as permanent corruption (data/io_guard.py) instead
+        of silently becoming a near-all-zeros sample."""
+        import shutil
+
+        import h5py
+
+        from seist_tpu.data.io_guard import CorruptSampleError
+        from seist_tpu.registry import DATASETS
+
+        src = tmp_path / "pnw_src"
+        src.mkdir()
+        root = tmp_path / "pnw_rot"
+        shutil.copytree(_pnw_fixture(src, "comcat_metadata.csv"), root)
+        with h5py.File(root / "comcat_waveforms.hdf5", "r+") as f:
+            arr = f["data/bucket0"][...]
+            arr[0] = np.nan  # whole first trace rotted
+            del f["data/bucket0"]
+            f.create_dataset("data/bucket0", data=arr)
+        ds = DATASETS.create(
+            "pnw", seed=11, mode="train", data_dir=str(root),
+            data_split=False, shuffle=False,
+        )
+        rotted = next(
+            i for i in range(len(ds))
+            if ds._row_dict(i)["trace_name"].startswith("bucket0$0,")
+        )
+        with pytest.raises(CorruptSampleError, match="non-finite"):
+            ds[rotted]
+
 
 class TestPNWLight:
     def test_reader_roundtrip(self, pnw_light_dir):
