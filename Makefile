@@ -4,7 +4,7 @@ NATIVE_DIR := seist_tpu/native
 CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
-.PHONY: native test t1 lint lint-baseline serve-smoke chaos clean
+.PHONY: native test t1 lint lint-baseline serve-smoke obs-smoke chaos clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -43,6 +43,14 @@ t1:
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'chaos or faults' \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Telemetry-plane smoke (docs/OBSERVABILITY.md): 2-step CPU train run
+# with --metrics-port, live Prometheus/JSON/flight scrape, then an
+# injected SEIST_FAULT_IO_STALL crash that must exit 75 and leave a
+# flight-recorder dump with the final steps' spans. Also runs in the
+# chaos lane (tests/test_obs_e2e.py).
+obs-smoke:
+	JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
 # Checkpoint-free serving smoke: warm-compile, micro-batch 24 requests,
 # print a BENCH-style latency/throughput/fill-ratio JSON line.

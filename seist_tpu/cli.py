@@ -69,8 +69,30 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         dest="profile_steps",
                         help="capture a jax.profiler trace of this many "
                         "steady-state train steps (first epoch, after "
-                        "warmup) into <logdir>/profile; view with "
-                        "TensorBoard's profile plugin. Default 0 = off")
+                        "warmup) into a unique "
+                        "<logdir>/profile/<timestamp>_p<pid> dir (a "
+                        "relaunched supervise attempt never clobbers the "
+                        "previous capture); view with TensorBoard's "
+                        "profile plugin. Later captures can be re-armed "
+                        "live via SIGUSR2 or POST /profile on "
+                        "--metrics-port. Default 0 = off")
+    parser.add_argument("--metrics-port", default=0, type=int,
+                        dest="metrics_port",
+                        help="serve the telemetry plane on this loopback "
+                        "port (docs/OBSERVABILITY.md): GET /metrics is "
+                        "Prometheus text exposition of the metrics bus "
+                        "(step spans, loss/wps gauges, data-plane "
+                        "counters), /metrics.json + /flight are JSON "
+                        "views, POST /profile triggers an on-demand "
+                        "jax.profiler capture. -1 binds an ephemeral "
+                        "port (logged). Default 0 = off")
+    parser.add_argument("--flight-steps", default=256, type=int,
+                        dest="flight_steps",
+                        help="flight-recorder ring size: the last N "
+                        "steps' metrics and span events are dumped to "
+                        "<logdir>/flight/*.json on every death path "
+                        "(rollback, stall, preempt, quarantine "
+                        "overflow, crash). Default 256")
     parser.add_argument("--steps-per-call", default=0, type=int,
                         dest="steps_per_call",
                         help="scan this many optimizer updates inside one "

@@ -358,6 +358,11 @@ class Quarantine:
         )
         limit = self.max_frac * self.n_total
         if n_bad > limit:
+            # The overflow crashes the run (deliberately NOT a preempt);
+            # leave the forensic record first — which samples, why, when.
+            _flight_dump(
+                "quarantine_overflow", quarantined=n_bad, n_total=self.n_total
+            )
             raise QuarantineOverflowError(
                 f"{n_bad}/{self.n_total} samples quarantined exceeds "
                 f"--max-quarantine-frac {self.max_frac}: the dataset is "
@@ -414,12 +419,30 @@ class Quarantine:
 
 
 # ------------------------------------------------------------- stall watchdog
+def _flight_dump(reason: str, **fields) -> None:
+    """Best-effort flight-recorder dump (obs/flight.py) on a death path.
+    A no-op when no recorder is installed (library use outside the train
+    worker) and never raises — the exit matters more than the artifact."""
+    try:
+        from seist_tpu.obs import flight
+
+        flight.dump_on_death(reason, **fields)
+    except Exception:  # noqa: BLE001 - death path; the exit must proceed
+        pass
+
+
 def hard_exit(code: int) -> None:
     """Flush log handlers and ``os._exit``. The only safe exit when
     non-daemon data-plane threads may be wedged: ``sys.exit`` would hang
     forever in ``threading._shutdown`` joining a pool thread stuck
     inside a dead read — the exact hang this module exists to eliminate.
-    A separate function so in-process tests can monkeypatch it."""
+    A separate function so in-process tests can monkeypatch it.
+
+    Dumps the flight recorder first (docs/OBSERVABILITY.md): this is the
+    funnel every hard death path drains through, so the dump happens even
+    when the caller forgot (deduped when the caller already dumped a
+    richer record seconds ago)."""
+    _flight_dump("hard_exit", dedup_s=5.0, exit_code=code)
     logging.shutdown()
     os._exit(code)
 
@@ -521,7 +544,12 @@ class StallWatchdog:
             f"(timeout {self.timeout_s}s); dumping thread stacks and "
             f"exiting {self.exit_code} for supervised relaunch"
         )
-        dump_thread_stacks()
+        stacks = dump_thread_stacks()
+        # Explicit dump here (hard_exit would also fire one) so the stall
+        # record carries the thread stacks and wait time even when a test
+        # injects a custom exit_fn.
+        _flight_dump("stall_watchdog", waited_s=round(waited, 1),
+                     thread_stacks=stacks)
         # The default exit_fn is hard_exit (logging.shutdown + os._exit):
         # every registered handler flushes, so the stall post-mortem is
         # durable before the process dies.

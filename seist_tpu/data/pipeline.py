@@ -512,6 +512,12 @@ class Loader:
         return out
 
     def __iter__(self) -> Iterator[Batch]:
+        # Bus counters resolved once per epoch, not per batch (obs/bus.py;
+        # the scrape side reads them via --metrics-port / snapshot()).
+        from seist_tpu.obs.bus import BUS
+
+        c_batches = BUS.counter("loader_batches")
+        c_samples = BUS.counter("loader_samples")
         indices = self._indices()
         nb = len(self)
         start, self._start_batch = self._start_batch, 0  # one-shot
@@ -535,6 +541,8 @@ class Loader:
             mask = np.ones(self.batch_size, dtype=np.float32)
             if pad:
                 mask[-pad:] = 0.0
+            c_batches.inc()
+            c_samples.inc(len(samples) - pad)
             yield Batch(inputs, loss_targets, metrics_targets, meta, mask)
 
 

@@ -1,0 +1,49 @@
+"""Unified telemetry plane (docs/OBSERVABILITY.md).
+
+Three pillars, one import:
+
+* **Metrics bus** (:mod:`~seist_tpu.obs.bus`): process-wide counters /
+  gauges / histograms + the span API every timing path in the repo is
+  deduplicated onto; Prometheus text exposition + JSONL event log.
+* **Per-op attribution** (:mod:`~seist_tpu.obs.attribution`): analytic
+  jaxpr walk + roofline time shares behind BENCH's ``step_breakdown``.
+* **Flight recorder** (:mod:`~seist_tpu.obs.flight`): ring buffer of the
+  last N steps' metrics/spans, dumped to JSON on every death path.
+
+``obs/http.py`` serves the bus on the train worker's ``--metrics-port``.
+"""
+
+from seist_tpu.obs import flight
+from seist_tpu.obs.attribution import attribute_step, jaxpr_op_costs
+from seist_tpu.obs.bus import (
+    BUS,
+    EventLog,
+    MetricsBus,
+    register_default_collectors,
+    render_prometheus,
+    stopwatch,
+    timed_iter,
+)
+from seist_tpu.obs.flight import FlightRecorder
+from seist_tpu.obs.http import (
+    MetricsHTTPServer,
+    ProfileTrigger,
+    start_metrics_server,
+)
+
+__all__ = [
+    "BUS",
+    "EventLog",
+    "FlightRecorder",
+    "MetricsBus",
+    "MetricsHTTPServer",
+    "ProfileTrigger",
+    "attribute_step",
+    "flight",
+    "jaxpr_op_costs",
+    "register_default_collectors",
+    "render_prometheus",
+    "start_metrics_server",
+    "stopwatch",
+    "timed_iter",
+]

@@ -1,0 +1,325 @@
+"""Per-op step-time attribution: where does the next 10 ms go?
+
+The ROADMAP's MFU campaign is blocked on visibility — ``bench.py``
+reports ONE aggregate step time, so nobody can say whether the gap to
+the hardware is attention FLOPs, padding waste, or data movement. The
+pjit/TPUv4 scaling report (arXiv:2204.06514) treats per-op profiling as
+the precondition for every step-time win it describes; this module is
+the always-available analytic half of that story (an on-demand
+``jax.profiler`` capture — ``--profile-steps`` / SIGUSR2 / ``POST
+/profile`` — is the measured half, viewed in TensorBoard/Perfetto).
+
+The model: walk the step function's jaxpr (recursing through pjit /
+scan / cond / custom-diff calls, multiplying scan bodies by their trip
+count) and charge every equation analytic FLOPs (exact for
+``dot_general`` / ``conv_general_dilated``, element-count for vector
+ops) and bytes moved (operand + result aval bytes — an un-fused upper
+bound; XLA fusion keeps intermediates in registers, which is exactly why
+the ``model_vs_xla`` ratio against the compiled executable's
+``cost_analysis()`` is reported alongside). Per-op time shares come from
+a roofline charge ``max(flops/peak, bytes/bw)``; multiplied by the
+measured step time they attribute real milliseconds per op class.
+
+Everything here is deterministic and backend-free (tested on CPU); the
+BENCH ``step_breakdown`` section is built from it (bench.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+# Generic roofline for share computation when the device peak/bandwidth
+# are unknown (CPU debug runs): ridge intensity 10 FLOP/byte — only the
+# RELATIVE shares matter there, and a ridge in the 5-50 range barely
+# moves them for this workload.
+_GENERIC_PEAK = 1e12
+_GENERIC_BW = 1e11
+
+#: Op classes for the MFU decomposition. Anything not listed is "other".
+_MATMUL_PRIMS = frozenset(("dot_general", "conv_general_dilated"))
+_REDUCE_PRIMS = frozenset(
+    (
+        "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+        "reduce_and", "reduce_or", "argmax", "argmin",
+        "reduce_precision", "cumsum", "cummax", "cummin", "cumprod",
+    )
+)
+_DATA_PRIMS = frozenset(
+    (
+        "transpose", "reshape", "broadcast_in_dim", "concatenate",
+        "slice", "dynamic_slice", "dynamic_update_slice", "pad",
+        "gather", "scatter", "scatter_add", "rev", "squeeze",
+        "convert_element_type", "select_n", "copy", "device_put",
+        "split", "iota",
+    )
+)
+
+
+def classify(prim_name: str) -> str:
+    if prim_name in _MATMUL_PRIMS:
+        return "matmul"
+    if prim_name in _REDUCE_PRIMS:
+        return "reduce"
+    if prim_name in _DATA_PRIMS:
+        return "data_movement"
+    return "elementwise"
+
+
+def _aval_bytes(v) -> int:
+    aval = getattr(v, "aval", None)
+    size = getattr(aval, "size", None)
+    dtype = getattr(aval, "dtype", None)
+    if size is None or dtype is None:
+        return 0
+    return int(size) * int(dtype.itemsize)
+
+
+def _aval_size(v) -> int:
+    return int(getattr(getattr(v, "aval", None), "size", 0) or 0)
+
+
+def _shape_str(v) -> str:
+    aval = getattr(v, "aval", None)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None:
+        return "?"
+    dt = str(dtype) if dtype is not None else "?"
+    short = {"float32": "f32", "bfloat16": "bf16", "float16": "f16",
+             "int32": "i32", "int64": "i64", "bool": "pred"}.get(dt, dt)
+    return f"{short}[{','.join(str(d) for d in shape)}]"
+
+
+def _dot_flops(eqn) -> int:
+    (lhs_c, rhs_c), (lhs_b, _rhs_b) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape
+    batch = math.prod(lhs[i] for i in lhs_b) if lhs_b else 1
+    k = math.prod(lhs[i] for i in lhs_c) if lhs_c else 1
+    m = math.prod(
+        d for i, d in enumerate(lhs) if i not in lhs_c and i not in lhs_b
+    )
+    n = math.prod(
+        d for i, d in enumerate(rhs) if i not in rhs_c and i not in _rhs_b
+    )
+    return 2 * batch * m * n * k
+
+
+def _conv_flops(eqn) -> int:
+    out = eqn.outvars[0].aval.shape
+    kernel = eqn.invars[1].aval.shape
+    dnums = eqn.params.get("dimension_numbers")
+    # rhs_spec[0] indexes the kernel's output-feature dim; MACs =
+    # batch*out_spatial*out_ch*(in_ch/groups)*kernel_spatial =
+    # (prod(out)/out_ch) * prod(kernel).
+    out_ch = kernel[dnums.rhs_spec[0]] if dnums is not None else 1
+    batch_count = eqn.params.get("batch_group_count", 1) or 1
+    return 2 * (math.prod(out) // max(out_ch, 1)) * math.prod(kernel) // max(
+        batch_count, 1
+    )
+
+
+def _sub_jaxprs(eqn) -> List[Tuple[Any, int, bool]]:
+    """(jaxpr, multiplier, exclusive) sub-jaxprs of a call-like eqn.
+    ``exclusive`` marks cond branches (charge the max, not the sum)."""
+    name = eqn.primitive.name
+    params = eqn.params
+    if name == "scan":
+        return [(params["jaxpr"], int(params.get("length", 1)), False)]
+    if name == "while":
+        # Trip count is data-dependent; charge one iteration (documented
+        # lower bound — the repo's steps are scan/pjit shaped anyway).
+        return [(params["body_jaxpr"], 1, False), (params["cond_jaxpr"], 1, False)]
+    if name == "cond":
+        return [(b, 1, True) for b in params["branches"]]
+    subs = []
+    for v in params.values():
+        if hasattr(v, "eqns") or hasattr(v, "jaxpr"):  # Jaxpr | ClosedJaxpr
+            subs.append((v, 1, False))
+    return subs
+
+
+def _inner(jaxpr) -> Any:
+    return jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+
+
+def _walk(jaxpr, scale: int, acc: Dict[str, Dict[str, Any]]) -> None:
+    for eqn in _inner(jaxpr).eqns:
+        subs = _sub_jaxprs(eqn)
+        if subs:
+            exclusive = [s for s in subs if s[2]]
+            if exclusive:
+                # cond: charge the most expensive branch only.
+                best: Dict[str, Dict[str, Any]] = {}
+                best_cost = -1.0
+                for sub, mult, _ in exclusive:
+                    trial: Dict[str, Dict[str, Any]] = {}
+                    _walk(sub, scale * mult, trial)
+                    cost = sum(r["flops"] + r["bytes"] for r in trial.values())
+                    if cost > best_cost:
+                        best_cost, best = cost, trial
+                _merge(acc, best)
+            for sub, mult, excl in subs:
+                if not excl:
+                    _walk(sub, scale * mult, acc)
+            continue
+        name = eqn.primitive.name
+        try:
+            if name == "dot_general":
+                flops = _dot_flops(eqn)
+            elif name == "conv_general_dilated":
+                flops = _conv_flops(eqn)
+            elif name in _REDUCE_PRIMS:
+                flops = sum(_aval_size(v) for v in eqn.invars)
+            elif name in _DATA_PRIMS:
+                flops = 0
+            else:
+                flops = max(
+                    max((_aval_size(v) for v in eqn.outvars), default=0),
+                    max((_aval_size(v) for v in eqn.invars), default=0),
+                )
+        except (AttributeError, KeyError, TypeError, IndexError):
+            # Unmodeled primitive layout — charge element count, never die:
+            # attribution is diagnostics for EVERY step variant.
+            flops = max((_aval_size(v) for v in eqn.outvars), default=0)
+        nbytes = sum(_aval_bytes(v) for v in eqn.invars) + sum(
+            _aval_bytes(v) for v in eqn.outvars
+        )
+        rec = acc.setdefault(
+            name,
+            {
+                "op": name,
+                "class": classify(name),
+                "count": 0,
+                "flops": 0,
+                "bytes": 0,
+                "example": None,
+            },
+        )
+        rec["count"] += scale
+        rec["flops"] += flops * scale
+        rec["bytes"] += nbytes * scale
+        if rec["example"] is None:
+            ins = " ".join(_shape_str(v) for v in eqn.invars[:2])
+            rec["example"] = f"{ins} -> {_shape_str(eqn.outvars[0])}"
+
+
+def _merge(acc: Dict[str, Dict[str, Any]], other: Dict[str, Dict[str, Any]]) -> None:
+    for name, rec in other.items():
+        dst = acc.setdefault(name, dict(rec, count=0, flops=0, bytes=0))
+        dst["count"] += rec["count"]
+        dst["flops"] += rec["flops"]
+        dst["bytes"] += rec["bytes"]
+        if dst.get("example") is None:
+            dst["example"] = rec.get("example")
+
+
+def jaxpr_op_costs(closed_jaxpr) -> List[Dict[str, Any]]:
+    """Per-primitive analytic cost records for a (Closed)Jaxpr, summed
+    over every call site (scan bodies multiplied by trip count)."""
+    acc: Dict[str, Dict[str, Any]] = {}
+    _walk(closed_jaxpr, 1, acc)
+    return sorted(acc.values(), key=lambda r: -(r["flops"] + r["bytes"]))
+
+
+def attribute_step(
+    fn: Callable,
+    args: Sequence[Any],
+    *,
+    peak_flops: Optional[float] = None,
+    hbm_bw: Optional[float] = None,
+    measured_step_ms: Optional[float] = None,
+    top_k: int = 10,
+) -> Dict[str, Any]:
+    """The BENCH ``step_breakdown`` core: trace ``fn(*args)`` (jitted
+    callables trace through their pjit wrapper) and return top-k ops by
+    roofline-modeled time with FLOPs, bytes and an MFU decomposition.
+
+    With ``measured_step_ms``, model time shares are converted into
+    attributed milliseconds of the real step; with ``peak_flops``, the
+    overall and matmul-only MFU are computed from the analytic FLOPs.
+    """
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    ops = jaxpr_op_costs(jaxpr)
+    peak = float(peak_flops or 0.0) or _GENERIC_PEAK
+    bw = float(hbm_bw or 0.0) or _GENERIC_BW
+    for r in ops:
+        r["time_model_s"] = max(r["flops"] / peak, r["bytes"] / bw)
+    t_total = sum(r["time_model_s"] for r in ops) or 1e-30
+
+    flops_total = sum(r["flops"] for r in ops)
+    bytes_total = sum(r["bytes"] for r in ops)
+    classes: Dict[str, Dict[str, float]] = {}
+    for r in ops:
+        c = classes.setdefault(
+            r["class"], {"flops": 0, "bytes": 0, "time_model_s": 0.0}
+        )
+        c["flops"] += r["flops"]
+        c["bytes"] += r["bytes"]
+        c["time_model_s"] += r["time_model_s"]
+
+    def _ms(share: float) -> Optional[float]:
+        if measured_step_ms is None:
+            return None
+        return round(share * measured_step_ms, 3)
+
+    top = []
+    for r in ops[: max(1, int(top_k))]:
+        share = r["time_model_s"] / t_total
+        top.append(
+            {
+                "op": r["op"],
+                "class": r["class"],
+                "count": r["count"],
+                "flops": int(r["flops"]),
+                "bytes_accessed": int(r["bytes"]),
+                "time_frac": round(share, 4),
+                "est_ms": _ms(share),
+                "bound": (
+                    "compute"
+                    if r["flops"] / peak >= r["bytes"] / bw
+                    else "memory"
+                ),
+                "example": r["example"],
+            }
+        )
+
+    decomposition = {}
+    for cname, c in sorted(classes.items()):
+        share = c["time_model_s"] / t_total
+        decomposition[cname] = {
+            "flops": int(c["flops"]),
+            "flops_frac": round(c["flops"] / max(flops_total, 1), 4),
+            "time_frac": round(share, 4),
+            "est_ms": _ms(share),
+        }
+
+    out: Dict[str, Any] = {
+        "top_ops": top,
+        "n_op_kinds": len(ops),
+        "flops_total": int(flops_total),
+        "bytes_total": int(bytes_total),
+        "arithmetic_intensity": round(flops_total / max(bytes_total, 1), 3),
+        "mfu_decomposition": decomposition,
+        "roofline_basis": {
+            "peak_flops": peak,
+            "hbm_bw": bw,
+            "generic": peak_flops is None or not peak_flops,
+        },
+    }
+    if measured_step_ms is not None and peak_flops:
+        mfu = flops_total / (measured_step_ms / 1e3 * peak_flops)
+        out["mfu_model"] = round(mfu, 4)
+        mm_ms = decomposition.get("matmul", {}).get("est_ms") or 0.0
+        mm_flops = classes.get("matmul", {}).get("flops", 0)
+        if mm_ms:
+            # MFU of the matmul-attributed milliseconds alone: how close
+            # the MXU-shaped work is to peak once everything else is
+            # carved out — the ceiling the fusion/padding work chases.
+            out["mfu_matmul_attributed"] = round(
+                mm_flops / (mm_ms / 1e3 * peak_flops), 4
+            )
+    return out

@@ -1,0 +1,195 @@
+"""Opt-in metrics HTTP endpoint for the train worker (``--metrics-port``).
+
+A days-long supervised run becomes observable without attaching a
+debugger: Prometheus scrapes ``/metrics``, a human curls
+``/metrics.json`` or ``/flight``, and ``POST /profile`` asks the train
+loop for an on-demand ``jax.profiler`` capture window (same machinery as
+``--profile-steps`` and SIGUSR2 — the loop polls the trigger at step
+boundaries, so the capture starts on a clean step edge).
+
+Endpoints::
+
+    GET  /metrics        Prometheus text exposition (bus + collectors)
+    GET  /metrics.json   JSON snapshot of the bus
+    GET  /flight         live flight-recorder ring (no file written)
+    POST /profile[?steps=N]  request a profiler capture (default 5 steps)
+    GET  /healthz        {"status": "ok"} liveness
+
+Stdlib ``http.server`` only (the serve front-end set the precedent); the
+server runs on a daemon thread and binds loopback by default — metrics
+are unauthenticated, do not bind a public interface.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from seist_tpu.obs import bus as bus_mod
+from seist_tpu.obs import flight as flight_mod
+from seist_tpu.obs.bus import MetricsBus, render_prometheus
+from seist_tpu.utils.logger import logger
+
+DEFAULT_PROFILE_STEPS = 5
+
+
+class ProfileTrigger:
+    """One-slot request box for an on-demand profiler capture. HTTP and
+    SIGUSR2 call :meth:`request`; the train loop calls :meth:`consume` at
+    step boundaries and starts a capture when it returns > 0."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._steps = 0
+
+    def request(self, steps: int = DEFAULT_PROFILE_STEPS) -> None:
+        steps = max(1, int(steps))
+        with self._lock:
+            self._steps = steps
+
+    def consume(self) -> int:
+        if not self._steps:  # lock-free fast path for the per-step poll
+            return 0
+        with self._lock:
+            steps, self._steps = self._steps, 0
+        return steps
+
+
+def _json_bytes(payload) -> bytes:
+    import json
+
+    return json.dumps(payload, default=str).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "seist-obs/0.1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, format: str, *args) -> None:
+        logger.debug(f"[obs] {self.address_string()} {format % args}")
+
+    def _reply(self, status: int, body: bytes, ctype: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    @property
+    def _bus(self) -> MetricsBus:
+        return self.server.bus  # type: ignore[attr-defined]
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        try:
+            parsed = urlparse(self.path)
+            if parsed.path == "/metrics":
+                self._reply(
+                    200,
+                    render_prometheus(self._bus).encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif parsed.path == "/metrics.json":
+                self._reply(
+                    200, _json_bytes(self._bus.snapshot()), "application/json"
+                )
+            elif parsed.path == "/flight":
+                rec = flight_mod.get()
+                if rec is None:
+                    self._reply(
+                        404,
+                        _json_bytes({"error": "no flight recorder installed"}),
+                        "application/json",
+                    )
+                else:
+                    self._reply(
+                        200,
+                        _json_bytes(rec.payload("live")),
+                        "application/json",
+                    )
+            elif parsed.path == "/healthz":
+                self._reply(200, _json_bytes({"status": "ok"}), "application/json")
+            else:
+                self._reply(
+                    404, _json_bytes({"error": "not_found"}), "application/json"
+                )
+        except Exception as e:  # noqa: BLE001 - a scrape bug must not kill
+            # the handler thread (and 500 is the right scrape outcome)
+            try:
+                self._reply(500, _json_bytes({"error": repr(e)}), "application/json")
+            except OSError:
+                pass
+
+    def do_POST(self) -> None:  # noqa: N802
+        try:
+            parsed = urlparse(self.path)
+            # Drain any body so keep-alive connections stay in sync.
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                self.rfile.read(min(length, 1 << 16))
+            if parsed.path == "/profile":
+                trigger = self.server.profile_trigger  # type: ignore[attr-defined]
+                if trigger is None:
+                    self._reply(
+                        404,
+                        _json_bytes(
+                            {"error": "no profile trigger (not a train run?)"}
+                        ),
+                        "application/json",
+                    )
+                    return
+                q = parse_qs(parsed.query)
+                steps = int(q.get("steps", [DEFAULT_PROFILE_STEPS])[0])
+                trigger.request(steps)
+                self._reply(
+                    200,
+                    _json_bytes({"requested_steps": max(1, steps)}),
+                    "application/json",
+                )
+            else:
+                self._reply(
+                    404, _json_bytes({"error": "not_found"}), "application/json"
+                )
+        except Exception as e:  # noqa: BLE001 - same contract as do_GET
+            try:
+                self._reply(500, _json_bytes({"error": repr(e)}), "application/json")
+            except OSError:
+                pass
+
+
+class MetricsHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(
+        self,
+        addr: Tuple[str, int],
+        bus: MetricsBus,
+        profile_trigger: Optional[ProfileTrigger] = None,
+    ):
+        super().__init__(addr, _Handler)
+        self.bus = bus
+        self.profile_trigger = profile_trigger
+
+
+def start_metrics_server(
+    port: int,
+    bus: Optional[MetricsBus] = None,
+    profile_trigger: Optional[ProfileTrigger] = None,
+    host: str = "127.0.0.1",
+) -> MetricsHTTPServer:
+    """Bind + serve on a daemon thread; ``port=0`` binds an ephemeral
+    port (read it back from ``server.server_address``). The bound port is
+    logged so an operator can find it in the run log."""
+    server = MetricsHTTPServer(
+        (host, int(port)), bus if bus is not None else bus_mod.BUS,
+        profile_trigger,
+    )
+    thread = threading.Thread(
+        target=server.serve_forever, name="obs-metrics-http", daemon=True
+    )
+    thread.start()
+    bound = server.server_address[1]
+    logger.info(f"[obs] metrics endpoint: http://{host}:{bound}/metrics")
+    return server

@@ -389,8 +389,12 @@ def data_plane_counters() -> Dict[str, int]:
     """Snapshot of the data-plane guard counters (reads, retries,
     handle reopens, quarantined samples, fallback reads, stall trips,
     loader deaths) — the ops-facing view of
-    ``seist_tpu.data.io_guard.COUNTERS``. Train-worker epoch logs and
-    the BENCH ``data_plane`` section (bench.py) read the same source."""
+    ``seist_tpu.data.io_guard.COUNTERS``. Train-worker epoch logs, the
+    BENCH ``data_plane`` section (bench.py) AND the metrics bus's
+    ``data_plane`` collector (obs/bus.py
+    ``register_default_collectors``, i.e. the ``seist_data_plane_*``
+    Prometheus series on ``--metrics-port``) all read through this one
+    function, so the surfaces can never disagree."""
     from seist_tpu.data.io_guard import COUNTERS
 
     return COUNTERS.snapshot()

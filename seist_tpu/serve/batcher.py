@@ -132,6 +132,20 @@ class MicroBatcher:
         self._batch_items = 0  # real traces forwarded
         self._batch_slots = 0  # bucket slots forwarded (incl. padding)
         self.latency_ms = LatencyHistogram()
+        # Publish on the process metrics bus (obs/bus.py): scrape-time
+        # collector, so the stats stay single-sourced behind self._cond
+        # and appear as seist_serve_batcher_*{model=...} in Prometheus
+        # exposition (serve /metrics?format=prometheus, --metrics-port).
+        # Keyed by model name ONLY: a fresh batcher replaces the one it
+        # succeeds even when the old one was dropped without shutdown —
+        # two registrations with identical labels would render duplicate
+        # series, which Prometheus rejects for the whole scrape.
+        from seist_tpu.obs.bus import BUS
+
+        self._collector_key = f"serve_batcher:{name}"
+        BUS.register_collector(
+            self._collector_key, self.stats, name="serve_batcher", model=name
+        )
         self._thread = threading.Thread(
             target=self._loop, name=f"batcher-{name}", daemon=True
         )
@@ -302,6 +316,11 @@ class MicroBatcher:
                 self._queue.clear()
             self._cond.notify_all()
         self._thread.join(timeout=timeout_s)
+        from seist_tpu.obs.bus import BUS
+
+        # fn-guarded: if a successor batcher already took this key, the
+        # old instance's shutdown must not unregister it.
+        BUS.unregister_collector(self._collector_key, fn=self.stats)
 
     @property
     def healthy(self) -> bool:

@@ -97,6 +97,12 @@ class ServeService:
         # exactly what a load balancer wants.
         self._warming = True
         self._warmup_error: Optional[BaseException] = None
+        # Metrics-bus collector (obs/bus.py): the request/annotate half
+        # of metrics(); batchers self-register their own. One key per
+        # service — a restarted service replaces its predecessor.
+        from seist_tpu.obs.bus import BUS
+
+        BUS.register_collector("serve", self._bus_metrics)
         if warmup_async:
             threading.Thread(
                 target=self._run_warmup, name="serve-warmup", daemon=True
@@ -291,6 +297,21 @@ class ServeService:
             },
         }
 
+    def _bus_metrics(self) -> Dict[str, Any]:
+        """The bus-collector payload: everything in :meth:`metrics` except
+        the per-model stats (batchers publish those themselves, labeled)."""
+        m = self.metrics()
+        m.pop("models", None)
+        return m
+
+    def metrics_prometheus(self) -> str:
+        """Prometheus text exposition of the process bus — the serve
+        process's scrape surface (``GET /metrics?format=prometheus``),
+        same renderer as the train worker's --metrics-port."""
+        from seist_tpu.obs.bus import BUS, render_prometheus
+
+        return render_prometheus(BUS)
+
     # ----------------------------------------------------------- shutdown
     def begin_drain(self) -> None:
         """Flip to not-ready (new /predict //annotate get 503, readiness
@@ -304,6 +325,12 @@ class ServeService:
         self._draining = True
         for batcher in self._batchers.values():
             batcher.shutdown(drain=drain)
+        # Mirror the batchers: a shut-down service must neither pin the
+        # model pool via the bus's collector ref nor report its stale
+        # request counters as live on a later scrape.
+        from seist_tpu.obs.bus import BUS
+
+        BUS.unregister_collector("serve", fn=self._bus_metrics)
 
 
 def _clip_picks(result: Dict[str, Any], n_real: int, fs: float) -> None:
@@ -364,6 +391,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, status: int, text: str, ctype: str) -> None:
+        body = text.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         try:
             if self.path == "/healthz":
@@ -382,8 +417,22 @@ class _Handler(BaseHTTPRequestHandler):
                     200 if ready else 503,
                     {"status": self.service._state_str(), "ready": ready},
                 )
-            elif self.path == "/metrics":
-                self._reply(200, self.service.metrics())
+            elif self.path.split("?", 1)[0] == "/metrics":
+                # ?format=prometheus selects text exposition regardless
+                # of other params/ordering (real scrapers append job
+                # labels etc.); bare /metrics stays the back-compat JSON
+                # (docs/OBSERVABILITY.md).
+                from urllib.parse import parse_qs, urlparse
+
+                query = parse_qs(urlparse(self.path).query)
+                if "prometheus" in query.get("format", []):
+                    self._reply_text(
+                        200,
+                        self.service.metrics_prometheus(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                else:
+                    self._reply(200, self.service.metrics())
             else:
                 self._reply(404, {"error": "not_found", "message": self.path})
         except Exception as e:  # noqa: BLE001

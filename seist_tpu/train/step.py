@@ -79,6 +79,30 @@ def resolve_donation(donate: Tuple[int, ...]) -> Tuple[int, ...]:
     return donate
 
 
+def _first_call_span(jitted: Callable, name: str) -> Callable:
+    """Record the jitted function's FIRST invocation as a bus span
+    (``jit_first_call_ms{fn=...}``, obs/bus.py) — on a fresh process that
+    call IS the compile (minutes for the big models), historically
+    invisible outside stderr. Steady-state cost: one truthiness check per
+    call."""
+    from functools import wraps
+
+    done: list = []
+
+    @wraps(jitted)
+    def call(*args, **kwargs):
+        if done:
+            return jitted(*args, **kwargs)
+        from seist_tpu.obs.bus import BUS
+
+        with BUS.span("jit_first_call", fn=name):
+            out = jitted(*args, **kwargs)
+        done.append(1)
+        return out
+
+    return call
+
+
 def _apply_transforms(spec: TaskSpec, outputs, targets):
     if spec.targets_transform_for_loss is not None:
         targets = spec.targets_transform_for_loss(targets)
@@ -317,14 +341,19 @@ def jit_device_aug_step(step_fn: Callable, mesh: Optional[Mesh]) -> Callable:
     in_shardings of the next consumer (the eval step)."""
     donate = resolve_donation((0,))
     if mesh is None:
-        return jax.jit(step_fn, donate_argnums=donate)
+        return _first_call_span(
+            jax.jit(step_fn, donate_argnums=donate), "device_aug_step"
+        )
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
-    return jax.jit(
-        step_fn,
-        in_shardings=(repl, data, data, data, repl, repl),
-        out_shardings=repl,
-        donate_argnums=donate,
+    return _first_call_span(
+        jax.jit(
+            step_fn,
+            in_shardings=(repl, data, data, data, repl, repl),
+            out_shardings=repl,
+            donate_argnums=donate,
+        ),
+        "device_aug_step",
     )
 
 
@@ -387,20 +416,26 @@ def jit_cached_call(call_fn: Callable, mesh: Optional[Mesh], cache) -> Callable:
     consulted for its pytree structure."""
     donate = resolve_donation((0,))
     if mesh is None:
-        return jax.jit(call_fn, donate_argnums=donate)
+        return _first_call_span(
+            jax.jit(call_fn, donate_argnums=donate), "cached_call"
+        )
     import jax.tree_util as jtu
 
     repl = NamedSharding(mesh, P())
     row_sh = jtu.tree_map(lambda _: NamedSharding(mesh, P("data")), cache)
     idx_sh = NamedSharding(mesh, P(None, "data"))
-    return jax.jit(
-        call_fn,
-        in_shardings=(repl, row_sh, idx_sh, repl, repl),
-        # Replicated outputs: GSPMD would otherwise be free to hand back
-        # data-sharded state leaves that clash with the eval step's
-        # replicated in_shardings (observed live on the 8-dev CPU mesh).
-        out_shardings=NamedSharding(mesh, P()),
-        donate_argnums=donate,
+    return _first_call_span(
+        jax.jit(
+            call_fn,
+            in_shardings=(repl, row_sh, idx_sh, repl, repl),
+            # Replicated outputs: GSPMD would otherwise be free to hand
+            # back data-sharded state leaves that clash with the eval
+            # step's replicated in_shardings (observed live on the 8-dev
+            # CPU mesh).
+            out_shardings=NamedSharding(mesh, P()),
+            donate_argnums=donate,
+        ),
+        "cached_call",
     )
 
 
@@ -529,6 +564,7 @@ def jit_step(
     donate_state: bool = True,
     n_batch_args: int = 2,
     n_extra_args: int = 1,
+    span_name: str = "train_step",
 ) -> Callable:
     """Jit a step function with mesh shardings. Defaults fit the *train* step
     ``(state, inputs, targets, rng)``; for eval steps use :func:`jit_eval_step`.
@@ -540,11 +576,16 @@ def jit_step(
     """
     donate = resolve_donation((0,)) if donate_state else ()
     if mesh is None:
-        return jax.jit(step_fn, donate_argnums=donate)
+        return _first_call_span(
+            jax.jit(step_fn, donate_argnums=donate), span_name
+        )
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
     in_shardings = (repl,) + (data,) * n_batch_args + (repl,) * n_extra_args
-    return jax.jit(step_fn, in_shardings=in_shardings, donate_argnums=donate)
+    return _first_call_span(
+        jax.jit(step_fn, in_shardings=in_shardings, donate_argnums=donate),
+        span_name,
+    )
 
 
 def jit_multi_step(
@@ -559,11 +600,17 @@ def jit_multi_step(
     """
     donate = resolve_donation((0,)) if donate_state else ()
     if mesh is None:
-        return jax.jit(step_fn, donate_argnums=donate)
+        return _first_call_span(
+            jax.jit(step_fn, donate_argnums=donate), "multi_step"
+        )
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P(None, "data"))
-    return jax.jit(
-        step_fn, in_shardings=(repl, data, data, repl), donate_argnums=donate
+    return _first_call_span(
+        jax.jit(
+            step_fn, in_shardings=(repl, data, data, repl),
+            donate_argnums=donate,
+        ),
+        "multi_step",
     )
 
 
@@ -575,7 +622,8 @@ def jit_eval_step(step_fn: Callable, mesh: Optional[Mesh] = None) -> Callable:
     batch-sharded on ``data``.
     """
     return jit_step(
-        step_fn, mesh=mesh, donate_state=False, n_batch_args=3, n_extra_args=0
+        step_fn, mesh=mesh, donate_state=False, n_batch_args=3,
+        n_extra_args=0, span_name="eval_step",
     )
 
 

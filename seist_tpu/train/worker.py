@@ -20,13 +20,12 @@ import os
 import signal
 import sys
 import threading
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-from seist_tpu import taskspec
+from seist_tpu import obs, taskspec
 from seist_tpu.data import io_guard, pipeline
 from seist_tpu.models import api
 from seist_tpu.ops import Metrics, ResultSaver, process_outputs
@@ -55,7 +54,12 @@ from seist_tpu.utils import faults as faults_lib
 from seist_tpu.utils import profiling
 from seist_tpu.utils.logger import logger
 from seist_tpu.utils.meters import AverageMeter, ProgressMeter
-from seist_tpu.utils.misc import count_params, get_safe_path, strftimedelta
+from seist_tpu.utils.misc import (
+    count_params,
+    get_safe_path,
+    get_time_str,
+    strftimedelta,
+)
 from seist_tpu.utils.tb import ScalarWriter
 
 
@@ -365,6 +369,44 @@ def validate(
     return loss_meter.avg, metrics_merged
 
 
+# Cleanup callbacks registered by the running worker (its _obs_close);
+# drained by _dump_flight_on_exception's finally so EVERY exit path —
+# return, sys.exit, uncaught exception — tears the telemetry plane down
+# (os._exit hard deaths skip it; the process is gone anyway).
+_OBS_CLEANUP: List[Any] = []
+
+
+def _dump_flight_on_exception(fn):
+    """Any uncaught exception in the wrapped worker leaves a flight-
+    recorder dump (reason ``exception``) before propagating — the crash
+    path that ISN'T one of the managed deaths (rollback/preempt/stall/
+    quarantine) still gets its forensic record. Deduped: a managed path
+    that already dumped seconds earlier doesn't leave a second file.
+    The finally drains _OBS_CLEANUP, so a crashed run cannot leak the
+    metrics HTTP port, the events fd, or the SIGUSR2 handler into the
+    process's next run."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        try:
+            return fn(*a, **k)
+        except Exception as e:
+            obs.flight.dump_on_death("exception", dedup_s=5.0, error=repr(e))
+            raise
+        finally:
+            while _OBS_CLEANUP:
+                cb = _OBS_CLEANUP.pop()
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001 - teardown must not mask
+                    # the real exception propagating out of the worker
+                    pass
+
+    return wrapper
+
+
+@_dump_flight_on_exception
 def train_worker(args: Any) -> str:
     """Full training run; returns the best checkpoint path
     (ref train.py:182-484)."""
@@ -761,6 +803,76 @@ def train_worker(args: Any) -> str:
         io_guard.StallWatchdog(wd_timeout).start() if wd_timeout > 0 else None
     )
 
+    # -- telemetry plane (docs/OBSERVABILITY.md) --------------------------
+    # Flight recorder: always on (a deque append per step — priced in
+    # BENCH step_breakdown.telemetry); every death path below dumps it.
+    # Any --flight-steps <= 0 falls back to the documented default
+    # rather than crashing the run at startup.
+    fsteps = int(getattr(args, "flight_steps", 0) or 0)
+    recorder = obs.FlightRecorder(capacity=fsteps if fsteps > 0 else 256)
+    obs.flight.install(recorder)
+    obs.register_default_collectors()
+    events = (
+        obs.EventLog(os.path.join(logger.logdir(), "events.jsonl"))
+        if is_main_process()
+        else None
+    )
+    # Opt-in Prometheus endpoint (--metrics-port; obs/http.py): >0 binds
+    # that loopback port, -1 an ephemeral one (logged), 0 disables.
+    profile_trigger = obs.ProfileTrigger()
+    metrics_server = None
+    mport = int(getattr(args, "metrics_port", 0) or 0)
+    if mport and is_main_process():
+        metrics_server = obs.start_metrics_server(
+            max(mport, 0), profile_trigger=profile_trigger
+        )
+    # SIGUSR2 -> on-demand profiler capture at the next step boundary
+    # (same window machinery as --profile-steps and POST /profile).
+    prev_usr2 = None
+    if (
+        threading.current_thread() is threading.main_thread()
+        and hasattr(signal, "SIGUSR2")
+    ):
+        def _on_usr2(signum, frame):
+            profile_trigger.request()
+            logger.info(
+                "[obs] SIGUSR2: profiler capture requested "
+                f"({obs.http.DEFAULT_PROFILE_STEPS} steps)"
+            )
+        prev_usr2 = signal.signal(signal.SIGUSR2, _on_usr2)
+
+    obs_closed = [False]
+
+    def _obs_close() -> None:
+        """Tear down the telemetry plane. Idempotent; runs on the normal
+        return, the preempt exit, AND — via _OBS_CLEANUP drained in the
+        _dump_flight_on_exception finally — every exception/SystemExit
+        path, so a crashed run cannot leave the metrics port bound or
+        the events fd open for the process's next run. Uninstalling the
+        recorder also unhooks its bus span sink, so back-to-back runs in
+        one process never stack sinks."""
+        if obs_closed[0]:
+            return
+        obs_closed[0] = True
+        obs.flight.install(None)
+        if events is not None:
+            events.close()
+        if metrics_server is not None:
+            metrics_server.shutdown()
+            metrics_server.server_close()  # release the listening port
+        if prev_usr2 is not None:
+            try:
+                signal.signal(signal.SIGUSR2, prev_usr2)
+            except ValueError:  # not the main thread anymore
+                pass
+
+    _OBS_CLEANUP.append(_obs_close)
+
+    def _emit_event(kind: str, **fields) -> None:
+        recorder.record_event(kind, **fields)
+        if events is not None:
+            events.emit(kind, **fields)
+
     def _step_out(ret):
         """Normalize (state, loss, outputs[, diag]) across guard on/off."""
         if len(ret) == 4:
@@ -776,18 +888,19 @@ def train_worker(args: Any) -> str:
             d_epoch, d_off = epoch + 1, 0
         else:
             d_epoch, d_off = epoch, batches_done
-        ckpt_mgr.save(
-            gstep,
-            state,
-            epoch=epoch,
-            data_epoch=d_epoch,
-            data_batch_offset=d_off,
-            seed=args.seed,
-            steps_per_epoch=steps_per_epoch,
-            batch_size=int(args.batch_size),
-            on_exists="skip",  # resume/rollback may legitimately re-reach
-            wait=wait,
-        )
+        with obs.BUS.span("checkpoint_save"):
+            ckpt_mgr.save(
+                gstep,
+                state,
+                epoch=epoch,
+                data_epoch=d_epoch,
+                data_batch_offset=d_off,
+                seed=args.seed,
+                steps_per_epoch=steps_per_epoch,
+                batch_size=int(args.batch_size),
+                on_exists="skip",  # resume/rollback may re-reach a step
+                wait=wait,
+            )
         return d_epoch, d_off
 
     def _rollback(state):
@@ -804,6 +917,20 @@ def train_worker(args: Any) -> str:
         logger.warning(
             f"Bad-update guard: {monitor.bad_run} consecutive non-finite "
             f"updates; rolling back to checkpoint step {step_r}"
+        )
+        # The run survives a rollback, but the steps leading into it are
+        # exactly what a post-mortem wants — snapshot them now, before
+        # the ring rolls past (docs/OBSERVABILITY.md).
+        _emit_event(
+            "bad_update_rollback",
+            rollback_to_step=int(step_r),
+            consecutive_bad=int(monitor.bad_run),
+        )
+        # arm_dedup=False: this dump is non-fatal (the run continues) and
+        # must never suppress the record of a crash seconds later.
+        obs.flight.dump_on_death(
+            "bad_update_rollback", arm_dedup=False,
+            rollback_to_step=int(step_r),
         )
         restored = ckpt_mgr.restore(state, step=step_r)
         monitor.reset()
@@ -829,11 +956,17 @@ def train_worker(args: Any) -> str:
             f"Preempted: checkpoint step {gstep} durable "
             f"(data position {d_epoch}:{d_off}); exiting {PREEMPT_EXIT_CODE}"
         )
+        _emit_event(
+            "preempt", gstep=int(gstep), data_epoch=int(d_epoch),
+            data_batch_offset=int(d_off), hard=bool(hard),
+        )
+        obs.flight.dump_on_death("preempt", gstep=int(gstep))
         if writer is not None:
             writer.close()
         train_loader.close()
         val_loader.close()
         ckpt_mgr.close()
+        _obs_close()
         if hard:
             io_guard.hard_exit(PREEMPT_EXIT_CODE)
         sys.exit(PREEMPT_EXIT_CODE)
@@ -870,7 +1003,8 @@ def train_worker(args: Any) -> str:
     # --profile-steps N: capture a jax.profiler trace of N steady-state
     # OPTIMIZER steps (skipping compile/warmup) in the first trained epoch.
     # Counted in optimizer steps regardless of the packed path (each loop
-    # iteration advances `updates_per_call` of them).
+    # iteration advances `updates_per_call` of them). Later captures are
+    # re-armed on demand: SIGUSR2 or POST /profile on --metrics-port.
     profile_steps = int(getattr(args, "profile_steps", 0) or 0)
     # Batches consumed per loop iteration on the packed path (steps-per-call
     # runs kpack updates/call; grad accumulation runs ONE update over kpack
@@ -880,6 +1014,18 @@ def train_worker(args: Any) -> str:
     updates_per_call = 1 if gas > 1 else spc
     profile_from = 2 * updates_per_call  # skip the first two loop iterations
     tracing = False
+    trace_dir = ""
+
+    def _trace_dir() -> str:
+        # Unique per supervise attempt AND per capture window
+        # (timestamp + pid + no-clobber suffix): a relaunched run must
+        # never overwrite the previous attempt's trace.
+        return get_safe_path(
+            os.path.join(
+                logger.logdir(), "profile",
+                f"{get_time_str()}_p{os.getpid()}",
+            )
+        )
 
     monitor = _BadUpdateMonitor(max_bad)
     preempt = _PreemptionHandler()
@@ -904,26 +1050,45 @@ def train_worker(args: Any) -> str:
 
     def _maybe_trace(opt_step: int, loss) -> None:
         """``opt_step``: optimizer steps completed before this iteration."""
-        nonlocal tracing, profile_steps
-        if not (profile_steps and is_main_process()):
+        nonlocal tracing, profile_steps, profile_from, trace_dir
+        if not is_main_process():
+            return
+        if not tracing:
+            # On-demand capture (SIGUSR2 / POST /profile): open the
+            # window at the next step boundary. Consume ONLY when idle —
+            # a request arriving mid-capture stays in the trigger box and
+            # opens its own window once this one closes.
+            req = profile_trigger.consume()
+            if req:
+                profile_steps = req
+                profile_from = opt_step + updates_per_call
+                _emit_event("profile_requested", steps=req)
+        if not profile_steps:
             return
         if not tracing and opt_step >= profile_from:
-            profiling.trace_start(os.path.join(logger.logdir(), "profile"))
+            trace_dir = _trace_dir()
+            profiling.trace_start(trace_dir)
             tracing = True
         elif tracing and opt_step >= profile_from + profile_steps:
             jax.block_until_ready(loss)
             profiling.trace_stop()
             tracing = False
-            profile_steps = 0  # first epoch only
-            logger.info(
-                f"Profiler trace saved: {os.path.join(logger.logdir(), 'profile')}"
-            )
+            profile_steps = 0  # one-shot; the trigger re-arms it
+            logger.info(f"Profiler trace saved: {trace_dir}")
+
+    # Bus handles resolved once (a per-step gauge set is then one lock,
+    # no registry lookup). All interval clocks below are obs spans on the
+    # shared monotonic source: an NTP step or suspend must not corrupt
+    # ETA/throughput math on a days-long run; time.time() remains only
+    # where a real timestamp is reported.
+    g_loss = obs.BUS.gauge("train_loss")
+    g_wps = obs.BUS.gauge("waveforms_per_sec")
+    g_epoch = obs.BUS.gauge("epoch")
+    g_gstep = obs.BUS.gauge("global_step")
 
     for epoch in range(start_epoch, epochs):
-        # Interval clocks (epoch time, wave/s) are monotonic: an NTP step
-        # or suspend must not corrupt ETA/throughput math on a days-long
-        # run. time.time() remains only where a real timestamp is reported.
-        t0 = time.monotonic()
+        epoch_span = obs.BUS.begin("train_epoch")
+        g_epoch.set(epoch)
         train_loader.set_epoch(epoch)
         skip = start_batch if epoch == start_epoch else 0
         if skip and kpack > 1 and skip % kpack:
@@ -947,7 +1112,9 @@ def train_worker(args: Any) -> str:
         progress = ProgressMeter(
             steps_per_epoch, [loss_meter, wps_meter], prefix=f"Epoch[{epoch}] "
         )
-        t_step = time.monotonic()
+        # Log-interval clock for wave/s: span begin/end pairs replace the
+        # old ad-hoc time.monotonic() bookkeeping.
+        rate_span = obs.BUS.begin("log_interval")
         # Device->host transfers are confined to every --log-step steps:
         # pulling loss/outputs every step serializes JAX's async dispatch
         # and stalls the chip on host postprocess (the per-step numbers are
@@ -982,16 +1149,21 @@ def train_worker(args: Any) -> str:
                 ),
                 start=skip // kpack,
             ):
-                faults.on_step(
-                    epoch * steps_per_epoch + call * kpack, n_steps=kpack
-                )
+                gstep = epoch * steps_per_epoch + call * kpack
+                # Record BEFORE the spans of this step end, so the
+                # recorder tags them with the step that is actually
+                # running — the dying step's spans must carry its number.
+                recorder.record_step(gstep)
+                g_gstep.set(gstep)
+                faults.on_step(gstep, n_steps=kpack)
                 idx_dev = mesh_lib.shard_stacked_batch(mesh, idx_k)
-                state, loss, _, diag = _step_out(
-                    train_step(
-                        state, dev_cache.arrays, idx_dev,
-                        jnp.int32(epoch), epoch_rng,
+                with obs.BUS.span("step_dispatch"):
+                    state, loss, _, diag = _step_out(
+                        train_step(
+                            state, dev_cache.arrays, idx_dev,
+                            jnp.int32(epoch), epoch_rng,
+                        )
                     )
-                )
                 deferred_losses.append(loss)
                 if diag is not None and monitor.push(diag["applied"]):
                     state = _rollback(state)
@@ -1014,13 +1186,15 @@ def train_worker(args: Any) -> str:
                 if call % args.log_step == 0:
                     loss_f = float(loss)
                     loss_meter.update(loss_f, 1)
-                    now = time.monotonic()
+                    interval = rate_span.end()
+                    rate_span = obs.BUS.begin("log_interval")
                     calls_done = min(args.log_step, call) or 1
                     wps_meter.update(
                         global_bs * kpack * calls_done
-                        / max(now - t_step, 1e-9)
+                        / max(interval, 1e-9)
                     )
-                    t_step = now
+                    g_loss.set(loss_f)
+                    g_wps.set(wps_meter.val)
                     if writer is not None:
                         writer.add_scalar(
                             "train-loss/step",
@@ -1042,32 +1216,38 @@ def train_worker(args: Any) -> str:
             import jax.numpy as jnp
 
             for step, (rows, idx, aug) in enumerate(
-                io_guard.watch(
-                    pipeline.prefetch_raw_to_device(
-                        pipeline.iter_raw_batches(
-                            dev_store,
-                            epoch,
-                            seed=args.seed,
-                            shuffle=args.shuffle,
-                            batch_size=args.batch_size,
-                            num_shards=jax.process_count(),
-                            shard_index=jax.process_index(),
-                            start_batch=skip,
+                obs.timed_iter(
+                    io_guard.watch(
+                        pipeline.prefetch_raw_to_device(
+                            pipeline.iter_raw_batches(
+                                dev_store,
+                                epoch,
+                                seed=args.seed,
+                                shuffle=args.shuffle,
+                                batch_size=args.batch_size,
+                                num_shards=jax.process_count(),
+                                shard_index=jax.process_index(),
+                                start_batch=skip,
+                            ),
+                            mesh,
                         ),
-                        mesh,
+                        watchdog,
                     ),
-                    watchdog,
+                    "host_wait",
                 ),
                 start=skip,
             ):
                 batches_done = step + 1
                 gstep = epoch * steps_per_epoch + step
+                recorder.record_step(gstep)  # before this step's spans end
+                g_gstep.set(gstep)
                 faults.on_step(gstep)
-                state, loss, _, diag = _step_out(
-                    train_step(
-                        state, rows, idx, aug, jnp.int32(epoch), epoch_rng
+                with obs.BUS.span("step_dispatch"):
+                    state, loss, _, diag = _step_out(
+                        train_step(
+                            state, rows, idx, aug, jnp.int32(epoch), epoch_rng
+                        )
                     )
-                )
                 deferred_losses.append(loss)
                 if diag is not None and monitor.push(diag["applied"]):
                     state = _rollback(state)
@@ -1080,12 +1260,14 @@ def train_worker(args: Any) -> str:
                 if step % args.log_step == 0:
                     loss_f = float(loss)
                     loss_meter.update(loss_f, 1)
-                    now = time.monotonic()
+                    interval = rate_span.end()
+                    rate_span = obs.BUS.begin("log_interval")
                     steps_done = min(args.log_step, step) or 1
                     wps_meter.update(
-                        global_bs * steps_done / max(now - t_step, 1e-9)
+                        global_bs * steps_done / max(interval, 1e-9)
                     )
-                    t_step = now
+                    g_loss.set(loss_f)
+                    g_wps.set(wps_meter.val)
                     if writer is not None:
                         writer.add_scalar("train-loss/step", loss_f, gstep)
                     if is_main_process():
@@ -1099,21 +1281,27 @@ def train_worker(args: Any) -> str:
             # accumulated update (--grad-accum-steps). The per-call loss is
             # already the mean over its micro-batches.
             for call, (xk, yk) in enumerate(
-                io_guard.watch(
-                    pipeline.prefetch_packed_to_device(
-                        iter(train_loader), mesh, kpack
+                obs.timed_iter(
+                    io_guard.watch(
+                        pipeline.prefetch_packed_to_device(
+                            iter(train_loader), mesh, kpack
+                        ),
+                        watchdog,
+                        on_death=_on_loader_death,
                     ),
-                    watchdog,
-                    on_death=_on_loader_death,
+                    "host_wait",
                 ),
                 start=skip // kpack,
             ):
                 first_b = epoch * steps_per_epoch + call * kpack
+                recorder.record_step(first_b)  # before this call's spans end
+                g_gstep.set(first_b)
                 faults.on_step(first_b, n_steps=kpack)
                 xk = faults.corrupt_inputs(first_b, xk, n_steps=kpack)
-                state, loss, _, diag = _step_out(
-                    train_step(state, xk, yk, epoch_rng)
-                )
+                with obs.BUS.span("step_dispatch"):
+                    state, loss, _, diag = _step_out(
+                        train_step(state, xk, yk, epoch_rng)
+                    )
                 deferred_losses.append(loss)
                 if diag is not None and monitor.push(diag["applied"]):
                     state = _rollback(state)
@@ -1136,13 +1324,15 @@ def train_worker(args: Any) -> str:
                 if call % args.log_step == 0:
                     loss_f = float(loss)
                     loss_meter.update(loss_f, 1)
-                    now = time.monotonic()
+                    interval = rate_span.end()
+                    rate_span = obs.BUS.begin("log_interval")
                     calls_done = min(args.log_step, call) or 1
                     wps_meter.update(
                         global_bs * kpack * calls_done
-                        / max(now - t_step, 1e-9)
+                        / max(interval, 1e-9)
                     )
-                    t_step = now
+                    g_loss.set(loss_f)
+                    g_wps.set(wps_meter.val)
                     if writer is not None:
                         writer.add_scalar(
                             "train-loss/step",
@@ -1157,20 +1347,28 @@ def train_worker(args: Any) -> str:
 
         else:
             for step, batch in enumerate(
-                io_guard.watch(
-                    pipeline.prefetch_to_device(iter(train_loader), mesh),
-                    watchdog,
-                    on_death=_on_loader_death,
+                obs.timed_iter(
+                    io_guard.watch(
+                        pipeline.prefetch_to_device(iter(train_loader), mesh),
+                        watchdog,
+                        on_death=_on_loader_death,
+                    ),
+                    "host_wait",
                 ),
                 start=skip,
             ):
                 batches_done = step + 1
                 gstep = epoch * steps_per_epoch + step
+                recorder.record_step(gstep)  # before this step's spans end
+                g_gstep.set(gstep)
                 faults.on_step(gstep)
                 inputs = faults.corrupt_inputs(gstep, batch.inputs)
-                state, loss, outputs, diag = _step_out(
-                    train_step(state, inputs, batch.loss_targets, epoch_rng)
-                )
+                with obs.BUS.span("step_dispatch"):
+                    state, loss, outputs, diag = _step_out(
+                        train_step(
+                            state, inputs, batch.loss_targets, epoch_rng
+                        )
+                    )
                 deferred_losses.append(loss)
                 if diag is not None and monitor.push(diag["applied"]):
                     state = _rollback(state)
@@ -1184,12 +1382,14 @@ def train_worker(args: Any) -> str:
                 if step % args.log_step == 0:
                     loss_f = float(loss)
                     loss_meter.update(loss_f, 1)
-                    now = time.monotonic()
+                    interval = rate_span.end()
+                    rate_span = obs.BUS.begin("log_interval")
                     steps_done = min(args.log_step, step) or 1
                     wps_meter.update(
-                        global_bs * steps_done / max(now - t_step, 1e-9)
+                        global_bs * steps_done / max(interval, 1e-9)
                     )
-                    t_step = now
+                    g_loss.set(loss_f)
+                    g_wps.set(wps_meter.val)
 
                     results = _postprocess_batch(args, spec, outputs, fs)
                     batch_metrics = _make_metrics(args, tasks, fs)
@@ -1220,7 +1420,7 @@ def train_worker(args: Any) -> str:
             profiling.trace_stop()
             tracing = False
             profile_steps = 0
-            logger.info("Profiler trace saved (short epoch)")
+            logger.info(f"Profiler trace saved (short epoch): {trace_dir}")
 
         if monitor.flush():  # lagging guard flags from the epoch tail
             state = _rollback(state)
@@ -1247,6 +1447,11 @@ def train_worker(args: Any) -> str:
                 f"[data-plane] epoch {epoch} quarantine report: "
                 f"{json.dumps(q_report)}"
             )
+            _emit_event(
+                "quarantine_report", epoch=epoch,
+                quarantined=len(q_report["quarantined"]),
+                frac=q_report["frac"],
+            )
         if io_guard.COUNTERS.any_faults():
             logger.info(
                 f"[data-plane] counters: {io_guard.COUNTERS.snapshot()}"
@@ -1254,12 +1459,14 @@ def train_worker(args: Any) -> str:
 
         # -- validate + checkpoint (ref train.py:402-415) ---------------------
         try:
-            val_loss, val_metrics = validate(
-                args, state, eval_step, spec, val_loader, mesh,
-                watchdog=watchdog,
-            )
+            with obs.BUS.span("validate"):
+                val_loss, val_metrics = validate(
+                    args, state, eval_step, spec, val_loader, mesh,
+                    watchdog=watchdog,
+                )
         except io_guard.LoaderDeathError as e:
             _loader_death_exit(e, state, epoch, steps_per_epoch)
+        obs.BUS.gauge("val_loss").set(val_loss)
         val_losses.append(val_loss)
         if writer is not None:
             writer.add_scalar("train-loss/epoch", epoch_train_loss, epoch)
@@ -1307,13 +1514,23 @@ def train_worker(args: Any) -> str:
         if preempt.triggered:  # SIGTERM during validation
             _preempt_exit(state, epoch, steps_per_epoch, epoch_end_step)
 
-        dt = time.monotonic() - t0
+        dt = epoch_span.end()
         epoch_times.append(dt)
         eta = float(np.mean(epoch_times)) * (epochs - epoch - 1)
         logger.info(
             f"Epoch {epoch}: train-loss {epoch_train_loss:.4e} "
             f"val-loss {val_loss:.4e} best {best_loss:.4e} "
             f"time {strftimedelta(dt)} ETA {strftimedelta(eta)}"
+        )
+        _emit_event(
+            "epoch_summary",
+            epoch=epoch,
+            train_loss=round(epoch_train_loss, 6),
+            val_loss=round(float(val_loss), 6),
+            best_loss=round(float(best_loss), 6),
+            epoch_time_s=round(dt, 3),
+            wps=round(wps_meter.val, 1),
+            data_plane=io_guard.COUNTERS.snapshot(),
         )
 
     preempt.__exit__()
@@ -1334,6 +1551,8 @@ def train_worker(args: Any) -> str:
         np.save(os.path.join(logger.logdir(), "val_losses.npy"), val_losses)
     if writer is not None:
         writer.close()
+    _emit_event("train_done", best_loss=round(float(best_loss), 6))
+    _obs_close()
     train_loader.close()
     val_loader.close()
     return best_ckpt_path

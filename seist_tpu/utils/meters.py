@@ -107,6 +107,14 @@ class LatencyHistogram:
         with self._lock:
             return self._sum / self._count if self._count else 0.0
 
+    def buckets(self) -> "tuple[List[float], List[int], int, float]":
+        """Consistent snapshot ``(bounds, counts, count, sum)`` — counts
+        has ``len(bounds) + 1`` entries (last = overflow). The raw-bucket
+        view the obs bus renders as cumulative Prometheus ``_bucket``
+        series (seist_tpu/obs/bus.py)."""
+        with self._lock:
+            return list(self._bounds), list(self._counts), self._count, self._sum
+
     def summary(self) -> Dict[str, float]:
         """{count, mean, p50, p90, p99, max} — the /metrics payload."""
         return {

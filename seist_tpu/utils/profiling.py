@@ -45,17 +45,15 @@ def trace(logdir: str) -> Iterator[None]:
 def stopwatch() -> Iterator[Callable[[], float]]:
     """``with stopwatch() as elapsed:`` — ``elapsed()`` returns seconds
     since entry (monotonic), both inside the block and after it exits.
-    Used by serve warmup/handlers so timing reads the same everywhere."""
-    t0 = time.perf_counter()
-    done = []
+    Used by serve warmup/handlers so timing reads the same everywhere.
 
-    def elapsed() -> float:
-        return (done[0] if done else time.perf_counter()) - t0
+    Thin re-export of the obs bus's timing primitive (obs/bus.py): every
+    interval in the repo reads ONE monotonic clock, so the span API, this
+    stopwatch and :class:`StepTimeSplit` can never drift apart."""
+    from seist_tpu.obs.bus import stopwatch as _stopwatch
 
-    try:
+    with _stopwatch() as elapsed:
         yield elapsed
-    finally:
-        done.append(time.perf_counter())
 
 
 def device_memory_stats() -> List[Dict[str, float]]:
@@ -88,10 +86,29 @@ class StepTimeSplit:
         self.skip_first = int(skip_first)
         self.host_s: List[float] = []
         self.device_s: List[float] = []
+        self._pending_host: Optional[float] = None
 
     def step(self, host_s: float, device_s: float) -> None:
         self.host_s.append(float(host_s))
         self.device_s.append(float(device_s))
+
+    @contextlib.contextmanager
+    def host(self) -> Iterator[None]:
+        """Time the host half of one step (batch fetch/stack/stage) on
+        the shared obs stopwatch; pair with :meth:`device`, which records
+        the completed (host, device) step."""
+        with stopwatch() as elapsed:
+            yield
+        self._pending_host = elapsed()
+
+    @contextlib.contextmanager
+    def device(self) -> Iterator[None]:
+        """Time the device half (dispatch→block_until_ready) and record
+        the step with the pending host time from :meth:`host`."""
+        with stopwatch() as elapsed:
+            yield
+        self.step(self._pending_host or 0.0, elapsed())
+        self._pending_host = None
 
     def summary(self) -> Dict[str, object]:
         h = self.host_s[self.skip_first :]

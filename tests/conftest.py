@@ -126,6 +126,8 @@ _SMOKE_FILES = {
     "test_collective_report.py",
     "test_jaxlint.py",
     "test_io_guard.py",
+    "test_obs.py",
+    "test_meters.py",
 }
 
 

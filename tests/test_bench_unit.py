@@ -66,7 +66,9 @@ def test_fail_replays_only_matching_config(bench, capsys):
     assert out["cached"] is True
     assert out["error"] == "backend unavailable"
 
-    # ANY differing key (dtype here) -> no replay, honest zero.
+    # ANY differing key (dtype here) -> no replay, honest zero — stamped
+    # with an EXPLICIT cached=False (schema v2: absence of the marker
+    # must never read as freshness).
     bench._fail(
         "m_train_throughput",
         "waveforms/sec/chip",
@@ -75,7 +77,8 @@ def test_fail_replays_only_matching_config(bench, capsys):
                 "steps_per_call": 1},
     )
     out = _emitted(capsys)
-    assert out["value"] == 0 and "cached" not in out
+    assert out["value"] == 0 and out["cached"] is False
+    assert out["schema_version"] == bench._SCHEMA_VERSION
 
 
 def test_fail_stream_config_includes_stride_and_record(bench, capsys):

@@ -1,0 +1,135 @@
+"""Edge-case coverage for utils/meters.LatencyHistogram (the histogram
+backing serve's /metrics AND the obs bus exposition) and the
+ops/metrics.Metrics.to_dict single-batched-transfer contract."""
+
+import numpy as np
+import pytest
+
+from seist_tpu.utils.meters import LATENCY_BOUNDS_MS, AverageMeter, LatencyHistogram
+
+
+# ------------------------------------------------------- LatencyHistogram
+def test_empty_histogram_percentiles_and_summary():
+    h = LatencyHistogram()
+    assert h.count == 0
+    assert h.mean == 0.0
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.percentile(q) == 0.0
+    s = h.summary()
+    assert s == {"count": 0.0, "mean": 0.0, "p50": 0.0, "p90": 0.0,
+                 "p99": 0.0, "max": 0.0}
+
+
+def test_single_sample_percentiles_clamped_to_observed():
+    h = LatencyHistogram()
+    h.observe(3.0)
+    # Every quantile of a single observation IS that observation; the
+    # in-bucket interpolation must clamp to the observed max.
+    for q in (0.0, 0.5, 1.0):
+        assert h.percentile(q) <= 3.0
+    assert h.percentile(1.0) == 3.0
+    assert h.summary()["max"] == 3.0
+    assert h.summary()["count"] == 1.0
+
+
+def test_overflow_bucket_above_last_bound():
+    h = LatencyHistogram(bounds=(1.0, 10.0))
+    h.observe(5.0)
+    h.observe(999.0)  # overflow bucket
+    bounds, counts, count, total = h.buckets()
+    assert bounds == [1.0, 10.0]
+    assert counts == [0, 1, 1]  # last entry = overflow
+    assert count == 2 and total == pytest.approx(1004.0)
+    # Quantiles inside the overflow bucket interpolate toward the max.
+    assert h.percentile(1.0) == 999.0
+    assert h.percentile(0.99) <= 999.0
+    assert h.summary()["max"] == 999.0
+
+
+def test_exactly_on_bound_goes_to_lower_bucket():
+    h = LatencyHistogram(bounds=(1.0, 10.0))
+    h.observe(1.0)  # bisect_left: lands in the <=1.0 bucket
+    _, counts, _, _ = h.buckets()
+    assert counts == [1, 0, 0]
+
+
+def test_unsorted_bounds_rejected():
+    with pytest.raises(ValueError):
+        LatencyHistogram(bounds=(10.0, 1.0))
+
+
+def test_percentile_out_of_range_rejected():
+    h = LatencyHistogram()
+    with pytest.raises(ValueError):
+        h.percentile(1.5)
+    with pytest.raises(ValueError):
+        h.percentile(-0.1)
+
+
+def test_buckets_snapshot_is_consistent_copy():
+    h = LatencyHistogram(bounds=(1.0,))
+    h.observe(0.5)
+    bounds, counts, _, _ = h.buckets()
+    counts[0] = 999  # mutating the snapshot must not touch the histogram
+    assert h.buckets()[1] == [1, 0]
+
+
+def test_default_bounds_sorted_and_nonempty():
+    assert list(LATENCY_BOUNDS_MS) == sorted(LATENCY_BOUNDS_MS)
+    assert len(LATENCY_BOUNDS_MS) >= 5
+
+
+def test_average_meter_running_stats():
+    m = AverageMeter("x", ":.2f")
+    m.update(1.0)
+    m.update(3.0, n=3)
+    assert m.val == 3.0
+    assert m.count == 4
+    assert m.avg == pytest.approx((1.0 + 9.0) / 4)
+
+
+# ---------------------------------------- Metrics.to_dict transfer contract
+def test_metrics_to_dict_single_batched_device_get(monkeypatch):
+    """to_dict must fetch ALL counters in ONE jax.device_get (the old
+    per-key .item() loop was one device sync per counter — jaxlint's
+    host-sync catch, PR 4)."""
+    import jax
+
+    from seist_tpu.ops.metrics import Metrics
+
+    m = Metrics(
+        task="ppk", metric_names=("precision", "recall", "f1", "mean",
+                                  "rmse", "mae", "mape"),
+        sampling_rate=100, time_threshold=0.1, num_samples=1000,
+    )
+    t = np.array([[100], [200], [300]], np.int32)
+    p = np.array([[105], [500], [-1]], np.int32)
+    m.compute(t, p)
+    m.compute(t, t)
+
+    calls = []
+    real = jax.device_get
+
+    def counting(x):
+        calls.append(x)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    out = m.to_dict()
+    assert len(calls) == 1  # ONE batched transfer of the counter dict
+    assert isinstance(calls[0], dict)
+    # Counters + finalized metrics both present.
+    assert {"tp", "predp", "possp", "precision", "recall"} <= set(out)
+    for v in out.values():  # host scalars (data_size stays int)
+        assert isinstance(v, (int, float)) and not hasattr(v, "device")
+
+
+def test_metrics_to_dict_empty_counters():
+    from seist_tpu.ops.metrics import Metrics
+
+    m = Metrics(
+        task="ppk", metric_names=("precision",), sampling_rate=100,
+        time_threshold=0.1, num_samples=1000,
+    )
+    out = m.to_dict()  # never computed a batch: finalized zeros only
+    assert out["precision"] == 0.0

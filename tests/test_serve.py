@@ -427,6 +427,20 @@ class TestServiceEndToEnd:
             assert status == 404 and out["error"] == "unknown_model"
             status, _ = call("GET", "/nope")
             assert status == 404
+
+            # Prometheus exposition (docs/OBSERVABILITY.md): the obs-bus
+            # render — batcher stats appear as labeled serve_batcher
+            # series; bare /metrics above stayed JSON (back-compat).
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            conn.request("GET", "/metrics?format=prometheus")
+            resp = conn.getresponse()
+            text = resp.read().decode()
+            ctype = resp.getheader("Content-Type", "")
+            conn.close()
+            assert resp.status == 200
+            assert ctype.startswith("text/plain")
+            assert 'seist_serve_batcher_submitted{model="phasenet"}' in text
+            assert "seist_serve_requests_predict" in text
         finally:
             server.shutdown()
 

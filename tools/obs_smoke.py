@@ -1,0 +1,181 @@
+"""Observability smoke (``make obs-smoke``; docs/OBSERVABILITY.md).
+
+One subprocess train run proves the telemetry plane end to end:
+
+1. boots a tiny CPU train run with ``--metrics-port -1`` (ephemeral) and
+   an injected data-plane stall (``SEIST_FAULT_IO_STALL_*``) two batches
+   in, with a short ``--data-watchdog-sec``;
+2. while the loader is wedged (the watchdog's grace window), scrapes the
+   live endpoint: ``/metrics`` must serve Prometheus text with the span
+   histograms, ``/metrics.json`` + ``/flight`` must serve JSON, and
+   ``POST /profile`` must accept a capture request;
+3. the stall watchdog then trips: the run must exit with the
+   clean-preempt code (75) and leave a flight-recorder dump containing
+   the final steps' records and their host_wait/step_dispatch spans.
+
+Prints one JSON result line on stdout; exit 0 iff every assertion held.
+Wired into the chaos lane via tests/test_obs_e2e.py.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PREEMPT_EXIT_CODE = 75
+ENDPOINT_RE = re.compile(r"metrics endpoint: (http://127\.0\.0\.1:\d+)/metrics")
+
+
+def _fail(msg: str, **extra) -> None:
+    print(json.dumps({"ok": False, "error": msg, **extra}))
+    sys.exit(1)
+
+
+def _get(url: str, timeout: float = 5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def main() -> None:
+    log_base = tempfile.mkdtemp(prefix="obs_smoke_")
+    out_path = os.path.join(log_base, "stdout.log")
+    cmd = [
+        sys.executable, "main.py",
+        "--mode", "train",
+        "--model-name", "phasenet",
+        "--dataset-name", "synthetic",
+        "--synthetic-events", "48",
+        "--batch-size", "8",
+        "--in-samples", "256",
+        "--epochs", "1",
+        "--workers", "2",
+        "--augmentation", "0",
+        "--use-tensorboard", "0",
+        "--log-step", "1",
+        "--log-base", log_base,
+        "--metrics-port", "-1",
+        "--data-watchdog-sec", "12",
+    ]
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        # Wedge the loader at batch 2 of epoch 0: steps 0-1 complete
+        # (spans + flight records exist), then the run hangs long enough
+        # to scrape the live endpoint before the watchdog trips.
+        SEIST_FAULT_IO_STALL_BATCH="2",
+        SEIST_FAULT_IO_STALL_SEC="600",
+    )
+    with open(out_path, "w") as out_f:
+        proc = subprocess.Popen(
+            cmd, cwd=_REPO, env=env, stdout=out_f, stderr=subprocess.STDOUT
+        )
+        try:
+            # -- find the ephemeral endpoint in the run log ---------------
+            base_url = None
+            deadline = time.time() + 240  # cold jit compile dominates
+            while time.time() < deadline and base_url is None:
+                if proc.poll() is not None:
+                    _fail(
+                        f"run exited rc={proc.returncode} before the "
+                        "metrics endpoint came up",
+                        log_tail=open(out_path).read()[-2000:],
+                    )
+                m = ENDPOINT_RE.search(open(out_path).read())
+                if m:
+                    base_url = m.group(1)
+                else:
+                    time.sleep(0.5)
+            if base_url is None:
+                proc.kill()
+                _fail("metrics endpoint never logged",
+                      log_tail=open(out_path).read()[-2000:])
+
+            # -- scrape the live plane (stall grace window) ---------------
+            # Wait until at least one step's spans landed.
+            prom = ""
+            deadline = time.time() + 200
+            while time.time() < deadline:
+                status, prom = _get(base_url + "/metrics")
+                if status == 200 and "seist_step_dispatch_ms_count" in prom:
+                    break
+                time.sleep(0.5)
+            checks = {
+                "prom_step_dispatch": "seist_step_dispatch_ms_count" in prom,
+                "prom_host_wait": "seist_host_wait_ms_count" in prom,
+                "prom_data_plane": "seist_data_plane_reads" in prom,
+                "prom_loss_gauge": "seist_train_loss" in prom,
+            }
+            status, snap = _get(base_url + "/metrics.json")
+            checks["json_snapshot"] = (
+                status == 200 and "histograms" in json.loads(snap)
+            )
+            status, fl = _get(base_url + "/flight")
+            flight_live = json.loads(fl)
+            checks["flight_live_steps"] = (
+                status == 200 and len(flight_live.get("steps", [])) >= 1
+            )
+            req = urllib.request.Request(
+                base_url + "/profile?steps=2", method="POST", data=b""
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                checks["profile_trigger"] = (
+                    json.loads(r.read())["requested_steps"] == 2
+                )
+
+            # -- watchdog trip: rc 75 + flight dump -----------------------
+            try:
+                rc = proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                # The regression this smoke exists to catch: the watchdog
+                # never tripped and the stalled run hung. Report it on
+                # the one-line JSON contract, not as a traceback.
+                proc.kill()
+                _fail(
+                    "stall watchdog never tripped within 120 s "
+                    "(run still alive)",
+                    checks=checks,
+                    log_tail=open(out_path).read()[-2000:],
+                )
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+    checks["exit_code_75"] = rc == PREEMPT_EXIT_CODE
+    dumps = glob.glob(
+        os.path.join(log_base, "*", "flight", "flight_stall_watchdog_*.json")
+    )
+    checks["dump_exists"] = bool(dumps)
+    if dumps:
+        dump = json.load(open(dumps[0]))
+        span_names = {s["name"] for s in dump.get("spans", [])}
+        checks["dump_reason"] = dump.get("reason") == "stall_watchdog"
+        checks["dump_has_steps"] = len(dump.get("steps", [])) >= 1
+        checks["dump_span_kinds"] = {"host_wait", "step_dispatch"} <= span_names
+        checks["dump_thread_stacks"] = "seist-data-watchdog" in str(
+            dump.get("thread_stacks", "")
+        )
+
+    ok = all(checks.values())
+    print(json.dumps({
+        "ok": ok,
+        "rc": rc,
+        "checks": checks,
+        "dump": dumps[0] if dumps else None,
+        "log_base": log_base,
+    }))
+    if not ok:
+        print(open(out_path).read()[-3000:], file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
