@@ -4,7 +4,8 @@ NATIVE_DIR := seist_tpu/native
 CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
-.PHONY: native test t1 lint lint-baseline serve-smoke obs-smoke chaos clean
+.PHONY: native test t1 lint lint-baseline serve-smoke serve-chaos obs-smoke \
+	chaos clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -57,6 +58,17 @@ obs-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/bench_serve.py --model-name phasenet \
 		--window 256 --requests 24 --concurrency 6 --max-batch 4
+
+# Serving chaos lane (docs/FAULT_TOLERANCE.md "Serving faults"): real
+# replica subprocesses under SEIST_FAULT_SERVE_* — SIGKILL-mid-load with
+# zero client-visible failures, black-hole circuit open/close, and
+# overload shedding that protects the alert tier's SLO. The fleet
+# supervisor + router units (model-free) ride along. Subset of `make
+# chaos`, runnable alone when iterating on serve/.
+serve-chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serve_chaos.py \
+	  tests/test_serve_fleet.py tests/test_router.py -q \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
 
 clean:
 	rm -f $(NATIVE_DIR)/libwavekit.so

@@ -15,7 +15,26 @@ Layout:
 
 __version__ = "0.1.0"
 
-from seist_tpu import registry, taskspec  # noqa: F401
+#: Package-root namespaces resolved lazily (PEP 562). An eager import
+#: here would pull jax into EVERY process that touches any seist_tpu
+#: submodule — including the model-free serving front tier
+#: (serve/router.py, serve/shed.py, tools/supervise_fleet.py), which
+#: must start on boxes with no accelerator stack installed at all.
+_LAZY_SUBMODULES = ("registry", "taskspec")
+
+
+def __getattr__(name: str):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        module = importlib.import_module(f"seist_tpu.{name}")
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module 'seist_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY_SUBMODULES))
 
 
 def load_all(validate: bool = True) -> None:
@@ -26,4 +45,6 @@ def load_all(validate: bool = True) -> None:
     import seist_tpu.data  # noqa: F401
 
     if validate:
+        from seist_tpu import taskspec
+
         taskspec.validate()

@@ -80,17 +80,68 @@ def barrier(name: str = "barrier") -> None:
         multihost_utils.sync_global_devices(name)
 
 
+#: broadcast_object call ordinal — every process calls broadcast_object
+#: in the same program order (it is a collective), so a per-process
+#: counter yields matching KV keys without any extra coordination.
+_broadcast_seq = 0
+_BROADCAST_TIMEOUT_MS = 300_000
+
+
+def _coordination_client():
+    """The jax distributed coordination-service client (the same KV store
+    ``jax.distributed.initialize`` rendezvouses through), or None outside
+    an initialized multi-process runtime."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 — private API; any change = fallback
+        return None
+
+
 def broadcast_object(obj: Any) -> Any:
     """Broadcast any picklable host-side python object from process 0 to all
     (ref: misc.py:134-140 broadcast_object_list).
 
-    ``multihost_utils.broadcast_one_to_all`` only moves numeric arrays, so
-    the object is pickled to a uint8 buffer; the length is broadcast first so
-    every host allocates the same padded shape.
+    Transport is the coordination-service KV store, NOT an XLA collective:
+    process 0 publishes the pickle under a sequenced key, everyone else
+    blocks on that key. Host-side control data (checkpoint paths, eval
+    verdicts) has no business riding device allreduces — and on the CPU
+    backend it must not: jaxlib 0.4.37's gloo allreduce intermittently
+    returns a zero-prefixed buffer when two differently-shaped broadcasts
+    run back-to-back (the seed test_multihost failure's second act; an
+    artificial delay between the collectives masks it, which is how it
+    escaped notice upstream). The legacy two-phase broadcast_one_to_all
+    path remains only for runtimes where the private client API is gone.
     """
     if jax.process_count() <= 1:
         return obj
     import pickle
+
+    client = _coordination_client()
+    if client is not None:
+        global _broadcast_seq
+        key = f"seist_tpu/broadcast_object/{_broadcast_seq}"
+        _broadcast_seq += 1
+        if jax.process_index() == 0:
+            client.key_value_set_bytes(key, pickle.dumps(obj))
+            result = obj
+        else:
+            result = pickle.loads(
+                client.blocking_key_value_get_bytes(
+                    key, _BROADCAST_TIMEOUT_MS
+                )
+            )
+        # Barrier-then-delete: once every process has read the value,
+        # process 0 removes the key. Keys must not outlive the call —
+        # they would accumulate over a long run, and a relaunched
+        # incarnation restarting its sequence at 0 against a still-live
+        # coordinator would read the PREVIOUS run's value for the wrong
+        # program point.
+        client.wait_at_barrier(key + "/read", _BROADCAST_TIMEOUT_MS)
+        if jax.process_index() == 0:
+            client.key_value_delete(key)
+        return result
 
     import numpy as np
     from jax.experimental import multihost_utils

@@ -1,17 +1,28 @@
 """Online inference service: micro-batching, bucketed warm compiles,
-stdlib HTTP front-end. See docs/SERVING.md.
+stdlib HTTP front-end, tiered load shedding, and a replica-fleet front
+tier. See docs/SERVING.md.
 
     seist_tpu.serve.protocol   wire format + error taxonomy (HTTP statuses)
     seist_tpu.serve.batcher    request coalescing, backpressure, deadlines
     seist_tpu.serve.pool       model loading + per-bucket warm-up + decode
+    seist_tpu.serve.shed       priority tiers + queue-delay load shedding
     seist_tpu.serve.server     ServeService core + HTTP shim + `serve` CLI
+    seist_tpu.serve.router     front-tier router: health-checked replica
+                               registry, circuit breaking, retries, hedging
 """
 
 from seist_tpu.serve.batcher import BatcherConfig, MicroBatcher  # noqa: F401
 from seist_tpu.serve.pool import ModelPool, load_model_entry  # noqa: F401
 from seist_tpu.serve.protocol import PredictOptions, ServeError  # noqa: F401
+from seist_tpu.serve.router import (  # noqa: F401
+    CircuitBreaker,
+    ReplicaRegistry,
+    Router,
+    RouterConfig,
+)
 from seist_tpu.serve.server import (  # noqa: F401
     ServeHTTPServer,
     ServeService,
     start_http_server,
 )
+from seist_tpu.serve.shed import AdmissionController, ShedConfig  # noqa: F401

@@ -24,6 +24,15 @@ request carries a deadline; requests that expire while queued are dropped
 before the forward (no wasted compute) and raise
 :class:`~seist_tpu.serve.protocol.DeadlineExceeded` in their caller.
 
+The queue is *rank-ordered*, not FIFO: each request carries a rank
+(serve layer: ``alert`` < ``interactive`` < ``batch``) and a flush takes
+the lowest ranks first, FIFO within a rank. Without this, low-tier
+requests admitted just before the shed controller trips would sit ahead
+of every later alert — on a slow or contended box that backlog alone
+blows the alert tier's latency SLO no matter how aggressive admission
+shedding is. Starvation of low tiers under sustained overload is the
+*intended* policy (those requests expire and should have been shed).
+
 Thread model: callers (HTTP handler threads) block in :meth:`submit`;
 one daemon worker owns the device. This is deliberate — JAX dispatch is
 not free-threaded, and a single submission thread also serializes bucket
@@ -85,9 +94,9 @@ class BatcherConfig:
 
 class _Pending:
     __slots__ = ("x", "enqueued_at", "deadline", "event", "result", "error",
-                 "abandoned")
+                 "abandoned", "rank")
 
-    def __init__(self, x: np.ndarray, deadline: float):
+    def __init__(self, x: np.ndarray, deadline: float, rank: int = 1):
         self.x = x
         self.enqueued_at = time.monotonic()
         self.deadline = deadline
@@ -95,6 +104,7 @@ class _Pending:
         self.result: Any = None
         self.error: Optional[BaseException] = None
         self.abandoned = False  # caller gave up; skip at flush time
+        self.rank = rank  # flush order: lower rank first, FIFO within
 
 
 class MicroBatcher:
@@ -131,6 +141,7 @@ class MicroBatcher:
         self._forwards = 0
         self._batch_items = 0  # real traces forwarded
         self._batch_slots = 0  # bucket slots forwarded (incl. padding)
+        self._flush_ewma_ms = 0.0  # EWMA of forward wall time per flush
         self.latency_ms = LatencyHistogram()
         # Publish on the process metrics bus (obs/bus.py): scrape-time
         # collector, so the stats stay single-sourced behind self._cond
@@ -152,11 +163,24 @@ class MicroBatcher:
         self._thread.start()
 
     # ------------------------------------------------------------- submit
-    def submit(self, x: np.ndarray, timeout_ms: float = 5000.0) -> Any:
+    def submit(
+        self, x: np.ndarray, timeout_ms: float = 5000.0, rank: int = 1
+    ) -> Any:
         """Block until the trace's batch is served; returns the per-item
-        output slice. Raises QueueFull / DeadlineExceeded / ShuttingDown."""
+        output slice. Raises QueueFull / DeadlineExceeded / ShuttingDown.
+
+        ``rank`` is the flush order under contention: lower ranks are
+        taken first, FIFO within a rank (the serve layer maps priority
+        tiers to ranks via ``protocol.PRIORITIES``). This is the queue
+        half of the overload story — admission shedding (serve/shed.py)
+        bounds how much low-tier work gets in, and rank ordering keeps
+        whatever *was* admitted from standing ahead of an alert, so a
+        high-tier request waits at most the in-flight flush plus its own
+        tier's queue regardless of box speed or backlog."""
         t0 = time.monotonic()
-        item = _Pending(np.asarray(x), deadline=t0 + timeout_ms / 1000.0)
+        item = _Pending(
+            np.asarray(x), deadline=t0 + timeout_ms / 1000.0, rank=rank
+        )
         with self._cond:
             if self._fatal is not None:
                 raise ServeError(
@@ -172,7 +196,13 @@ class MicroBatcher:
                     f"({self.config.max_queue} waiting)"
                 )
             self._submitted += 1
-            self._queue.append(item)
+            # Stable rank-ordered insert (scan from the tail: bursts are
+            # overwhelmingly same-or-lower rank, so this is O(number of
+            # lower-rank items behind), bounded by max_queue).
+            pos = len(self._queue)
+            while pos > 0 and self._queue[pos - 1].rank > item.rank:
+                pos -= 1
+            self._queue.insert(pos, item)
             self._cond.notify_all()
         if not item.event.wait(timeout=timeout_ms / 1000.0 + 0.05):
             # Decide success-vs-expired once, under the lock the worker
@@ -266,6 +296,7 @@ class MicroBatcher:
             batch = np.concatenate(
                 [batch, np.repeat(batch[-1:], bucket - n, axis=0)], axis=0
             )
+        t_fwd0 = time.monotonic()
         try:
             out = self._forward(batch)
         except Exception as e:  # noqa: BLE001 — must not kill the worker
@@ -287,10 +318,18 @@ class MicroBatcher:
             out = type(out)(np.asarray(o) for o in out)
         else:
             out = np.asarray(out)
+        flush_ms = (time.monotonic() - t_fwd0) * 1e3
         with self._cond:
             self._forwards += 1
             self._batch_items += n
             self._batch_slots += bucket
+            # Service-time EWMA feeding queue_delay_ms(); first flush seeds
+            # it so one warm compile doesn't poison the estimate for long.
+            self._flush_ewma_ms = (
+                flush_ms
+                if self._flush_ewma_ms == 0.0
+                else 0.8 * self._flush_ewma_ms + 0.2 * flush_ms
+            )
             # Count + event.set under the lock so each request is credited
             # exactly once: a caller timing out DURING the forward holds
             # this lock to mark itself abandoned/expired, and its lost-race
@@ -302,6 +341,24 @@ class MicroBatcher:
                 if not item.abandoned:
                     self._completed += 1
                 item.event.set()
+
+    # ----------------------------------------------------- overload signal
+    def queue_delay_ms(self) -> float:
+        """Estimated queueing delay a newly admitted request would see:
+        head-of-line sojourn time (the CoDel overload signal — under
+        sustained overload it grows without bound, under transient bursts
+        it self-clears) plus the flush waves already queued ahead priced
+        at the EWMA service time. serve/shed.py sheds low tiers on this;
+        an empty queue reads 0 (a lone request waits only max_delay_ms,
+        which is policy, not overload)."""
+        with self._cond:
+            if not self._queue:
+                return 0.0
+            head_age_ms = (
+                time.monotonic() - self._queue[0].enqueued_at
+            ) * 1e3
+            waves = -(-len(self._queue) // self.config.max_batch)
+            return head_age_ms + waves * self._flush_ewma_ms
 
     # ---------------------------------------------------------- control
     def shutdown(self, drain: bool = True, timeout_s: float = 30.0) -> None:
@@ -336,6 +393,7 @@ class MicroBatcher:
             slots = self._batch_slots
             return {
                 "queue_depth": len(self._queue),
+                "queue_delay_ms": round(self.queue_delay_ms(), 3),
                 "healthy": self.healthy,
                 "submitted": self._submitted,
                 "completed": self._completed,

@@ -38,6 +38,10 @@ class ServeError(Exception):
     def payload(self) -> Dict[str, Any]:
         return {"error": self.code, "message": str(self)}
 
+    def headers(self) -> Dict[str, str]:
+        """Extra HTTP response headers (e.g. Retry-After for shedding)."""
+        return {}
+
 
 class BadRequest(ServeError):
     status = 400
@@ -67,6 +71,42 @@ class ShuttingDown(ServeError):
     code = "shutting_down"
 
 
+#: Priority tiers, highest first. Order IS the shed order reversed:
+#: ``batch`` (backfill) is dropped first under overload, ``alert``
+#: (streaming early-warning picks — a missed one is a missed event) last.
+#: The numeric level is what serve/shed.py compares thresholds against.
+PRIORITIES = {"alert": 0, "interactive": 1, "batch": 2}
+DEFAULT_PRIORITY = "interactive"
+
+
+class Overloaded(ServeError):
+    """Adaptive load shedding (serve/shed.py): the replica's queue delay
+    says this request's tier cannot be served within its latency budget.
+    Distinct from QueueFull's 429 (a hard bounded-queue bounce) — this is
+    a *policy* drop of a low tier, delivered as 503 + Retry-After so
+    well-behaved batch clients back off for a computed interval while
+    alert traffic keeps flowing."""
+
+    status = 503
+    code = "shed"
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        # No floor here: the shed policy (ShedConfig.min_retry_after_s)
+        # owns the minimum — clamping again would silently override a
+        # sub-second operator setting. Only guard against negatives.
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+    def payload(self) -> Dict[str, Any]:
+        p = super().payload()
+        p["retry_after_s"] = round(self.retry_after_s, 1)
+        return p
+
+    def headers(self) -> Dict[str, str]:
+        # Retry-After is delta-seconds, integral per RFC 9110.
+        return {"Retry-After": str(int(math.ceil(self.retry_after_s)))}
+
+
 @dataclass
 class PredictOptions:
     """Per-request knobs; defaults mirror cli.py's eval flags."""
@@ -79,6 +119,7 @@ class PredictOptions:
     norm_mode: str = "std"
     max_events: int = 8
     timeout_ms: float = 5000.0
+    priority: str = DEFAULT_PRIORITY  # admission tier (serve/shed.py)
     # /annotate only:
     stride: int = 0  # 0 = window // 2
     combine: str = "max"
@@ -93,7 +134,7 @@ class PredictOptions:
         int_fields = ("sampling_rate", "max_events", "stride",
                       "record_max_events")
         for key, value in d.items():
-            if key in ("norm_mode", "combine"):
+            if key in ("norm_mode", "combine", "priority"):
                 if not isinstance(value, str):
                     raise BadRequest(f"option '{key}' must be a string")
                 continue
@@ -138,6 +179,11 @@ class PredictOptions:
         if opts.combine not in ("max", "mean"):
             raise BadRequest(
                 f"combine must be 'max' or 'mean', got '{opts.combine}'"
+            )
+        if opts.priority not in PRIORITIES:
+            raise BadRequest(
+                f"priority must be one of {sorted(PRIORITIES)}, "
+                f"got '{opts.priority}'"
             )
         return opts
 

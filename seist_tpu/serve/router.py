@@ -1,0 +1,949 @@
+"""Front-tier replica router: health-checked registry, circuit breaking,
+bounded retries, hedged requests.
+
+The PR 1 service is one process; this module makes N of them a fleet.
+A thin, model-free HTTP tier (stdlib only, no jax import — it runs in
+the supervisor or on a separate box) forwards ``POST /predict`` and
+``POST /annotate`` to replica processes and owns the reliability story:
+
+* :class:`ReplicaRegistry` — the routable set. A background prober
+  drives it off each replica's ``/healthz/ready`` (the PR 2 live/ready
+  split): a draining or still-warming replica leaves rotation within one
+  probe interval, a restarted one re-enters the same way.
+  ``tools/supervise_fleet.py`` also rolls it explicitly over the
+  ``POST /router/register`` / ``/router/deregister`` admin endpoints.
+* :class:`CircuitBreaker`, per replica — the *fast* path around failure.
+  Health probes need seconds and cannot see the worst failure mode at
+  all: a black-holed replica that accepts connections (and answers
+  probes) but never answers requests. The breaker sees every request
+  outcome: consecutive failures (connection errors, per-attempt
+  timeouts, 500s) or slow successes past ``latency_trip_ms`` OPEN the
+  circuit; after a cooldown one HALF-OPEN probe request is let through;
+  success CLOSEs, failure re-opens with doubled cooldown.
+* **Bounded retries** — a failed attempt is retried on a *different*
+  replica while the per-request retry budget (``retries``) and the
+  client's own deadline allow. Replica-crash failures (SIGKILL mid
+  flight) become invisible to well-formed clients; shed responses
+  (503 ``shed``) are deliberately NOT retried — under fleet-wide
+  overload a retry storm is fuel on the fire, so the shed verdict and
+  its Retry-After pass through.
+* **Hedged requests** (``hedge_ms`` > 0) — tail-latency insurance: if
+  the chosen replica hasn't answered within the hedge delay, a second
+  attempt races it on another replica and the first acceptable answer
+  wins (arXiv:2605.25645's p99-under-SLO serving bar is exactly what
+  this buys).
+
+Error classification (drives retry + breaker):
+
+    =====================  ========  =======  ==================
+    outcome                breaker   retried  passed to client
+    =====================  ========  =======  ==================
+    connect/read timeout   failure   yes      504 if budget gone
+    connection refused     failure   yes      502 if budget gone
+    HTTP 500               failure   yes      after budget
+    HTTP 429 queue_full    success   yes      after budget
+    HTTP 503 shutting_down success   yes      after budget
+    HTTP 503 shed          success   NO       immediately
+    HTTP 504 deadline      success   NO       immediately
+    HTTP 2xx/4xx           success   NO       immediately
+    =====================  ========  =======  ==================
+
+Counters land on the PR 6 metrics bus (``seist_router_*``), scraped from
+the router's own ``GET /metrics``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import re
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from queue import Empty, Queue
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from seist_tpu.utils.logger import logger
+
+# Breaker states (also the value of the router_breaker_state gauge).
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+_STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitBreaker:
+    """Per-replica request-outcome circuit breaker.
+
+    CLOSED —(``failures_to_open`` consecutive failures or
+    too-slow successes)→ OPEN —(cooldown elapses; next ``allow`` grants
+    exactly one probe)→ HALF_OPEN —(probe success)→ CLOSED, or —(probe
+    failure)→ OPEN with the cooldown doubled (capped). Thread-safe; the
+    clock is injectable for tests."""
+
+    def __init__(
+        self,
+        failures_to_open: int = 3,
+        cooldown_s: float = 2.0,
+        max_cooldown_s: float = 30.0,
+        latency_trip_ms: float = float("inf"),
+        probe_timeout_s: float = 60.0,
+        clock=time.monotonic,
+    ):
+        self.failures_to_open = max(1, int(failures_to_open))
+        self.base_cooldown_s = float(cooldown_s)
+        self.max_cooldown_s = float(max_cooldown_s)
+        self.latency_trip_ms = float(latency_trip_ms)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._cooldown_s = self.base_cooldown_s
+        self._opened_at = 0.0
+        self._half_open_at = 0.0
+        self._opens = 0  # lifetime open transitions (stats)
+
+    # ------------------------------------------------------------ decisions
+    def allow(self) -> bool:
+        """May a request be sent now? In OPEN, the first call after the
+        cooldown flips to HALF_OPEN and grants itself the single probe;
+        callers that get False must route elsewhere."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock() - self._opened_at >= self._cooldown_s:
+                    self._state = HALF_OPEN
+                    self._half_open_at = self._clock()
+                    return True  # this caller IS the half-open probe
+                return False
+            # HALF_OPEN: probe already in flight — unless its outcome was
+            # lost (attempt thread outliving every drain window, e.g. a
+            # replica trickling bytes so each socket op resets the per-op
+            # timeout). Without this escape a lost probe wedges the
+            # breaker HALF_OPEN forever and the replica becomes
+            # permanently unroutable; re-grant the probe slot instead.
+            if self._clock() - self._half_open_at >= self.probe_timeout_s:
+                self._half_open_at = self._clock()
+                return True
+            return False
+
+    def record_success(self, latency_ms: float = 0.0) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                if latency_ms > self.latency_trip_ms:
+                    # The probe "succeeded" but is still slower than the
+                    # trip latency: the replica is still sick. Closing
+                    # here would flood traffic back and reset the
+                    # cooldown — keep it OPEN with escalation instead.
+                    self._open_locked(escalate=True)
+                else:
+                    # Probe came back healthy: the replica recovered.
+                    self._close_locked()
+                return
+            if latency_ms > self.latency_trip_ms:
+                # A "success" slower than the trip latency is the
+                # wedged-but-not-dead signature; count it like a failure
+                # so a latency-sick replica opens too.
+                self._failure_locked()
+            else:
+                self._consecutive = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == HALF_OPEN:
+                # Probe failed: back to OPEN, longer cooldown.
+                self._open_locked(escalate=True)
+                return
+            self._failure_locked()
+
+    # ------------------------------------------------------------ internals
+    def _failure_locked(self) -> None:
+        self._consecutive += 1
+        if self._state == CLOSED and self._consecutive >= self.failures_to_open:
+            self._open_locked(escalate=False)
+
+    def _open_locked(self, escalate: bool) -> None:
+        if escalate:
+            self._cooldown_s = min(self._cooldown_s * 2.0, self.max_cooldown_s)
+        self._state = OPEN
+        self._opened_at = self._clock()
+        self._opens += 1
+
+    def _close_locked(self) -> None:
+        self._state = CLOSED
+        self._consecutive = 0
+        self._cooldown_s = self.base_cooldown_s
+
+    # --------------------------------------------------------------- stats
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive,
+                "cooldown_s": self._cooldown_s,
+                "opens": self._opens,
+            }
+
+
+@dataclass
+class RouterConfig:
+    #: additional attempts after the first (per request)
+    retries: int = 2
+    #: per-attempt cap (seconds) — ALSO the black-hole detection time:
+    #: an accepted-but-never-answered request fails after this long and
+    #: feeds the breaker, so keep it a small multiple of honest p99
+    request_timeout_s: float = 10.0
+    #: duplicate a request onto a second replica after this long without
+    #: an answer (0 = hedging off)
+    hedge_ms: float = 0.0
+    #: /healthz/ready probe cadence + timeout
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 2.0
+    #: probe failures before a replica leaves rotation
+    probe_fails_down: int = 2
+    #: breaker knobs (per replica)
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 2.0
+    breaker_max_cooldown_s: float = 30.0
+    breaker_latency_trip_ms: float = float("inf")
+
+
+class Replica:
+    """One registry entry: probe state + breaker + counters."""
+
+    def __init__(self, url: str, config: RouterConfig):
+        self.url = url.rstrip("/")
+        self.breaker = CircuitBreaker(
+            failures_to_open=config.breaker_failures,
+            cooldown_s=config.breaker_cooldown_s,
+            max_cooldown_s=config.breaker_max_cooldown_s,
+            latency_trip_ms=config.breaker_latency_trip_ms,
+            # A probe attempt that hasn't settled within a couple of
+            # request timeouts is presumed lost (see allow()).
+            probe_timeout_s=2.0 * config.request_timeout_s + 5.0,
+        )
+        # Optimistic start: a just-registered replica is routable until
+        # the first probe says otherwise — the breaker catches a dead one
+        # within failures_to_open requests, while a pessimistic start
+        # would black out a healthy fleet for one probe interval.
+        self.probe_ready = True
+        self.probe_state = "unprobed"
+        self.probe_fails = 0
+        self._lock = threading.Lock()
+        self.routed = 0
+        self.failures = 0
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            routed, failures = self.routed, self.failures
+        return {
+            "url": self.url,
+            "ready": self.probe_ready,
+            "probe_state": self.probe_state,
+            "breaker": self.breaker.stats(),
+            "routed": routed,
+            "failures": failures,
+        }
+
+    def count(self, failure: bool) -> None:
+        with self._lock:
+            self.routed += 1
+            if failure:
+                self.failures += 1
+
+
+class ReplicaRegistry:
+    """The routable replica set; thread-safe. Pick order is round-robin
+    over probe-ready replicas whose breaker admits traffic."""
+
+    def __init__(self, config: Optional[RouterConfig] = None):
+        self.config = config or RouterConfig()
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, Replica] = {}
+        self._rr = 0
+
+    def add(self, url: str) -> Replica:
+        url = url.rstrip("/")
+        with self._lock:
+            replica = self._replicas.get(url)
+            if replica is None:
+                replica = Replica(url, self.config)
+                self._replicas[url] = replica
+                logger.info(f"[router] registered replica {url}")
+            return replica
+
+    def remove(self, url: str) -> bool:
+        url = url.rstrip("/")
+        with self._lock:
+            gone = self._replicas.pop(url, None)
+        if gone is not None:
+            logger.info(f"[router] deregistered replica {url}")
+        return gone is not None
+
+    def mark_down(self, url: str, reason: str = "") -> None:
+        """Immediately pull a replica from rotation (the fleet supervisor
+        calls this the moment it reaps the process — faster than waiting
+        out a probe interval)."""
+        with self._lock:
+            replica = self._replicas.get(url.rstrip("/"))
+        if replica is not None:
+            replica.probe_ready = False
+            replica.probe_state = f"down({reason})" if reason else "down"
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def pick(self, exclude: Set[str] = frozenset()) -> Optional[Replica]:
+        """Round-robin over ready replicas not in ``exclude`` whose
+        breaker admits the request (``allow`` may consume the single
+        half-open probe slot, so it is asked last, only for the
+        candidate actually about to be used)."""
+        with self._lock:
+            candidates = [
+                r
+                for r in self._replicas.values()
+                if r.probe_ready and r.url not in exclude
+            ]
+            if not candidates:
+                return None
+            start = self._rr % len(candidates)
+            self._rr += 1
+        for i in range(len(candidates)):
+            replica = candidates[(start + i) % len(candidates)]
+            if replica.breaker.allow():
+                return replica
+        return None
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values() if r.probe_ready)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        return [r.snapshot() for r in self.replicas()]
+
+
+# --------------------------------------------------------------- outcomes
+class _Outcome:
+    """One attempt's result. ``status=0`` means a network-level failure
+    (no HTTP response): ``error`` holds the reason."""
+
+    __slots__ = ("status", "headers", "body", "error", "latency_ms")
+
+    def __init__(
+        self,
+        status: int,
+        headers: Dict[str, str],
+        body: bytes,
+        error: str = "",
+        latency_ms: float = 0.0,
+    ):
+        self.status = status
+        self.headers = headers
+        self.body = body
+        self.error = error
+        self.latency_ms = latency_ms
+
+    @property
+    def is_net_error(self) -> bool:
+        return self.status == 0
+
+    def error_code(self) -> str:
+        """The serve error taxonomy code from a JSON error body (the
+        'shed' vs 'shutting_down' discriminator for 503s)."""
+        if not self.body:
+            return ""
+        try:
+            return str(json.loads(self.body.decode()).get("error", ""))
+        except (ValueError, UnicodeDecodeError):
+            return ""
+
+
+def _classify(outcome: _Outcome) -> Tuple[bool, bool]:
+    """-> (breaker_failure, retryable). See the module-docstring table."""
+    if outcome.is_net_error:
+        return True, True
+    s = outcome.status
+    if s >= 500 and s not in (503, 504):
+        return True, True
+    if s == 429:
+        return False, True
+    if s == 503:
+        # 'shed' = fleet overload policy verdict: retrying elsewhere
+        # amplifies the overload that caused it; pass it through.
+        return False, outcome.error_code() != "shed"
+    return False, False  # 2xx, 4xx, 504
+
+
+class Router:
+    """Transport-free routing core (the HTTP shim below is ~50 lines):
+    ``forward()`` runs the pick → attempt → classify → retry/hedge loop
+    and returns ``(status, headers, body)`` ready to relay."""
+
+    def __init__(
+        self,
+        registry: Optional[ReplicaRegistry] = None,
+        config: Optional[RouterConfig] = None,
+        bus=None,
+    ):
+        self.config = config or RouterConfig()
+        self.registry = registry or ReplicaRegistry(self.config)
+        if bus is None:
+            from seist_tpu.obs.bus import BUS as bus
+        self._bus = bus
+        self._prober: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        bus.register_collector("router", self._collect)
+
+    # ------------------------------------------------------------- probing
+    def start_prober(self) -> None:
+        """Start the background ``/healthz/ready`` prober (idempotent)."""
+        if self._prober is not None and self._prober.is_alive():
+            return
+        self._stop.clear()
+        self._prober = threading.Thread(
+            target=self._probe_loop, name="router-prober", daemon=True
+        )
+        self._prober.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=5.0)
+        self._bus.unregister_collector("router", fn=self._collect)
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            for replica in self.registry.replicas():
+                self._probe_one(replica)
+            self._stop.wait(self.config.probe_interval_s)
+
+    def _probe_one(self, replica: Replica) -> None:
+        try:
+            status, _, body = _http_request(
+                replica.url,
+                "GET",
+                "/healthz/ready",
+                timeout_s=self.config.probe_timeout_s,
+            )
+            replica.probe_fails = 0
+            if status == 200:
+                replica.probe_ready = True
+                replica.probe_state = "ok"
+            else:
+                replica.probe_ready = False
+                try:
+                    replica.probe_state = str(
+                        json.loads(body.decode()).get("status", "not_ready")
+                    )
+                except (ValueError, UnicodeDecodeError):
+                    replica.probe_state = "not_ready"
+        except (OSError, http.client.HTTPException) as e:
+            # Connection refused/reset/timeout/half-closed: the process
+            # is likely gone. Two strikes before leaving rotation — one
+            # lost probe packet must not drain a healthy replica.
+            replica.probe_fails += 1
+            if replica.probe_fails >= self.config.probe_fails_down:
+                replica.probe_ready = False
+                replica.probe_state = f"unreachable({type(e).__name__})"
+
+    # ------------------------------------------------------------ forwarding
+    def forward(
+        self, path: str, body: bytes
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        """Route one inference request; returns (status, headers, body)."""
+        self._bus.counter("router_requests", path=path.lstrip("/")).inc()
+        deadline = time.monotonic() + self._budget_s(body)
+        tried: Set[str] = set()
+        attempts_left = 1 + max(0, int(self.config.retries))
+        last: Optional[_Outcome] = None
+        while attempts_left > 0 and time.monotonic() < deadline:
+            replica = self.registry.pick(exclude=tried)
+            if replica is None and tried:
+                # Every replica tried once; a retry may reuse one (the
+                # failure could have been transient) as long as its
+                # breaker still admits traffic.
+                replica = self.registry.pick()
+            if replica is None:
+                break
+            attempts_left -= 1
+            if tried:  # anything after the first attempt is a retry
+                self._bus.counter("router_retries").inc()
+            tried.add(replica.url)
+            if self.config.hedge_ms > 0:
+                outcome, replica, attempts_left, pre_settled = (
+                    self._attempt_hedged(
+                        replica, path, body, deadline, tried, attempts_left
+                    )
+                )
+            else:
+                outcome = self._attempt(replica, path, body, deadline)
+                pre_settled = False
+            if pre_settled:
+                # The hedged path already fed this outcome to its
+                # replica's breaker; settling again would double-count.
+                _, retryable = _classify(outcome)
+            else:
+                _, retryable = self._settle(replica, outcome)
+            if not retryable:
+                return self._relay(outcome)
+            last = outcome
+        if last is not None:
+            return self._relay(last)
+        self._bus.counter("router_no_replica").inc()
+        return (
+            503,
+            {},
+            json.dumps(
+                {"error": "no_replica",
+                 "message": "no routable replica in the registry"}
+            ).encode(),
+        )
+
+    def _settle(
+        self, replica: Replica, outcome: _Outcome
+    ) -> Tuple[bool, bool]:
+        """Feed breaker + counters; -> (breaker_failure, retryable)."""
+        failure, retryable = _classify(outcome)
+        if failure:
+            replica.breaker.record_failure()
+        else:
+            replica.breaker.record_success(outcome.latency_ms)
+        replica.count(failure)
+        return failure, retryable
+
+    def _relay(self, outcome: _Outcome) -> Tuple[int, Dict[str, str], bytes]:
+        if outcome.is_net_error:
+            # No HTTP response to relay: surface the failure class. A
+            # timeout maps to 504 (the client's wait was consumed), a
+            # refused/reset connection to 502.
+            status = 504 if "timeout" in outcome.error else 502
+            body = json.dumps(
+                {"error": "replica_unreachable", "message": outcome.error}
+            ).encode()
+            self._bus.counter("router_responses", status=status).inc()
+            return status, {}, body
+        self._bus.counter("router_responses", status=outcome.status).inc()
+        return outcome.status, outcome.headers, outcome.body
+
+    def _attempt(
+        self, replica: Replica, path: str, body: bytes, deadline: float
+    ) -> _Outcome:
+        timeout_s = min(
+            self.config.request_timeout_s,
+            max(0.05, deadline - time.monotonic()),
+        )
+        t0 = time.monotonic()
+        try:
+            status, headers, payload = _http_request(
+                replica.url, "POST", path, body=body, timeout_s=timeout_s
+            )
+            return _Outcome(
+                status,
+                headers,
+                payload,
+                latency_ms=(time.monotonic() - t0) * 1e3,
+            )
+        except socket.timeout:
+            return _Outcome(0, {}, b"", error="timeout")
+        except (OSError, http.client.HTTPException) as e:
+            # RemoteDisconnected/BadStatusLine are HTTPException (a
+            # SIGKILLed replica's half-written response), the rest OSError.
+            msg = f"{type(e).__name__}: {e}"
+            if "timed out" in str(e):
+                msg = f"timeout ({msg})"
+            return _Outcome(0, {}, b"", error=msg)
+
+    def _attempt_hedged(
+        self,
+        primary: Replica,
+        path: str,
+        body: bytes,
+        deadline: float,
+        tried: Set[str],
+        attempts_left: int,
+    ) -> Tuple[_Outcome, Replica, int, bool]:
+        """Race the primary against a late-started hedge on another
+        replica; first non-retryable outcome wins. The hedge consumes one
+        unit of the retry budget (a hedge IS a speculative retry). Every
+        launched attempt settles its breaker exactly once — losers and
+        stragglers via a background drain, so a black-holed loser keeps
+        counting. Returns ``(outcome, replica, attempts_left,
+        pre_settled)``: when ``pre_settled`` the outcome was already fed
+        to its breaker here and the caller must not settle it again."""
+        results: "Queue[Tuple[_Outcome, Replica]]" = Queue()
+
+        def run(replica: Replica) -> None:
+            out = self._attempt(replica, path, body, deadline)
+            results.put((out, replica))
+
+        threading.Thread(
+            target=run, args=(primary,), daemon=True,
+            name="router-attempt",
+        ).start()
+        launched = [primary]
+        try:
+            outcome, winner = results.get(
+                timeout=self.config.hedge_ms / 1000.0
+            )
+            return outcome, winner, attempts_left, False
+        except Empty:
+            pass
+        hedge = (
+            self.registry.pick(exclude=tried) if attempts_left > 0 else None
+        )
+        if hedge is not None:
+            attempts_left -= 1
+            tried.add(hedge.url)
+            self._bus.counter("router_hedges").inc()
+            threading.Thread(
+                target=run, args=(hedge,), daemon=True,
+                name="router-hedge",
+            ).start()
+            launched.append(hedge)
+
+        def drain_pending(seen_n: int) -> None:
+            if seen_n < len(launched):
+                threading.Thread(
+                    target=self._drain_loser,
+                    args=(results, len(launched) - seen_n),
+                    daemon=True,
+                    name="router-hedge-drain",
+                ).start()
+
+        seen = 0
+        best: Optional[Tuple[_Outcome, Replica]] = None
+        while seen < len(launched):
+            remaining = max(0.05, deadline - time.monotonic())
+            try:
+                outcome, replica = results.get(timeout=remaining)
+            except Empty:
+                break
+            seen += 1
+            _, retryable = _classify(outcome)
+            if not retryable:
+                # Acceptable answer: forward() settles the winner; the
+                # straggler is accounted when it eventually lands.
+                drain_pending(seen)
+                return outcome, replica, attempts_left, False
+            # Failed retryably: settle its breaker now and keep waiting
+            # for the other attempt (if any).
+            self._settle(replica, outcome)
+            best = (outcome, replica)
+        # Deadline ran out. Whatever came back was settled above
+        # (pre_settled=True keeps forward() from double-counting it);
+        # whatever is still in flight settles via the drain.
+        drain_pending(seen)
+        if best is not None:
+            return best[0], best[1], attempts_left, True
+        # Neither attempt returned before the deadline: synthesize a
+        # timeout for relay. The real outcomes settle via the drain, so
+        # the synthetic one must not touch any breaker.
+        return (
+            _Outcome(0, {}, b"", error="timeout"),
+            primary,
+            attempts_left,
+            True,
+        )
+
+    def _drain_loser(self, results: Queue, n: int) -> None:
+        for _ in range(n):
+            try:
+                outcome, replica = results.get(
+                    timeout=self.config.request_timeout_s + 1.0
+                )
+            except Empty:
+                return
+            self._settle(replica, outcome)
+
+    _TIMEOUT_MS_RE = re.compile(rb'"timeout_ms"\s*:\s*([0-9eE.+-]+)')
+
+    def _budget_s(self, body: bytes) -> float:
+        """Total routing budget: the client's own options.timeout_ms plus
+        slack when findable, else enough for every attempt to time out.
+        This is a routing heuristic, not protocol validation (the replica
+        re-validates), so a regex scan suffices at every size: the front
+        tier must not decode a waveform payload (a 256-sample /predict is
+        already ~20 KB, hours-long /annotate records run to tens of MB)
+        just to read one scalar, and the quoted key cannot appear inside
+        the numeric arrays."""
+        fallback = self.config.request_timeout_s * (
+            1 + max(0, int(self.config.retries))
+        )
+        m = self._TIMEOUT_MS_RE.search(body)
+        try:
+            timeout_ms = float(m.group(1)) if m else 0.0
+        except ValueError:
+            return fallback
+        if timeout_ms <= 0:
+            return fallback
+        return timeout_ms / 1000.0 + 0.5
+
+    # ------------------------------------------------------------- metrics
+    def _collect(self) -> Dict[str, Any]:
+        replicas = self.registry.snapshot()
+        return {
+            "replicas": len(replicas),
+            "replicas_ready": sum(1 for r in replicas if r["ready"]),
+            "breakers_open": sum(
+                1 for r in replicas if r["breaker"]["state"] != CLOSED
+            ),
+        }
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "replicas": self.registry.snapshot(),
+            "ready": self.registry.ready_count(),
+            "config": {
+                "retries": self.config.retries,
+                "hedge_ms": self.config.hedge_ms,
+                "request_timeout_s": self.config.request_timeout_s,
+            },
+        }
+
+
+# ----------------------------------------------------------- http plumbing
+def _http_request(
+    base_url: str,
+    method: str,
+    path: str,
+    body: Optional[bytes] = None,
+    timeout_s: float = 10.0,
+) -> Tuple[int, Dict[str, str], bytes]:
+    """One HTTP exchange against ``base_url`` (``host:port`` or
+    ``http://host:port``); returns (status, headers, body). Raises
+    OSError subclasses (incl. socket.timeout) on network failure."""
+    hostport = base_url.split("://", 1)[-1].rstrip("/")
+    conn = http.client.HTTPConnection(hostport, timeout=timeout_s)
+    try:
+        headers = {"Content-Type": "application/json"} if body else {}
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        payload = resp.read()
+        keep = {}
+        for k in ("Content-Type", "Retry-After"):
+            v = resp.getheader(k)
+            if v is not None:
+                keep[k] = v
+        return resp.status, keep, payload
+    finally:
+        conn.close()
+
+
+# ----------------------------------------------------------------- HTTP shim
+MAX_BODY_BYTES = 64 * 1024 * 1024  # match serve/server.py
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server_version = "seist-router/0.1"
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def router(self) -> Router:
+        return self.server.router  # type: ignore[attr-defined]
+
+    def log_message(self, format: str, *args: Any) -> None:
+        logger.debug(f"[router] {self.address_string()} {format % args}")
+
+    def _reply(
+        self,
+        status: int,
+        body: bytes,
+        ctype: str = "application/json",
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            if k.lower() != "content-type":
+                self.send_header(k, v)
+        if self.close_connection:
+            # Tell the client, not just the socket: without the header an
+            # HTTP/1.1 client assumes keep-alive and retries a dead conn
+            # (same contract as serve/server.py's _reply).
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, status: int, payload: Any) -> None:
+        self._reply(status, json.dumps(payload).encode())
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        try:
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
+                ready = self.router.registry.ready_count()
+                self._reply_json(
+                    200 if ready else 503,
+                    {"status": "ok" if ready else "no_replicas",
+                     "ready_replicas": ready},
+                )
+            elif path == "/router/replicas":
+                self._reply_json(200, self.router.status())
+            elif path == "/metrics":
+                from seist_tpu.obs.bus import render_prometheus
+
+                self._reply(
+                    200,
+                    render_prometheus(self.router._bus).encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            else:
+                self._reply_json(404, {"error": "not_found",
+                                       "message": self.path})
+        except Exception as e:  # noqa: BLE001 — a handler bug must 500,
+            # not kill the connection thread mid-response
+            self._reply_json(500, {"error": "internal", "message": repr(e)})
+
+    def do_POST(self) -> None:  # noqa: N802
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            if length > MAX_BODY_BYTES:
+                self.close_connection = True
+                self._reply_json(
+                    413,
+                    {"error": "too_large",
+                     "message": f"body {length} > {MAX_BODY_BYTES} bytes"},
+                )
+                return
+            body = self.rfile.read(length)
+            path = self.path.split("?", 1)[0]
+            if path in ("/predict", "/annotate"):
+                status, headers, payload = self.router.forward(path, body)
+                self._reply(status, payload, headers=headers)
+            elif path == "/router/register":
+                url = self._admin_url(body)
+                if url:
+                    self.router.registry.add(url)
+                    self._reply_json(200, {"registered": url})
+            elif path == "/router/deregister":
+                url = self._admin_url(body)
+                if url:
+                    removed = self.router.registry.remove(url)
+                    self._reply_json(
+                        200 if removed else 404, {"deregistered": removed}
+                    )
+            else:
+                self._reply_json(404, {"error": "not_found",
+                                       "message": self.path})
+        except Exception as e:  # noqa: BLE001 — same contract as do_GET
+            logger.warning(f"[router] unhandled error: {e!r}")
+            self._reply_json(500, {"error": "internal", "message": repr(e)})
+
+    def _admin_url(self, body: bytes) -> Optional[str]:
+        try:
+            url = json.loads(body.decode()).get("url", "")
+        except (ValueError, UnicodeDecodeError, AttributeError):
+            url = ""
+        if not isinstance(url, str) or not url:
+            self._reply_json(400, {"error": "bad_request",
+                                   "message": "body must be {'url': ...}"})
+            return None
+        return url
+
+
+class RouterHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    # socketserver's default listen backlog is 5: under an open-loop
+    # connection burst (every bench/ops client opens a conn per request)
+    # SYNs overflow the backlog and get silently dropped, and the client
+    # kernel retries at 1/3/7/15/31 s — which shows up as latency
+    # *clusters* at exactly those values while the service itself is
+    # idle. A front tier must absorb accept bursts; overload policy
+    # belongs to the shed/429 tiers, not the kernel's SYN queue.
+    request_queue_size = 1024
+
+    def __init__(self, addr: Tuple[str, int], router: Router):
+        super().__init__(addr, _RouterHandler)
+        self.router = router
+
+
+def start_router_server(
+    router: Router, host: str = "127.0.0.1", port: int = 8080
+) -> RouterHTTPServer:
+    """Bind + serve on a daemon thread (ephemeral port via ``port=0``);
+    also starts the health prober."""
+    server = RouterHTTPServer((host, port), router)
+    thread = threading.Thread(
+        target=server.serve_forever, name="router-http", daemon=True
+    )
+    thread.start()
+    router.start_prober()
+    return server
+
+
+# ----------------------------------------------------------------- CLI
+def get_router_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        prog="router",
+        description="seist_tpu serving front tier: replica router",
+    )
+    ap.add_argument(
+        "--replica", action="append", default=[], metavar="HOST:PORT",
+        help="replica base address, repeatable (more can be registered "
+        "at runtime via POST /router/register)",
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--retries", type=int, default=2)
+    ap.add_argument("--request-timeout-s", type=float, default=10.0)
+    ap.add_argument("--hedge-ms", type=float, default=0.0)
+    ap.add_argument("--probe-interval-s", type=float, default=1.0)
+    ap.add_argument("--breaker-failures", type=int, default=3)
+    ap.add_argument("--breaker-cooldown-s", type=float, default=2.0)
+    ap.add_argument("--breaker-latency-trip-ms", type=float,
+                    default=float("inf"))
+    return ap.parse_args(argv)
+
+
+def router_from_args(args: argparse.Namespace) -> Router:
+    config = RouterConfig(
+        retries=args.retries,
+        request_timeout_s=args.request_timeout_s,
+        hedge_ms=args.hedge_ms,
+        probe_interval_s=args.probe_interval_s,
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        breaker_latency_trip_ms=args.breaker_latency_trip_ms,
+    )
+    router = Router(config=config)
+    for url in args.replica:
+        router.registry.add(url)
+    return router
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = get_router_args(argv)
+    router = router_from_args(args)
+    server = start_router_server(router, args.host, args.port)
+    host, port = server.server_address[:2]
+    logger.info(
+        f"[router] listening on http://{host}:{port} "
+        f"replicas={[r.url for r in router.registry.replicas()]}"
+    )
+    stop = threading.Event()
+    import signal
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    server.shutdown()
+    router.stop()
+    logger.info("[router] stopped")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,7 @@ import numpy as np
 from seist_tpu.serve.batcher import BatcherConfig, MicroBatcher
 from seist_tpu.serve.pool import ModelPool, decode_outputs
 from seist_tpu.serve.protocol import (
+    PRIORITIES,
     BadRequest,
     DeadlineExceeded,
     PredictOptions,
@@ -48,12 +49,27 @@ from seist_tpu.serve.protocol import (
     parse_body,
     parse_waveform,
 )
+from seist_tpu.serve.shed import AdmissionController, ShedConfig
+from seist_tpu.utils.faults import ServeFaultInjector
 from seist_tpu.utils.logger import logger
 from seist_tpu.utils.meters import LatencyHistogram
 
 MAX_BODY_BYTES = 64 * 1024 * 1024  # one hours-long fp32 record is ~tens of MB
 
 _NORM_MODES = ("std", "max", "absmax", "")
+
+# Clean-preempt exit code (sysexits EX_TEMPFAIL), shared with the train
+# plane: a SIGTERM'd replica drains and exits 75, telling its supervisor
+# (tools/supervise_fleet.py) "managed drain — relaunch immediately, budget
+# untouched". Kept in sync with seist_tpu.train.checkpoint.PREEMPT_EXIT_CODE
+# by tests/test_serve_fleet.py (checkpoint.py drags orbax in; a serve
+# replica should not pay that import).
+PREEMPT_EXIT_CODE = 75
+
+#: replica lifecycle as a scrapeable gauge (serve_state_code): the
+#: warming -> ok -> draining state machine the router's health probes,
+#: the flight recorder and events.jsonl all see identically.
+STATE_CODES = {"dead": 0, "warming": 1, "ok": 2, "draining": 3}
 
 
 class ServeService:
@@ -65,20 +81,44 @@ class ServeService:
         pool: ModelPool,
         batcher_config: Optional[BatcherConfig] = None,
         warmup_async: bool = False,
+        shed_config: Optional[ShedConfig] = None,
+        event_log: Optional[Any] = None,  # obs.EventLog
+        faults: Optional[ServeFaultInjector] = None,
     ):
         self.pool = pool
         self.config = batcher_config or BatcherConfig()
         self.buckets = self.config.resolved_buckets()
+        self.shed_config = shed_config or ShedConfig()
+        self._event_log = event_log
+        # Serving-plane fault injection (SEIST_FAULT_SERVE_*): inert
+        # unless the env schedules a fault targeting this replica.
+        self._faults = faults if faults is not None else (
+            ServeFaultInjector.from_env()
+        )
         self._batchers: Dict[str, MicroBatcher] = {}
+        self._shedders: Dict[str, AdmissionController] = {}
         for name in pool.names():
             entry = pool.get(name)
             import jax.numpy as jnp
 
             fwd = entry.forward
+            injector = self._faults
+
+            def batched_forward(batch, _f=fwd, _inj=injector):
+                # Injected model slowness runs IN the flush thread, so
+                # queued requests age exactly as behind a slow device.
+                _inj.forward_delay()
+                return _f(jnp.asarray(batch))
+
             self._batchers[name] = MicroBatcher(
-                lambda batch, _f=fwd: _f(jnp.asarray(batch)),
-                self.config,
-                name=name,
+                batched_forward, self.config, name=name
+            )
+            # Tiered admission gate per model, fed by that model's
+            # batcher queue-delay estimate (serve/shed.py).
+            self._shedders[name] = AdmissionController(
+                self._batchers[name].queue_delay_ms,
+                self.shed_config,
+                model=name,
             )
         self._annotate_locks = {n: threading.Lock() for n in pool.names()}
         self.annotate_latency_ms = LatencyHistogram()
@@ -97,12 +137,14 @@ class ServeService:
         # exactly what a load balancer wants.
         self._warming = True
         self._warmup_error: Optional[BaseException] = None
+        self._last_state: Optional[str] = None
         # Metrics-bus collector (obs/bus.py): the request/annotate half
         # of metrics(); batchers self-register their own. One key per
         # service — a restarted service replaces its predecessor.
         from seist_tpu.obs.bus import BUS
 
         BUS.register_collector("serve", self._bus_metrics)
+        self.publish_state("startup")
         if warmup_async:
             threading.Thread(
                 target=self._run_warmup, name="serve-warmup", daemon=True
@@ -116,6 +158,7 @@ class ServeService:
         try:
             self.pool.warmup(self.buckets)
             self._warming = False
+            self.publish_state("warmup_done")
         except BaseException as e:  # noqa: BLE001
             # A failed warm-up (compile OOM, bad bucket, XLA error) must
             # never flip the service to ready: record it so liveness goes
@@ -123,6 +166,38 @@ class ServeService:
             # equivalent of the sync path's crash.
             self._warmup_error = e
             logger.warning(f"[serve] warm-up failed: {e!r}")
+            self.publish_state("warmup_failed")
+
+    # ------------------------------------------------------ lifecycle state
+    def publish_state(self, reason: str = "") -> None:
+        """Publish the replica lifecycle state machine (warming -> ok ->
+        draining, or -> dead) everywhere an observer might look: a bus
+        gauge (``serve_state_code``, scraped by Prometheus and the
+        router's operators), a structured ``events.jsonl`` event, and the
+        flight recorder ring when one is installed — one state machine,
+        three views (docs/SERVING.md). Transition-edge-triggered: calling
+        it redundantly is free."""
+        state = self._state_str()
+        with self._lock:
+            if state == self._last_state:
+                return
+            prev, self._last_state = self._last_state, state
+        from seist_tpu.obs import flight
+        from seist_tpu.obs.bus import BUS
+
+        BUS.gauge("serve_state_code").set(STATE_CODES.get(state, 0))
+        if self._event_log is not None:
+            self._event_log.emit(
+                "serve_state", state=state, prev=prev, reason=reason
+            )
+        rec = flight.get()
+        if rec is not None:
+            rec.record_event("serve_state", state=state, prev=prev,
+                             reason=reason)
+        logger.info(
+            f"[serve] state {prev or 'start'} -> {state}"
+            + (f" ({reason})" if reason else "")
+        )
 
     # ----------------------------------------------------------- predict
     def predict(
@@ -136,6 +211,16 @@ class ServeService:
             raise ShuttingDown("service is draining")
         entry = self.pool.get(model)
         opts = PredictOptions.from_dict(options)
+        # Request arrival: count, fire any scheduled serving fault
+        # (SIGKILL at request k / black-hole window), then the admission
+        # gate — shedding happens BEFORE the expensive waveform parse, so
+        # an overloaded replica spends no decode work on a request it is
+        # about to drop.
+        with self._lock:
+            self._requests["predict"] += 1
+            n_request = self._requests["predict"]
+        self._faults.on_request(n_request)
+        self._shedders[entry.name].admit(opts.priority)
         x = parse_waveform(data, entry.in_channels)
         if x.shape[0] > entry.window:
             raise BadRequest(
@@ -147,9 +232,9 @@ class ServeService:
         if n_real < entry.window:  # pad AFTER normalize: zeros stay zero
             pad = np.zeros((entry.window - n_real, x.shape[1]), dtype=x.dtype)
             x = np.concatenate([x, pad], axis=0)
-        with self._lock:
-            self._requests["predict"] += 1
-        raw = self._batchers[entry.name].submit(x, timeout_ms=opts.timeout_ms)
+        raw = self._batchers[entry.name].submit(
+            x, timeout_ms=opts.timeout_ms, rank=PRIORITIES[opts.priority]
+        )
         result = decode_outputs(entry, raw, opts)
         if n_real < entry.window:
             # The signal->zeros step at the padding boundary can fabricate
@@ -176,6 +261,9 @@ class ServeService:
                 "needs (non|det, ppk, spk) outputs"
             )
         opts = PredictOptions.from_dict(options)
+        # Same tiered gate as /predict: an overloaded replica sheds
+        # low-tier record backfill before paying the (large) record parse.
+        self._shedders[entry.name].admit(opts.priority)
         record = parse_waveform(data, entry.in_channels)
         if record.shape[0] < entry.window:
             raise BadRequest(
@@ -295,6 +383,10 @@ class ServeService:
                 name: batcher.stats()
                 for name, batcher in self._batchers.items()
             },
+            "shed": {
+                name: shedder.stats()
+                for name, shedder in self._shedders.items()
+            },
         }
 
     def _bus_metrics(self) -> Dict[str, Any]:
@@ -302,6 +394,7 @@ class ServeService:
         the per-model stats (batchers publish those themselves, labeled)."""
         m = self.metrics()
         m.pop("models", None)
+        m.pop("shed", None)  # AdmissionControllers publish their own
         return m
 
     def metrics_prometheus(self) -> str:
@@ -319,12 +412,16 @@ class ServeService:
         handler calls this so in-flight work finishes while the load
         balancer routes away."""
         self._draining = True
+        self.publish_state("drain")
 
     def shutdown(self, drain: bool = True) -> None:
         """Refuse new work, then (with ``drain``) serve what's queued."""
         self._draining = True
+        self.publish_state("shutdown")
         for batcher in self._batchers.values():
             batcher.shutdown(drain=drain)
+        for shedder in self._shedders.values():
+            shedder.close()
         # Mirror the batchers: a shut-down service must neither pin the
         # model pool via the bus's collector ref nor report its stale
         # request counters as live on a later scrape.
@@ -379,11 +476,18 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, format: str, *args: Any) -> None:
         logger.debug(f"[serve] {self.address_string()} {format % args}")
 
-    def _reply(self, status: int, payload: Dict[str, Any]) -> None:
+    def _reply(
+        self,
+        status: int,
+        payload: Dict[str, Any],
+        extra_headers: Optional[Dict[str, str]] = None,
+    ) -> None:
         body = json_bytes(payload)
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         if self.close_connection:
             # Tell the client, not just the socket: without the header an
             # HTTP/1.1 client assumes keep-alive and retries a dead conn.
@@ -466,7 +570,8 @@ class _Handler(BaseHTTPRequestHandler):
             )
             self._reply(200, result)
         except ServeError as e:
-            self._reply(e.status, e.payload())
+            # e.headers() carries e.g. the shed path's Retry-After.
+            self._reply(e.status, e.payload(), extra_headers=e.headers())
         except Exception as e:  # noqa: BLE001
             logger.warning(f"[serve] unhandled error: {e!r}")
             self._reply(500, {"error": "internal", "message": repr(e)})
@@ -475,6 +580,12 @@ class _Handler(BaseHTTPRequestHandler):
 class ServeHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # socketserver's default listen backlog is 5: a conn-per-request
+    # client burst overflows it and dropped SYNs retry at 1/3/7/15/31 s,
+    # showing up as client-side latency clusters while the batcher is
+    # idle. Overload must surface via the shed/429 tiers, not the
+    # kernel's SYN queue (see RouterHTTPServer).
+    request_queue_size = 1024
 
     def __init__(self, addr: Tuple[str, int], service: ServeService):
         super().__init__(addr, _Handler)
@@ -518,6 +629,17 @@ def get_serve_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         "--max-batch); largest must equal --max-batch",
     )
     ap.add_argument("--seed", type=int, default=0)
+    # Adaptive load shedding (serve/shed.py): per-tier queue-delay
+    # budgets. 'inf' disables policy shedding for a tier.
+    ap.add_argument("--shed-batch-delay-ms", type=float, default=50.0,
+                    help="shed 'batch' tier above this queue delay")
+    ap.add_argument("--shed-interactive-delay-ms", type=float,
+                    default=250.0,
+                    help="shed 'interactive' tier above this queue delay")
+    ap.add_argument("--shed-alert-delay-ms", type=float,
+                    default=float("inf"),
+                    help="shed 'alert' tier above this queue delay "
+                    "(default: never — alerts ride to the 429 bound)")
     return ap.parse_args(argv)
 
 
@@ -553,6 +675,9 @@ def watch_until_shutdown(
                 if sick
                 else f"warm-up failed: {service._warmup_error!r}"
             )
+            publish = getattr(service, "publish_state", None)
+            if publish is not None:  # tests pass bare namespaces
+                publish(reason)
             logger.warning(f"[serve] {reason}; exiting 1")
             return 1
         stop.wait(poll_s)
@@ -560,9 +685,14 @@ def watch_until_shutdown(
 
 
 def main(argv: Optional[List[str]] = None) -> None:
+    from seist_tpu.utils.misc import enable_compile_cache
     from seist_tpu.utils.platform import honor_jax_platforms
 
     honor_jax_platforms()
+    # Warm-up compiles dominate replica startup; the persistent cache
+    # (same one cli.main_worker uses) makes a supervisor relaunch re-enter
+    # rotation in seconds instead of re-paying every bucket's compile.
+    enable_compile_cache()
     args = get_serve_args(argv)
     entries = parse_model_flags(args)
     config = BatcherConfig(
@@ -575,11 +705,27 @@ def main(argv: Optional[List[str]] = None) -> None:
             else None
         ),
     )
+    import os as _os
+
+    from seist_tpu.obs.bus import EventLog
+
+    shed_config = ShedConfig(
+        batch_delay_ms=args.shed_batch_delay_ms,
+        interactive_delay_ms=args.shed_interactive_delay_ms,
+        alert_delay_ms=args.shed_alert_delay_ms,
+    )
+    # Replica lifecycle events (warming/ok/draining + shed decisions) go
+    # to the same events.jsonl the train worker writes — one forensic
+    # stream per logdir regardless of plane.
+    events = EventLog(_os.path.join(logger.logdir(), "events.jsonl"))
     pool = ModelPool(entries, window=args.window, seed=args.seed)
     # Async warm-up: the socket (and /healthz/ready, reporting 503
     # "warming") comes up immediately; orchestrators gate traffic on
     # readiness instead of timing out their liveness probe on the compile.
-    service = ServeService(pool, config, warmup_async=True)
+    service = ServeService(
+        pool, config, warmup_async=True, shed_config=shed_config,
+        event_log=events,
+    )
     server = start_http_server(service, args.host, args.port)
     host, port = server.server_address[:2]
     logger.info(
@@ -590,10 +736,15 @@ def main(argv: Optional[List[str]] = None) -> None:
     import signal
 
     stop = threading.Event()
+    # SIGTERM = managed preemption (orchestrator reschedule, node drain):
+    # drain in-flight work, then exit PREEMPT_EXIT_CODE so the fleet
+    # supervisor relaunches immediately with its retry budget untouched.
+    # SIGINT = an operator stopping the process: exit 0, no relaunch.
+    exit_code = {"rc": 0}
 
-    # Containers stop with SIGTERM; flip to not-ready first so the load
-    # balancer routes away, then drain what's queued.
     def _term(signum, frame):
+        if signum == signal.SIGTERM:
+            exit_code["rc"] = PREEMPT_EXIT_CODE
         service.begin_drain()
         stop.set()
 
@@ -601,14 +752,16 @@ def main(argv: Optional[List[str]] = None) -> None:
     signal.signal(signal.SIGINT, _term)
     rc = watch_until_shutdown(service, stop)
     if rc == 0:
+        rc = exit_code["rc"]
         logger.info("[serve] draining...")
         service.shutdown(drain=True)
         server.shutdown()
-        logger.info("[serve] stopped")
+        logger.info(f"[serve] stopped (rc={rc})")
     else:
         server.shutdown()
         service.shutdown(drain=False)
         logger.info("[serve] stopped (unhealthy)")
+    events.close()
     if rc:
         raise SystemExit(rc)
 

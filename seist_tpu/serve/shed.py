@@ -1,0 +1,172 @@
+"""Adaptive admission control: priority tiers + queue-delay-based load
+shedding, per replica.
+
+The PR 1 overload story was a single blanket 429 once the bounded queue
+filled — every client class treated alike, and by the time the queue is
+full the requests inside it are already doomed to blow their deadlines.
+Continuous seismic monitoring cannot afford that: a streaming-alert pick
+request during an event matters more than a batch backfill request, and
+the service must say so *before* the queue rots.
+
+This module is the replica-side half of the fleet resilience plane
+(docs/SERVING.md; the router in serve/router.py is the front-tier half):
+
+* Requests carry a **priority tier** (``options.priority``):
+  ``alert`` > ``interactive`` (default) > ``batch``
+  (:data:`~seist_tpu.serve.protocol.PRIORITIES`).
+* The overload signal is the micro-batcher's **estimated queue delay**
+  (``MicroBatcher.queue_delay_ms``: head-of-line sojourn + queued flush
+  waves x EWMA service time — the CoDel design, self-clocking and free
+  of wall-clock/config guesswork).
+* Each tier has a delay threshold; when the estimate exceeds it, that
+  tier is **shed** with a 503 + ``Retry-After`` (protocol.Overloaded,
+  code ``shed``) — distinct from the queue-full 429, which remains the
+  last-ditch hard bounce for whatever is still admitted. Hysteresis
+  (re-admit only below ``threshold * hysteresis``) keeps the decision
+  from flapping at the boundary.
+* Every decision is counted on the PR 6 metrics bus
+  (``seist_serve_shed_*{model=,tier=}``) plus a live gauge of the
+  current delay estimate and shed level.
+
+One controller per model (each model has its own batcher, hence its own
+queue delay); ``ServeService`` consults it at the top of ``predict``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from seist_tpu.serve.protocol import DEFAULT_PRIORITY, PRIORITIES, Overloaded
+
+
+@dataclass(frozen=True)
+class ShedConfig:
+    """Per-tier queue-delay thresholds (ms). ``float('inf')`` = the tier
+    is never policy-shed (it can still hit the 429 queue bound)."""
+
+    #: shed ``batch`` backfill when the estimated delay exceeds this
+    batch_delay_ms: float = 50.0
+    #: shed ``interactive`` when it exceeds this
+    interactive_delay_ms: float = 250.0
+    #: ``alert`` is shed only above this (default: never — alerts ride
+    #: the queue to the 429 bound; a missed alert is a missed event)
+    alert_delay_ms: float = float("inf")
+    #: re-admit a shed tier only once delay < threshold * hysteresis
+    hysteresis: float = 0.5
+    #: floor for the computed Retry-After (seconds)
+    min_retry_after_s: float = 1.0
+
+    def threshold_ms(self, tier: str) -> float:
+        return {
+            "alert": self.alert_delay_ms,
+            "interactive": self.interactive_delay_ms,
+            "batch": self.batch_delay_ms,
+        }[tier]
+
+
+@dataclass
+class _TierState:
+    shedding: bool = False
+    admitted: int = 0
+    shed: int = 0
+
+
+class AdmissionController:
+    """Tiered queue-delay admission gate for one model's batcher.
+
+    ``admit(priority)`` either returns (request admitted; proceed to the
+    batcher, which may still 429) or raises :class:`Overloaded` with a
+    Retry-After derived from the current delay estimate. Thread-safe;
+    the delay callable is read outside the lock (it locks the batcher
+    itself)."""
+
+    def __init__(
+        self,
+        delay_ms_fn: Callable[[], float],
+        config: Optional[ShedConfig] = None,
+        model: str = "default",
+    ):
+        self._delay_ms = delay_ms_fn
+        self.config = config or ShedConfig()
+        self.model = model
+        self._lock = threading.Lock()
+        self._tiers: Dict[str, _TierState] = {
+            t: _TierState() for t in PRIORITIES
+        }
+        # Metrics-bus surface: scrape-time collector, one family with
+        # model labels (the serve_batcher precedent — keyed by model so a
+        # restarted service's controller replaces its predecessor).
+        from seist_tpu.obs.bus import BUS
+
+        self._collector_key = f"serve_shed:{model}"
+        BUS.register_collector(
+            self._collector_key, self.stats, name="serve_shed", model=model
+        )
+
+    # ------------------------------------------------------------- admit
+    def admit(self, priority: str = DEFAULT_PRIORITY) -> None:
+        """Admit or shed one request of tier ``priority``.
+
+        Raises :class:`Overloaded` (503 + Retry-After) when the tier is
+        shedding. The shed decision per tier is sticky (hysteresis): it
+        flips on above ``threshold`` and off below ``threshold *
+        hysteresis``, so one noisy estimate doesn't flap admission."""
+        if priority not in PRIORITIES:
+            # Protocol validation rejects these before we're called;
+            # guard against programmatic callers all the same.
+            priority = DEFAULT_PRIORITY
+        delay_ms = self._delay_ms()
+        threshold = self.config.threshold_ms(priority)
+        with self._lock:
+            state = self._tiers[priority]
+            if state.shedding:
+                if delay_ms < threshold * self.config.hysteresis:
+                    state.shedding = False
+            elif delay_ms > threshold:
+                state.shedding = True
+            if state.shedding:
+                state.shed += 1
+                retry_after_s = max(
+                    self.config.min_retry_after_s, 2.0 * delay_ms / 1e3
+                )
+                raise Overloaded(
+                    f"tier '{priority}' shed: queue delay "
+                    f"{delay_ms:.0f} ms > {threshold:.0f} ms budget "
+                    f"(model '{self.model}')",
+                    retry_after_s=retry_after_s,
+                )
+            state.admitted += 1
+
+    # ------------------------------------------------------------- stats
+    def shed_level(self) -> int:
+        """Number of tiers currently shedding (0 = fully open; 3 = even
+        alerts shed). The one-number overload gauge for dashboards."""
+        with self._lock:
+            return sum(1 for s in self._tiers.values() if s.shedding)
+
+    def stats(self) -> Dict[str, Any]:
+        delay_ms = self._delay_ms()
+        with self._lock:
+            return {
+                "queue_delay_ms": round(delay_ms, 3),
+                "level": sum(
+                    1 for s in self._tiers.values() if s.shedding
+                ),
+                "tiers": {
+                    t: {
+                        "shedding": s.shedding,
+                        "admitted": s.admitted,
+                        "shed": s.shed,
+                    }
+                    for t, s in self._tiers.items()
+                },
+            }
+
+    def close(self) -> None:
+        """Unregister the bus collector (service shutdown); fn-guarded so
+        a late close never tears down a successor's registration."""
+        from seist_tpu.obs.bus import BUS
+
+        BUS.unregister_collector(self._collector_key, fn=self.stats)

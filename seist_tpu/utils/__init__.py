@@ -1,3 +1,30 @@
-from seist_tpu.utils.logger import logger  # noqa: F401
-from seist_tpu.utils.meters import AverageMeter, ProgressMeter  # noqa: F401
-from seist_tpu.utils import misc  # noqa: F401
+"""Shared utilities. Re-exports resolve lazily (PEP 562): ``misc``
+imports jax at module level, and an eager pull here would drag jax into
+the jax-free serving front tier (serve/router.py imports
+utils.logger, which executes this package __init__)."""
+
+_LAZY = {
+    "logger": ("seist_tpu.utils.logger", "logger"),
+    "AverageMeter": ("seist_tpu.utils.meters", "AverageMeter"),
+    "ProgressMeter": ("seist_tpu.utils.meters", "ProgressMeter"),
+    "misc": ("seist_tpu.utils.misc", None),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module 'seist_tpu.utils' has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    module = importlib.import_module(module_name)
+    obj = module if attr is None else getattr(module, attr)
+    globals()[name] = obj
+    return obj
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

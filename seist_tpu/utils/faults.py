@@ -50,6 +50,36 @@ the post-split index space the Loader shuffles over)::
                                 batch index (stall-watchdog e2e)
     SEIST_FAULT_IO_STALL_SEC    stall duration in seconds (default 3600)
 
+Serving-plane knobs (consumed by ``seist_tpu/serve/server.py``; request
+numbers are 1-based per-process /predict ordinals, so "kill at request
+k" is deterministic under any client concurrency)::
+
+    SEIST_FAULT_SERVE_KILL_REQ        SIGKILL the replica when its k-th
+                                      /predict request arrives (mid-load
+                                      hard crash; the router must retry
+                                      the in-flight failures elsewhere)
+    SEIST_FAULT_SERVE_SLOW_MS         sleep this long inside the model
+                                      forward for every flush (forces the
+                                      504 deadline path; per-replica slow)
+    SEIST_FAULT_SERVE_BLACKHOLE_AFTER accept requests after the k-th but
+                                      never answer them (hold the socket
+                                      open) — the failure mode health
+                                      probes CANNOT see, which only a
+                                      request-path circuit breaker
+                                      catches
+    SEIST_FAULT_SERVE_BLACKHOLE_COUNT ...for this many requests, then
+                                      recover (default: forever). A
+                                      finite count lets the breaker's
+                                      half-open probes find the recovery
+                                      and close the circuit.
+    SEIST_FAULT_SERVE_REPLICA         only fire in the replica whose
+                                      SEIST_SERVE_REPLICA index (set by
+                                      tools/supervise_fleet.py) matches;
+                                      -1/absent = fire in any replica
+    SEIST_FAULT_STAMP                 shared with the train plane: the
+                                      serve kill fires at most once
+                                      across replica relaunches
+
 The injector is deliberately dependency-free above numpy/jax tree utils:
 it must be importable (and inert) in every entry point that might train.
 """
@@ -58,6 +88,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Mapping, Optional, Set
@@ -214,21 +245,41 @@ class IoFaultInjector:
             time.sleep(self.plan.stall_sec)
 
 
+class _Stamps:
+    """Fired-fault bookkeeping, optionally persisted to a stamp file so a
+    fault fires at most once across process relaunches. The stamp is read
+    at construction and appended to just before the fault fires, fsynced
+    — even a SIGKILL cannot outrun it."""
+
+    def __init__(self, path: str = ""):
+        self.path = path
+        self._fired: Set[str] = set()
+        if path and os.path.exists(path):
+            with open(path) as f:
+                self._fired = {line.strip() for line in f if line.strip()}
+
+    def armed(self, name: str) -> bool:
+        return name not in self._fired
+
+    def mark(self, name: str) -> None:
+        self._fired.add(name)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(name + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+
 class FaultInjector:
     """Step-boundary fault driver. ``on_step`` fires process-level faults
     (kill / sigterm / slow); ``corrupt_inputs`` handles the numeric one.
 
     Each named fault fires once per process; with a stamp file, once per
-    *run* (surviving relaunches — the stamp is read at construction and
-    appended to just before the fault fires, so even a SIGKILL cannot
-    outrun it)."""
+    *run* (surviving relaunches — see :class:`_Stamps`)."""
 
     def __init__(self, plan: Optional[FaultPlan] = None):
         self.plan = plan or FaultPlan()
-        self._fired: Set[str] = set()
-        if self.plan.stamp_path and os.path.exists(self.plan.stamp_path):
-            with open(self.plan.stamp_path) as f:
-                self._fired = {line.strip() for line in f if line.strip()}
+        self._stamps = _Stamps(self.plan.stamp_path)
 
     @classmethod
     def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "FaultInjector":
@@ -240,17 +291,12 @@ class FaultInjector:
 
     # ------------------------------------------------------------- internals
     def _armed(self, name: str) -> bool:
-        return name not in self._fired
+        return self._stamps.armed(name)
 
     def _mark(self, name: str) -> None:
         """Record a firing BEFORE acting on it: SIGKILL never returns, so
         the stamp write must precede the kill or relaunches loop forever."""
-        self._fired.add(name)
-        if self.plan.stamp_path:
-            with open(self.plan.stamp_path, "a") as f:
-                f.write(name + "\n")
-                f.flush()
-                os.fsync(f.fileno())
+        self._stamps.mark(name)
 
     # ------------------------------------------------------------- step hook
     def on_step(self, step: int, n_steps: int = 1) -> None:
@@ -300,3 +346,120 @@ class FaultInjector:
         import jax
 
         return jax.tree.map(lambda x: x * np.float32("nan"), inputs)
+
+
+# --------------------------------------------------------------- serve plane
+@dataclass(frozen=True)
+class ServeFaultPlan:
+    """Parsed serving-plane fault schedule (inert by default). Request
+    numbers are 1-based per-process /predict ordinals."""
+
+    kill_req: int = -1
+    slow_ms: float = 0.0
+    blackhole_after: int = -1
+    blackhole_count: int = 1 << 30  # default: never recovers
+    blackhole_hold_s: float = 3600.0
+    replica: int = -1  # only fire in this SEIST_SERVE_REPLICA; -1 = any
+    stamp_path: str = ""
+
+    @classmethod
+    def from_env(
+        cls, env: Optional[Mapping[str, str]] = None
+    ) -> "ServeFaultPlan":
+        env = os.environ if env is None else env
+        return cls(
+            kill_req=_env_int(env, "SEIST_FAULT_SERVE_KILL_REQ", -1),
+            slow_ms=_env_float(env, "SEIST_FAULT_SERVE_SLOW_MS", 0.0),
+            blackhole_after=_env_int(
+                env, "SEIST_FAULT_SERVE_BLACKHOLE_AFTER", -1
+            ),
+            blackhole_count=max(
+                1, _env_int(env, "SEIST_FAULT_SERVE_BLACKHOLE_COUNT", 1 << 30)
+            ),
+            blackhole_hold_s=_env_float(
+                env, "SEIST_FAULT_SERVE_BLACKHOLE_HOLD_S", 3600.0
+            ),
+            replica=_env_int(env, "SEIST_FAULT_SERVE_REPLICA", -1),
+            stamp_path=env.get("SEIST_FAULT_STAMP", ""),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return (
+            self.kill_req >= 0
+            or self.slow_ms > 0
+            or self.blackhole_after >= 0
+        )
+
+
+class ServeFaultInjector:
+    """Serving-plane fault driver, consulted by ``ServeService``.
+
+    ``on_request(n)`` runs at request arrival (kill / black-hole);
+    ``forward_delay()`` runs inside the batcher's forward closure (slow
+    model — the flush thread sleeps, so queued requests age exactly as
+    they would behind a genuinely slow accelerator). Faults can be scoped
+    to one replica of a fleet: tools/supervise_fleet.py exports
+    ``SEIST_SERVE_REPLICA=<index>`` per replica, and a plan with
+    ``replica >= 0`` only fires where the two match."""
+
+    def __init__(
+        self,
+        plan: Optional[ServeFaultPlan] = None,
+        replica_index: Optional[int] = None,
+    ):
+        self.plan = plan or ServeFaultPlan()
+        if replica_index is None:
+            replica_index = _env_int(os.environ, "SEIST_SERVE_REPLICA", -1)
+        self.replica_index = replica_index
+        self._stamps = _Stamps(self.plan.stamp_path)
+        self._lock = threading.Lock()
+        self._blackholed = 0
+
+    @classmethod
+    def from_env(
+        cls, env: Optional[Mapping[str, str]] = None
+    ) -> "ServeFaultInjector":
+        return cls(ServeFaultPlan.from_env(env))
+
+    @property
+    def enabled(self) -> bool:
+        """True when any fault is scheduled AND targets this replica."""
+        if not self.plan.enabled:
+            return False
+        return self.plan.replica < 0 or self.plan.replica == self.replica_index
+
+    # ---------------------------------------------------------- request hook
+    def on_request(self, n: int) -> None:
+        """Fire request-arrival faults for the ``n``-th (1-based) /predict
+        request. Kill is >= (not ==) so concurrent arrivals can't skip
+        past the trigger; the stamp makes it fire once across relaunches."""
+        if not self.enabled:
+            return
+        p = self.plan
+        if p.kill_req >= 0 and n >= p.kill_req and self._stamps.armed(
+            "serve_kill"
+        ):
+            self._stamps.mark("serve_kill")
+            logger.warning(f"[faults] serve SIGKILL at request {n}")
+            os.kill(os.getpid(), signal.SIGKILL)
+        if p.blackhole_after >= 0 and n > p.blackhole_after:
+            with self._lock:
+                fire = self._blackholed < p.blackhole_count
+                if fire:
+                    self._blackholed += 1
+            if fire:
+                logger.warning(
+                    f"[faults] serve black-hole: request {n} accepted, "
+                    f"never answered ({self._blackholed}/{p.blackhole_count})"
+                )
+                # Hold the handler thread (and the client's socket) open:
+                # the request is accepted but no bytes ever come back —
+                # exactly what a wedged replica looks like from outside.
+                time.sleep(p.blackhole_hold_s)
+
+    # ---------------------------------------------------------- forward hook
+    def forward_delay(self) -> None:
+        """Sleep inside the model forward (batcher flush thread)."""
+        if self.enabled and self.plan.slow_ms > 0:
+            time.sleep(self.plan.slow_ms / 1000.0)

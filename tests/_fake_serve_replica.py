@@ -1,0 +1,104 @@
+"""Model-free stand-in replica for fleet-supervisor tests.
+
+Speaks just enough of the serve protocol for tools/supervise_fleet.py and
+seist_tpu/serve/router.py: ``GET /healthz/ready`` (200 once "warm"),
+``POST /predict`` (200 echo). Honors the replica exit-code contract:
+SIGTERM -> drain -> exit 75. Crash behavior is scripted by env:
+
+    FAKE_CRASH_AFTER_S   exit 3 after this many seconds — but only when
+                         FAKE_CRASH_STAMP does not exist yet (the stamp is
+                         written first, so the relaunch runs clean: one
+                         crash per fleet, like a real one-off fault)
+    FAKE_CRASH_STAMP     stamp-file path gating the crash
+    SEIST_SERVE_REPLICA  only the matching FAKE_CRASH_REPLICA crashes
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+PREEMPT_EXIT_CODE = 75
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, status, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path in ("/healthz", "/healthz/live", "/healthz/ready"):
+            self._reply(200, {"status": "ok"})
+        else:
+            self._reply(404, {"error": "not_found"})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n)
+        if self.path == "/predict":
+            self._reply(
+                200,
+                {"ok": True,
+                 "replica": os.environ.get("SEIST_SERVE_REPLICA", "?")},
+            )
+        else:
+            self._reply(404, {"error": "not_found"})
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    args, _ = ap.parse_known_args()
+
+    server = ThreadingHTTPServer((args.host, args.port), Handler)
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    stop = threading.Event()
+    rc = {"code": 0}
+
+    def _term(signum, frame):
+        if signum == signal.SIGTERM:
+            rc["code"] = PREEMPT_EXIT_CODE
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+
+    crash_after = float(os.environ.get("FAKE_CRASH_AFTER_S", "0") or 0)
+    stamp = os.environ.get("FAKE_CRASH_STAMP", "")
+    target = os.environ.get("FAKE_CRASH_REPLICA", "")
+    me = os.environ.get("SEIST_SERVE_REPLICA", "")
+    crash_armed = (
+        crash_after > 0
+        and (not target or target == me)
+        and (not stamp or not os.path.exists(stamp))
+    )
+    deadline = time.monotonic() + crash_after if crash_armed else None
+    while not stop.is_set():
+        if deadline is not None and time.monotonic() >= deadline:
+            if stamp:
+                with open(stamp, "w") as f:
+                    f.write("crashed\n")
+            os._exit(3)  # hard crash, no drain
+        stop.wait(0.05)
+    server.shutdown()
+    return rc["code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,10 @@ proc_id, nprocs, port, logdir = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# gloo CPU collectives: without an implementation selected the CPU
+# backend refuses multiprocess computations (the seed test_multihost
+# failure — see tests/_multihost_worker.py).
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(
     coordinator_address=f"localhost:{port}",
     num_processes=nprocs,

@@ -20,6 +20,12 @@ proc_id, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# The CPU backend refuses multiprocess computations ("Multiprocess
+# computations aren't implemented on the CPU backend") unless a CPU
+# collectives implementation is selected — gloo ships in jaxlib. This was
+# the seed test_multihost failure (ROADMAP burn-down): the rendezvous
+# succeeded, the first cross-process collective crashed.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(
     coordinator_address=f"localhost:{port}",
     num_processes=nprocs,

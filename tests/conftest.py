@@ -128,6 +128,7 @@ _SMOKE_FILES = {
     "test_io_guard.py",
     "test_obs.py",
     "test_meters.py",
+    "test_router.py",
 }
 
 

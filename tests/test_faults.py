@@ -283,3 +283,104 @@ def test_monitor_disabled_when_max_bad_zero():
         assert m.push(0) is False
     assert m.flush() is False
     assert m.total_skipped == 10
+
+
+# ------------------------------------------------------------- serve plane
+class TestServeFaults:
+    """ServeFaultPlan/Injector units (the serving-plane knobs; the e2e
+    runs driving a real fleet live in tests/test_serve_chaos.py)."""
+
+    def test_plan_from_env_defaults_inert(self):
+        from seist_tpu.utils.faults import ServeFaultInjector, ServeFaultPlan
+
+        plan = ServeFaultPlan.from_env(env={})
+        assert not plan.enabled
+        inj = ServeFaultInjector(plan)
+        assert not inj.enabled
+        inj.on_request(10**9)  # no fault scheduled: must be a no-op
+        inj.forward_delay()
+
+    def test_plan_parses_all_knobs(self):
+        from seist_tpu.utils.faults import ServeFaultPlan
+
+        plan = ServeFaultPlan.from_env(env={
+            "SEIST_FAULT_SERVE_KILL_REQ": "7",
+            "SEIST_FAULT_SERVE_SLOW_MS": "12.5",
+            "SEIST_FAULT_SERVE_BLACKHOLE_AFTER": "3",
+            "SEIST_FAULT_SERVE_BLACKHOLE_COUNT": "2",
+            "SEIST_FAULT_SERVE_BLACKHOLE_HOLD_S": "0.01",
+            "SEIST_FAULT_SERVE_REPLICA": "1",
+            "SEIST_FAULT_STAMP": "/tmp/x",
+        })
+        assert plan.enabled
+        assert (plan.kill_req, plan.slow_ms) == (7, 12.5)
+        assert (plan.blackhole_after, plan.blackhole_count) == (3, 2)
+        assert plan.replica == 1 and plan.stamp_path == "/tmp/x"
+
+    def test_replica_targeting_gates_enabled(self):
+        from seist_tpu.utils.faults import ServeFaultInjector, ServeFaultPlan
+
+        plan = ServeFaultPlan(slow_ms=5.0, replica=1)
+        assert not ServeFaultInjector(plan, replica_index=0).enabled
+        assert ServeFaultInjector(plan, replica_index=1).enabled
+        # replica=-1 fires anywhere, including outside a fleet.
+        anywhere = ServeFaultPlan(slow_ms=5.0, replica=-1)
+        assert ServeFaultInjector(anywhere, replica_index=-1).enabled
+
+    def test_kill_fires_once_at_threshold_with_stamp(
+        self, tmp_path, monkeypatch
+    ):
+        from seist_tpu.utils import faults as faults_mod
+        from seist_tpu.utils.faults import ServeFaultInjector, ServeFaultPlan
+
+        kills = []
+        monkeypatch.setattr(
+            faults_mod.os, "kill", lambda pid, sig: kills.append(sig)
+        )
+        stamp = str(tmp_path / "stamp")
+        plan = ServeFaultPlan(kill_req=5, stamp_path=stamp)
+        inj = ServeFaultInjector(plan, replica_index=-1)
+        inj.on_request(4)
+        assert not kills
+        # >= threshold (not ==): concurrent arrivals can't skip past it.
+        inj.on_request(6)
+        assert kills == [signal.SIGKILL]
+        # The stamp was written BEFORE the (here neutered) kill, so a
+        # relaunched injector must never fire again.
+        inj2 = ServeFaultInjector(plan, replica_index=-1)
+        inj2.on_request(100)
+        assert kills == [signal.SIGKILL]
+
+    def test_blackhole_window_then_recovery(self, monkeypatch):
+        from seist_tpu.utils import faults as faults_mod
+        from seist_tpu.utils.faults import ServeFaultInjector, ServeFaultPlan
+
+        held = []
+        monkeypatch.setattr(
+            faults_mod.time, "sleep", lambda s: held.append(s)
+        )
+        plan = ServeFaultPlan(
+            blackhole_after=2, blackhole_count=3, blackhole_hold_s=9.0
+        )
+        inj = ServeFaultInjector(plan, replica_index=-1)
+        for n in range(1, 9):
+            inj.on_request(n)
+        # Requests 3,4,5 held; 6+ recovered (finite count).
+        assert held == [9.0, 9.0, 9.0]
+
+    def test_forward_delay_sleeps_only_when_enabled(self, monkeypatch):
+        from seist_tpu.utils import faults as faults_mod
+        from seist_tpu.utils.faults import ServeFaultInjector, ServeFaultPlan
+
+        slept = []
+        monkeypatch.setattr(
+            faults_mod.time, "sleep", lambda s: slept.append(s)
+        )
+        ServeFaultInjector(
+            ServeFaultPlan(slow_ms=40.0), replica_index=-1
+        ).forward_delay()
+        assert slept == [0.04]
+        ServeFaultInjector(
+            ServeFaultPlan(slow_ms=40.0, replica=2), replica_index=0
+        ).forward_delay()
+        assert slept == [0.04]  # mistargeted: no extra sleep

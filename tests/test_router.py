@@ -1,0 +1,525 @@
+"""Front-tier router units: circuit breaker state machine, registry
+rotation, outcome classification, and the forward retry/hedge loop
+against real (tiny, stdlib) fake replicas — no jax, no model.
+
+The fake replicas are scriptable HTTP servers: each can answer 200,
+return a canned error status, refuse connections (stopped), or black-hole
+(accept + never respond) — the four behaviors the router's reliability
+contract is written against.
+"""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from seist_tpu.serve.router import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    ReplicaRegistry,
+    Router,
+    RouterConfig,
+    _classify,
+    _Outcome,
+    start_router_server,
+)
+
+
+# ----------------------------------------------------------- fake replicas
+class _FakeReplica:
+    """Scriptable replica: set ``behavior`` to one of
+    'ok' | 'error:<status>[:<code>]' | 'blackhole' | 'slow:<ms>'."""
+
+    def __init__(self):
+        self.behavior = "ok"
+        self.hits = 0
+        self._lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz/ready":
+                    self._reply(200, {"status": "ok"})
+                else:
+                    self._reply(404, {})
+
+            def do_POST(self):
+                with fake._lock:
+                    fake.hits += 1
+                behavior = fake.behavior
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                if behavior == "blackhole":
+                    time.sleep(30.0)  # hold the socket; never answer
+                    return
+                if behavior.startswith("slow:"):
+                    time.sleep(float(behavior.split(":")[1]) / 1e3)
+                    behavior = "ok"
+                if behavior == "ok":
+                    self._reply(200, {"ok": True, "replica": fake.url})
+                else:
+                    parts = behavior.split(":")
+                    status = int(parts[1])
+                    code = parts[2] if len(parts) > 2 else "err"
+                    self._reply(status, {"error": code, "message": code})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.server.daemon_threads = True
+        self.url = f"127.0.0.1:{self.server.server_address[1]}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def replicas():
+    pair = [_FakeReplica(), _FakeReplica()]
+    yield pair
+    for r in pair:
+        r.stop()
+
+
+def _router(replicas, **overrides) -> Router:
+    kw = dict(
+        retries=2,
+        request_timeout_s=1.0,
+        breaker_failures=3,
+        breaker_cooldown_s=0.2,
+    )
+    kw.update(overrides)
+    router = Router(config=RouterConfig(**kw))
+    for r in replicas:
+        router.registry.add(r.url)
+    return router
+
+
+BODY = json.dumps({"data": [[0.0, 0.0, 0.0]], "options": {}}).encode()
+
+
+# --------------------------------------------------------- circuit breaker
+class TestCircuitBreaker:
+    def test_opens_after_consecutive_failures_only(self):
+        cb = CircuitBreaker(failures_to_open=3)
+        cb.record_failure()
+        cb.record_failure()
+        cb.record_success()  # resets the consecutive count
+        cb.record_failure()
+        cb.record_failure()
+        assert cb.state == CLOSED
+        cb.record_failure()
+        assert cb.state == OPEN
+        assert not cb.allow()
+
+    def test_half_open_probe_then_close(self):
+        t = [0.0]
+        cb = CircuitBreaker(
+            failures_to_open=1, cooldown_s=2.0, clock=lambda: t[0]
+        )
+        cb.record_failure()
+        assert cb.state == OPEN and not cb.allow()
+        t[0] = 2.5  # cooldown elapsed: exactly one probe is granted
+        assert cb.allow()
+        assert cb.state == HALF_OPEN
+        assert not cb.allow()  # second caller must route elsewhere
+        cb.record_success()
+        assert cb.state == CLOSED
+        assert cb.allow()
+
+    def test_failed_probe_reopens_with_doubled_cooldown(self):
+        t = [0.0]
+        cb = CircuitBreaker(
+            failures_to_open=1, cooldown_s=1.0, max_cooldown_s=3.0,
+            clock=lambda: t[0],
+        )
+        cb.record_failure()
+        t[0] = 1.1
+        assert cb.allow()  # half-open probe
+        cb.record_failure()  # probe failed
+        assert cb.state == OPEN
+        assert cb.stats()["cooldown_s"] == 2.0
+        t[0] = 2.0
+        assert not cb.allow()  # old cooldown would have admitted here
+        t[0] = 3.2
+        assert cb.allow()
+        cb.record_failure()
+        assert cb.stats()["cooldown_s"] == 3.0  # capped at max
+
+    def test_close_resets_cooldown_escalation(self):
+        t = [0.0]
+        cb = CircuitBreaker(
+            failures_to_open=1, cooldown_s=1.0, clock=lambda: t[0]
+        )
+        cb.record_failure()
+        t[0] = 1.1
+        assert cb.allow()
+        cb.record_failure()  # cooldown now 2.0
+        t[0] = 3.5
+        assert cb.allow()
+        cb.record_success()  # recovered
+        assert cb.stats()["cooldown_s"] == 1.0
+
+    def test_slow_success_counts_as_failure(self):
+        cb = CircuitBreaker(failures_to_open=2, latency_trip_ms=100.0)
+        cb.record_success(latency_ms=500.0)
+        cb.record_success(latency_ms=500.0)
+        assert cb.state == OPEN
+
+    def test_fast_success_does_not_trip(self):
+        cb = CircuitBreaker(failures_to_open=2, latency_trip_ms=100.0)
+        for _ in range(10):
+            cb.record_success(latency_ms=5.0)
+        assert cb.state == CLOSED
+
+    def test_half_open_slow_probe_reopens_with_escalation(self):
+        t = [0.0]
+        cb = CircuitBreaker(
+            failures_to_open=1, cooldown_s=1.0, latency_trip_ms=100.0,
+            clock=lambda: t[0],
+        )
+        cb.record_failure()
+        t[0] = 1.1
+        assert cb.allow()  # half-open probe
+        cb.record_success(latency_ms=500.0)  # answered, but still sick
+        assert cb.state == OPEN
+        assert cb.stats()["cooldown_s"] == 2.0  # escalated, not reset
+
+    def test_lost_probe_slot_regranted_after_probe_timeout(self):
+        # A probe whose outcome is never reported (attempt thread
+        # outliving every drain window) must not wedge the breaker in
+        # HALF_OPEN forever: after probe_timeout_s the slot re-opens.
+        t = [0.0]
+        cb = CircuitBreaker(
+            failures_to_open=1, cooldown_s=1.0, probe_timeout_s=10.0,
+            clock=lambda: t[0],
+        )
+        cb.record_failure()
+        t[0] = 1.1
+        assert cb.allow()  # probe granted... and its outcome is lost
+        assert not cb.allow()  # single probe while presumed in flight
+        t[0] = 5.0
+        assert not cb.allow()
+        t[0] = 11.2
+        assert cb.allow()  # lost-probe escape: slot re-granted
+        assert cb.state == HALF_OPEN
+        assert not cb.allow()  # the replacement probe is single again
+        cb.record_success()
+        assert cb.state == CLOSED
+
+
+# ----------------------------------------------------------- classification
+@pytest.mark.parametrize(
+    "status,code,expect_failure,expect_retry",
+    [
+        (0, "", True, True),       # network error
+        (500, "internal", True, True),
+        (429, "queue_full", False, True),
+        (503, "shutting_down", False, True),
+        (503, "shed", False, False),   # overload verdict: never retried
+        (503, "no_replica", False, True),
+        (504, "deadline_exceeded", False, False),
+        (200, "", False, False),
+        (400, "bad_request", False, False),
+    ],
+)
+def test_outcome_classification(status, code, expect_failure, expect_retry):
+    body = json.dumps({"error": code}).encode() if code else b""
+    out = _Outcome(status, {}, body, error="refused" if status == 0 else "")
+    assert _classify(out) == (expect_failure, expect_retry)
+
+
+# --------------------------------------------------------------- registry
+class TestRegistry:
+    def test_round_robin_over_ready(self):
+        reg = ReplicaRegistry()
+        for u in ("a:1", "b:2", "c:3"):
+            reg.add(u)
+        picks = [reg.pick().url for _ in range(6)]
+        assert sorted(picks[:3]) == ["a:1", "b:2", "c:3"]
+        assert picks[:3] == picks[3:]  # stable rotation
+
+    def test_mark_down_and_probe_ready_filtering(self):
+        reg = ReplicaRegistry()
+        reg.add("a:1")
+        reg.add("b:2")
+        reg.mark_down("a:1", reason="rc=-9")
+        assert {reg.pick().url for _ in range(4)} == {"b:2"}
+        assert reg.ready_count() == 1
+        snap = {s["url"]: s for s in reg.snapshot()}
+        assert snap["a:1"]["probe_state"] == "down(rc=-9)"
+
+    def test_exclude_and_breaker_open_skipped(self):
+        reg = ReplicaRegistry()
+        a, b = reg.add("a:1"), reg.add("b:2")
+        assert reg.pick(exclude={"b:2"}).url == "a:1"
+        # open a's breaker: only b remains; with both gone, pick -> None
+        for _ in range(reg.config.breaker_failures):
+            a.breaker.record_failure()
+        assert {reg.pick().url for _ in range(4)} == {"b:2"}
+        reg.mark_down("b:2")
+        assert reg.pick() is None
+
+    def test_add_idempotent_remove_missing_false(self):
+        reg = ReplicaRegistry()
+        r1 = reg.add("a:1")
+        assert reg.add("a:1") is r1  # same entry, breaker state kept
+        assert reg.remove("a:1") is True
+        assert reg.remove("a:1") is False
+
+
+# ------------------------------------------------------------ forward loop
+class TestForward:
+    def test_success_passthrough(self, replicas):
+        router = _router(replicas)
+        status, _, body = router.forward("/predict", BODY)
+        assert status == 200
+        assert json.loads(body)["ok"] is True
+
+    def test_dead_replica_retried_invisibly(self, replicas):
+        """A stopped replica (connection refused) must cost the client
+        nothing: the retry lands on the live one."""
+        replicas[0].stop()
+        router = _router(replicas)
+        for _ in range(6):
+            status, _, body = router.forward("/predict", BODY)
+            assert status == 200
+        # ...and the dead one's breaker opened along the way.
+        snap = {s["url"]: s for s in router.registry.snapshot()}
+        assert snap[replicas[0].url]["breaker"]["state"] == OPEN
+
+    def test_500_retried_on_other_replica(self, replicas):
+        replicas[0].behavior = "error:500:internal"
+        replicas[1].behavior = "error:500:internal"
+        router = _router(replicas, retries=1)
+        status, _, body = router.forward("/predict", BODY)
+        # Both replicas 500 and the budget (1 retry) is spent: the last
+        # outcome is relayed, and both replicas were actually tried.
+        assert status == 500
+        assert replicas[0].hits + replicas[1].hits == 2
+
+    def test_shed_503_not_retried(self, replicas):
+        replicas[0].behavior = "error:503:shed"
+        replicas[1].behavior = "error:503:shed"
+        router = _router(replicas)
+        status, _, body = router.forward("/predict", BODY)
+        assert status == 503
+        assert json.loads(body)["error"] == "shed"
+        assert replicas[0].hits + replicas[1].hits == 1  # exactly one try
+
+    def test_429_retried_but_breaker_untouched(self, replicas):
+        replicas[0].behavior = "error:429:queue_full"
+        replicas[1].behavior = "ok"
+        router = _router(replicas)
+        oks = sum(
+            router.forward("/predict", BODY)[0] == 200 for _ in range(4)
+        )
+        assert oks == 4
+        snap = {s["url"]: s for s in router.registry.snapshot()}
+        assert snap[replicas[0].url]["breaker"]["state"] == CLOSED
+
+    def test_no_replica_503(self):
+        router = Router(config=RouterConfig())
+        status, _, body = router.forward("/predict", BODY)
+        assert status == 503
+        assert json.loads(body)["error"] == "no_replica"
+
+    def test_blackhole_times_out_and_opens_circuit(self, replicas):
+        """The probe-invisible failure mode: accepts, answers /healthz,
+        never answers /predict. Per-attempt timeouts must (a) rescue the
+        client via the other replica and (b) open the circuit."""
+        replicas[0].behavior = "blackhole"
+        router = _router(
+            replicas, request_timeout_s=0.3, breaker_failures=2, retries=2
+        )
+        t0 = time.monotonic()
+        for _ in range(4):
+            status, _, _ = router.forward("/predict", BODY)
+            assert status == 200  # the live replica saves every request
+        snap = {s["url"]: s for s in router.registry.snapshot()}
+        assert snap[replicas[0].url]["breaker"]["state"] == OPEN
+        assert time.monotonic() - t0 < 5.0
+
+    def test_hedge_rescues_slow_replica(self, replicas):
+        replicas[0].behavior = "slow:800"
+        replicas[1].behavior = "slow:800"
+        router = _router(replicas, hedge_ms=100.0, request_timeout_s=3.0)
+        # Make exactly one replica fast; whichever the rotation picks
+        # first, the race must finish in ~fast time.
+        replicas[1].behavior = "ok"
+        t0 = time.monotonic()
+        status, _, body = router.forward("/predict", BODY)
+        elapsed = time.monotonic() - t0
+        assert status == 200
+        assert elapsed < 0.7, f"hedge did not rescue the tail: {elapsed:.2f}s"
+
+    def test_client_timeout_budget_respected(self, replicas):
+        """options.timeout_ms bounds the whole routing attempt chain."""
+        replicas[0].behavior = "blackhole"
+        replicas[1].behavior = "blackhole"
+        router = _router(replicas, request_timeout_s=5.0, retries=4)
+        body = json.dumps(
+            {"data": [[0.0] * 3], "options": {"timeout_ms": 400}}
+        ).encode()
+        t0 = time.monotonic()
+        status, _, _ = router.forward("/predict", body)
+        elapsed = time.monotonic() - t0
+        assert status in (502, 504)
+        assert elapsed < 2.5, f"routing budget overrun: {elapsed:.2f}s"
+
+
+# ----------------------------------------------------------- prober + HTTP
+class TestProberAndHTTP:
+    def test_prober_drops_dead_and_readmits(self, replicas):
+        router = _router(
+            replicas, probe_interval_s=0.1, probe_timeout_s=0.5
+        )
+        server = start_router_server(router, port=0)
+        try:
+            port = server.server_address[1]
+            replicas[0].stop()
+            deadline = time.monotonic() + 5.0
+            snap = {}
+            while time.monotonic() < deadline:
+                snap = {
+                    s["url"]: s for s in router.registry.snapshot()
+                }
+                if not snap[replicas[0].url]["ready"]:
+                    break
+                time.sleep(0.05)
+            assert not snap[replicas[0].url]["ready"], (
+                "prober never dropped the dead replica"
+            )
+            assert snap[replicas[1].url]["ready"]
+
+            # The router's own health + registry endpoints.
+            import http.client
+
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            assert resp.status == 200  # one replica still ready
+            assert json.loads(resp.read())["ready_replicas"] == 1
+            conn.request(
+                "POST", "/router/register",
+                json.dumps({"url": "127.0.0.1:59999"}).encode(),
+                {"Content-Type": "application/json"},
+            )
+            assert conn.getresponse().read() and len(
+                router.registry.replicas()
+            ) == 3
+            conn.request(
+                "POST", "/router/deregister",
+                json.dumps({"url": "127.0.0.1:59999"}).encode(),
+                {"Content-Type": "application/json"},
+            )
+            conn.getresponse().read()
+            assert len(router.registry.replicas()) == 2
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            text = resp.read().decode()
+            assert "seist_router_replicas" in text
+            conn.close()
+        finally:
+            server.shutdown()
+            server.server_close()
+            router.stop()
+
+    def test_413_sends_connection_close_header(self, replicas):
+        from seist_tpu.serve.router import MAX_BODY_BYTES
+
+        router = _router(replicas)
+        server = start_router_server(router, port=0)
+        try:
+            import http.client
+
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.server_address[1], timeout=5
+            )
+            conn.putrequest("POST", "/predict")
+            conn.putheader("Content-Type", "application/json")
+            conn.putheader("Content-Length", str(MAX_BODY_BYTES + 1))
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 413
+            # An HTTP/1.1 client must be TOLD the connection is done;
+            # without the header it assumes keep-alive and pipelines its
+            # next request onto a dead socket.
+            assert (resp.getheader("Connection") or "").lower() == "close"
+            resp.read()
+            conn.close()
+        finally:
+            server.shutdown()
+            server.server_close()
+            router.stop()
+
+    def test_forward_counters_on_bus(self, replicas):
+        from seist_tpu.obs.bus import BUS
+
+        replicas[0].stop()
+        router = _router(replicas)
+        router.forward("/predict", BODY)
+        snap = BUS.snapshot()
+        assert any(
+            k.startswith("router_requests") for k in snap["counters"]
+        )
+        assert any(
+            k.startswith("router_retries") for k in snap["counters"]
+        )
+        assert snap["collectors"].get("router_replicas") == 2.0
+        router.stop()
+        # stop() unregisters the collector: a torn-down router must not
+        # keep reporting on later scrapes.
+        assert "router_replicas" not in BUS.snapshot()["collectors"]
+
+
+# ----------------------------------------------------------- import hygiene
+def test_front_tier_imports_no_jax():
+    """The front tier (router, shed, fleet supervisor) must start on a
+    box with no accelerator stack: importing and constructing it must
+    never pull jax. The package roots (seist_tpu, seist_tpu.utils)
+    resolve their jax-importing submodules lazily for exactly this; a
+    new eager import anywhere in the chain regresses it."""
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    script = (
+        "import sys\n"
+        "import seist_tpu.serve.router as router\n"
+        "import seist_tpu.serve.shed  # noqa: F401\n"
+        "r = router.Router(config=router.RouterConfig())  # pulls obs.bus\n"
+        "r.stop()\n"
+        "sys.path.insert(0, 'tools')\n"
+        "import supervise_fleet  # noqa: F401\n"
+        "assert 'jax' not in sys.modules, 'front tier imported jax'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, cwd=repo_root, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr

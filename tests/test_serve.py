@@ -193,6 +193,43 @@ def test_bounded_queue_rejects_with_queue_full():
     b.shutdown()
 
 
+def test_rank_ordered_flush_takes_alerts_first():
+    """A later-arriving rank-0 (alert) request is flushed ahead of the
+    rank-2 (batch) backlog already queued; FIFO holds within a rank."""
+    release = threading.Event()
+    entered = threading.Event()
+    order = []
+
+    def gated_forward(batch):
+        if not entered.is_set():
+            entered.set()
+            release.wait(timeout=10.0)
+        order.append(float(batch[0, 0]))
+        return batch
+
+    b = _make(gated_forward, max_batch=1, max_delay_ms=1.0, max_queue=8)
+    with ThreadPoolExecutor(4) as ex:
+        futs = [ex.submit(
+            lambda: b.submit(np.full((1,), 0.0, np.float32),
+                             timeout_ms=10_000, rank=2)
+        )]
+        assert entered.wait(timeout=5.0)  # worker pinned on request 0.0
+        for val, rank in [(1.0, 2), (2.0, 2), (3.0, 0)]:
+            futs.append(ex.submit(
+                lambda v=val, r=rank: b.submit(
+                    np.full((1,), v, np.float32), timeout_ms=10_000, rank=r)
+            ))
+            deadline = time.monotonic() + 5.0
+            while b.stats()["queue_depth"] < len(futs) - 1:
+                assert time.monotonic() < deadline, "request never queued"
+                time.sleep(0.005)
+        release.set()
+        for f in futs:
+            f.result(timeout=10)
+    assert order == [0.0, 3.0, 1.0, 2.0]
+    b.shutdown()
+
+
 def test_shutdown_drains_queued_requests():
     release = threading.Event()
 
@@ -583,3 +620,297 @@ class TestHealthAndWatchdog:
                 ServeService(BoomPool(), BC(max_batch=2, max_delay_ms=5.0))
         finally:
             svc.shutdown(drain=False)
+
+
+# =================================================================== shedding
+class TestAdmissionControl:
+    """serve/shed.py units: tier order, hysteresis, Retry-After, stats."""
+
+    def _ctl(self, delay, **kw):
+        from seist_tpu.serve.shed import AdmissionController, ShedConfig
+
+        box = {"ms": delay}
+        ctl = AdmissionController(
+            lambda: box["ms"], ShedConfig(**kw), model="t"
+        )
+        return ctl, box
+
+    def test_batch_shed_first_alert_never(self):
+        from seist_tpu.serve.protocol import Overloaded
+
+        ctl, box = self._ctl(100.0)  # > batch 50, < interactive 250
+        try:
+            with pytest.raises(Overloaded):
+                ctl.admit("batch")
+            ctl.admit("interactive")
+            ctl.admit("alert")
+            box["ms"] = 1e6  # grotesque overload
+            with pytest.raises(Overloaded):
+                ctl.admit("interactive")
+            ctl.admit("alert")  # inf threshold: alerts ride to the 429
+            assert ctl.shed_level() == 2
+        finally:
+            ctl.close()
+
+    def test_hysteresis_sticky_until_half_threshold(self):
+        from seist_tpu.serve.protocol import Overloaded
+
+        ctl, box = self._ctl(60.0, batch_delay_ms=50.0, hysteresis=0.5)
+        try:
+            with pytest.raises(Overloaded):
+                ctl.admit("batch")  # 60 > 50: flips to shedding
+            box["ms"] = 30.0  # below threshold but above 25 = 50*0.5
+            with pytest.raises(Overloaded):
+                ctl.admit("batch")  # sticky
+            box["ms"] = 20.0
+            ctl.admit("batch")  # readmitted below the hysteresis floor
+            assert ctl.shed_level() == 0
+        finally:
+            ctl.close()
+
+    def test_retry_after_scales_with_delay_and_is_integral(self):
+        from seist_tpu.serve.protocol import Overloaded
+
+        ctl, box = self._ctl(4000.0)
+        try:
+            with pytest.raises(Overloaded) as ei:
+                ctl.admit("batch")
+            e = ei.value
+            assert e.status == 503 and e.code == "shed"
+            assert e.retry_after_s == pytest.approx(8.0)  # 2x delay
+            assert e.headers() == {"Retry-After": "8"}
+            assert e.payload()["retry_after_s"] == 8.0
+        finally:
+            ctl.close()
+
+    def test_sub_second_min_retry_after_is_honored(self):
+        """ShedConfig.min_retry_after_s owns the floor: Overloaded must
+        not re-clamp a configured sub-second value back up to 1 s."""
+        from seist_tpu.serve.protocol import Overloaded
+
+        e = Overloaded("x", retry_after_s=0.2)
+        assert e.retry_after_s == pytest.approx(0.2)
+        assert e.payload()["retry_after_s"] == 0.2
+        # Retry-After stays integral per RFC 9110 (ceil, not clamp).
+        assert e.headers() == {"Retry-After": "1"}
+
+    def test_shed_distinct_from_queue_full(self):
+        """The two overload responses must stay distinguishable: policy
+        shed = 503 'shed' (+Retry-After), hard bound = 429 'queue_full'."""
+        from seist_tpu.serve.protocol import Overloaded, QueueFull
+
+        shed, full = Overloaded("x", 2.0), QueueFull("y")
+        assert (shed.status, shed.code) == (503, "shed")
+        assert (full.status, full.code) == (429, "queue_full")
+        assert "Retry-After" in shed.headers()
+
+    def test_stats_on_bus_and_close_unregisters(self):
+        from seist_tpu.obs.bus import BUS
+
+        ctl, box = self._ctl(100.0)
+        try:
+            ctl.admit("alert")
+        finally:
+            ctl.close()
+        snap = ctl.stats()
+        assert snap["tiers"]["alert"]["admitted"] == 1
+        assert snap["queue_delay_ms"] == 100.0
+        # Only THIS controller's collector is gone; other live services'
+        # shed collectors (e.g. the module fixture's) remain untouched.
+        assert all(
+            'model="t"' not in k
+            for k in BUS.snapshot()["collectors"]
+            if k.startswith("serve_shed")
+        )
+
+    def test_unknown_priority_rejected_at_protocol(self):
+        from seist_tpu.serve.protocol import BadRequest, PredictOptions
+
+        with pytest.raises(BadRequest, match="priority"):
+            PredictOptions.from_dict({"priority": "urgent"})
+        assert PredictOptions.from_dict({}).priority == "interactive"
+        assert PredictOptions.from_dict(
+            {"priority": "alert"}
+        ).priority == "alert"
+
+
+def test_queue_delay_estimate_tracks_backlog():
+    """queue_delay_ms: 0 when idle; grows with a held queue; prices queued
+    flush waves by the service-time EWMA once one flush has completed."""
+    gate = threading.Event()
+
+    def blocked_forward(batch):
+        gate.wait(5.0)
+        return np.asarray(batch)
+
+    b = MicroBatcher(
+        blocked_forward,
+        BatcherConfig(max_batch=2, max_delay_ms=1.0, max_queue=64),
+    )
+    try:
+        assert b.queue_delay_ms() == 0.0
+        results = []
+        pool = ThreadPoolExecutor(6)
+        for _ in range(6):
+            results.append(
+                pool.submit(
+                    b.submit,
+                    np.zeros((4, 3), np.float32),
+                    timeout_ms=5000.0,
+                )
+            )
+        deadline = time.monotonic() + 2.0
+        while b.queue_delay_ms() == 0.0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        est = b.queue_delay_ms()
+        assert est > 0.0, "held queue must read a positive delay"
+        time.sleep(0.05)
+        assert b.queue_delay_ms() > est, "estimate must grow while held"
+        gate.set()
+        for r in results:
+            r.result(timeout=5.0)
+        pool.shutdown()
+        assert b.queue_delay_ms() == 0.0  # drained: no backlog, no delay
+        assert b.stats()["queue_delay_ms"] == 0.0
+    finally:
+        gate.set()
+        b.shutdown(drain=False)
+
+
+# ====================================================== faults: 504 + shed
+class TestServeFaultPaths:
+    """SEIST_FAULT_SERVE_* driving the deadline and shed branches through
+    the REAL predict path (phasenet pool fixture)."""
+
+    def test_slow_model_forces_504_deadline(self, service):
+        """Satellite: the predict 504 branch had no direct test. An
+        injected in-forward sleep (SEIST_FAULT_SERVE_SLOW_MS) longer than
+        the request deadline must surface as DeadlineExceeded (HTTP 504),
+        and the service must stay healthy for later requests."""
+        from seist_tpu.serve import BatcherConfig as BC
+        from seist_tpu.serve import ServeService
+        from seist_tpu.serve.protocol import DeadlineExceeded
+        from seist_tpu.utils.faults import (
+            ServeFaultInjector,
+            ServeFaultPlan,
+        )
+
+        svc = ServeService(
+            service.pool,
+            BC(max_batch=2, max_delay_ms=5.0, max_queue=16),
+            faults=ServeFaultInjector(ServeFaultPlan(slow_ms=400.0)),
+        )
+        try:
+            trace = np.zeros((WINDOW, 3), np.float32)
+            with pytest.raises(DeadlineExceeded) as ei:
+                svc.predict(trace, options={"timeout_ms": 120.0})
+            assert ei.value.status == 504
+            # The injected slowness is per-flush, not a crash: a patient
+            # request still succeeds afterwards.
+            out = svc.predict(trace, options={"timeout_ms": 10_000.0})
+            assert "picks" in out or isinstance(out, dict)
+        finally:
+            svc.shutdown(drain=False)
+
+    def test_slow_env_plan_parses(self, monkeypatch):
+        from seist_tpu.utils.faults import ServeFaultInjector
+
+        monkeypatch.setenv("SEIST_FAULT_SERVE_SLOW_MS", "250")
+        inj = ServeFaultInjector.from_env()
+        assert inj.enabled and inj.plan.slow_ms == 250.0
+
+    def test_overload_sheds_batch_tier_in_predict(self, service):
+        """Back-pressure e2e at service level: a slow flush builds queue
+        delay; a batch-tier request is then shed 503 while alert-tier
+        requests keep being admitted (they may be slow, never refused)."""
+        from seist_tpu.serve import BatcherConfig as BC
+        from seist_tpu.serve import ServeService
+        from seist_tpu.serve.protocol import Overloaded
+        from seist_tpu.serve.shed import ShedConfig
+        from seist_tpu.utils.faults import (
+            ServeFaultInjector,
+            ServeFaultPlan,
+        )
+
+        svc = ServeService(
+            service.pool,
+            BC(max_batch=1, max_delay_ms=1.0, max_queue=64),
+            shed_config=ShedConfig(
+                batch_delay_ms=50.0, interactive_delay_ms=1e9
+            ),
+            faults=ServeFaultInjector(ServeFaultPlan(slow_ms=150.0)),
+        )
+        try:
+            trace = np.zeros((WINDOW, 3), np.float32)
+            pool = ThreadPoolExecutor(8)
+            futures = [
+                pool.submit(
+                    svc.predict, trace,
+                    options={"timeout_ms": 30_000.0},
+                )
+                for _ in range(8)
+            ]
+            # Let the backlog age past the 50 ms batch budget.
+            deadline = time.monotonic() + 5.0
+            batcher = svc._batchers["phasenet"]
+            while (
+                batcher.queue_delay_ms() < 200.0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            with pytest.raises(Overloaded) as ei:
+                svc.predict(
+                    trace,
+                    options={"timeout_ms": 30_000.0, "priority": "batch"},
+                )
+            assert ei.value.status == 503
+            assert "Retry-After" in ei.value.headers()
+            # Alert tier still admitted under the same backlog.
+            out = svc.predict(
+                trace, options={"timeout_ms": 30_000.0, "priority": "alert"}
+            )
+            assert isinstance(out, dict)
+            for f in futures:
+                f.result(timeout=30.0)
+            pool.shutdown()
+            shed_stats = svc.metrics()["shed"]["phasenet"]
+            assert shed_stats["tiers"]["batch"]["shed"] >= 1
+            assert shed_stats["tiers"]["alert"]["shed"] == 0
+        finally:
+            svc.shutdown(drain=False)
+
+
+# ================================================== lifecycle state machine
+def test_lifecycle_states_published_to_events_and_gauge(service, tmp_path):
+    """Satellite: warming -> ok -> draining transitions must land in
+    events.jsonl and on the serve_state_code bus gauge so the router,
+    flight recorder and operators watch the same state machine."""
+    from seist_tpu.obs.bus import BUS, EventLog
+    from seist_tpu.serve import BatcherConfig as BC
+    from seist_tpu.serve import ServeService
+    from seist_tpu.serve.server import STATE_CODES
+
+    log_path = str(tmp_path / "events.jsonl")
+    events = EventLog(log_path)
+    svc = ServeService(
+        service.pool,
+        BC(max_batch=2, max_delay_ms=5.0),
+        event_log=events,
+    )
+    try:
+        svc.begin_drain()
+        assert BUS.snapshot()["gauges"]["serve_state_code"] == (
+            STATE_CODES["draining"]
+        )
+    finally:
+        svc.shutdown(drain=False)
+        events.close()
+    with open(log_path) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    states = [r["state"] for r in recs if r["event"] == "serve_state"]
+    assert states == ["warming", "ok", "draining"]
+    transitions = [
+        (r["prev"], r["state"]) for r in recs if r["event"] == "serve_state"
+    ]
+    assert transitions[0] == (None, "warming")
+    assert transitions[1] == ("warming", "ok")

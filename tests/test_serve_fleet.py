@@ -1,0 +1,406 @@
+"""tools/supervise_fleet.py + bench_serve accounting: the fast (model-
+free) half of the serving-resilience story. Real subprocess replicas, but
+stand-ins (tests/_fake_serve_replica.py) — the jax-loaded end-to-end runs
+live in tests/test_serve_chaos.py (`make serve-chaos`).
+
+Pins, in one place, the three copies of the preemption exit code (train
+checkpoint plane, serve replica, fleet supervisor) — docs/FAULT_TOLERANCE.md
+promises they are one contract.
+"""
+
+import http.client
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+FAKE_REPLICA = os.path.join(HERE, "_fake_serve_replica.py")
+SUPERVISE_FLEET = os.path.join(REPO, "tools", "supervise_fleet.py")
+
+
+# ------------------------------------------------------------ contract pins
+def test_preempt_exit_code_pinned_across_planes():
+    """75 (EX_TEMPFAIL) is ONE contract: train worker, serve replica and
+    both supervisors must agree, or a clean drain gets billed as a crash."""
+    import supervise as train_supervise
+    import supervise_fleet
+
+    from seist_tpu.serve.server import PREEMPT_EXIT_CODE as serve_code
+    from seist_tpu.train.checkpoint import PREEMPT_EXIT_CODE as train_code
+
+    assert (
+        train_supervise.PREEMPT_EXIT_CODE
+        == supervise_fleet.PREEMPT_EXIT_CODE
+        == serve_code
+        == train_code
+        == 75
+    )
+
+
+# --------------------------------------------------------------- fleet e2e
+def _free_port_base() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _drain_pipe(pipe, buf):
+    for line in pipe:
+        buf.append(line)
+
+
+def _start_fleet(env_extra=None, replicas=2, extra_args=()):
+    base = _free_port_base()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [
+            sys.executable, SUPERVISE_FLEET,
+            "--replicas", str(replicas),
+            "--base-port", str(base),
+            "--router-port", "0",
+            "--probe-interval-s", "0.2",
+            "--backoff", "0.4",
+            "--drain-timeout-s", "10",
+            *extra_args,
+            "--",
+            sys.executable, FAKE_REPLICA,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        text=True,
+    )
+    # Drain stderr from the start (and stdout once the ROUTER line is
+    # found): the replicas inherit these fds, and a pipe that fills the
+    # 64 KB kernel buffer blocks every writer in the fleet — including
+    # the supervisor's own monitor loop mid-log-line.
+    proc.fleet_err = []
+    err_thread = threading.Thread(
+        target=_drain_pipe, args=(proc.stderr, proc.fleet_err), daemon=True
+    )
+    err_thread.start()
+    proc.fleet_err_thread = err_thread
+    # The ROUTER= line is printed once the (ephemeral) front tier is up;
+    # the seist logger may interleave INFO lines on stdout before it.
+    seen = []
+    for _ in range(20):
+        line = proc.stdout.readline()
+        if not line:
+            break
+        seen.append(line)
+        m = re.search(r"ROUTER=http://([\d.]+):(\d+)", line)
+        if m:
+            threading.Thread(
+                target=_drain_pipe, args=(proc.stdout, []), daemon=True
+            ).start()
+            return proc, m.group(1), int(m.group(2))
+    proc.kill()
+    raise AssertionError(f"no ROUTER line from supervisor: {seen!r}")
+
+
+def _router_get(host, port, path, timeout=5.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _predict(host, port, timeout=5.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = json.dumps({"data": [[0.0] * 3], "options": {}}).encode()
+        conn.request("POST", "/predict", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _wait_ready(host, port, n, timeout_s=20.0):
+    """Wait for n replicas with a PROBED-ok state (a just-registered
+    replica is optimistically routable before its process has even bound
+    the port, so /healthz ready_replicas alone races the spawn)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            status, payload = _router_get(host, port, "/router/replicas")
+            ok = sum(
+                1
+                for r in payload.get("replicas", [])
+                if r["probe_state"] == "ok"
+            )
+            if status == 200 and ok >= n:
+                return
+        except OSError:
+            pass
+        time.sleep(0.1)
+    raise AssertionError(f"fleet never reached {n} probed-ready replicas")
+
+
+def _stop(proc, expect_rc=0, timeout=20):
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise
+    proc.fleet_err_thread.join(timeout=10)
+    err = "".join(proc.fleet_err)
+    assert rc == expect_rc, f"supervisor rc={rc}\n{err}"
+    return err
+
+
+class TestFleetSupervisor:
+    def test_sigterm_drains_replicas_via_exit_75(self):
+        proc, host, port = _start_fleet()
+        try:
+            _wait_ready(host, port, 2)
+            status, _ = _predict(host, port)
+            assert status == 200
+        finally:
+            err = _stop(proc, expect_rc=0)
+        # Both replicas drained on SIGTERM with the preempt code —
+        # billed as managed, not crash.
+        assert err.count("drained (rc=75)") == 2, err
+
+    def test_crashed_replica_restarts_and_requests_survive(self, tmp_path):
+        """One replica hard-crashes mid-run: the supervisor must pull it
+        from rotation, relaunch it after backoff, and the router must keep
+        every client request at 200 throughout."""
+        stamp = str(tmp_path / "crash.stamp")
+        proc, host, port = _start_fleet(
+            env_extra={
+                "FAKE_CRASH_AFTER_S": "1.0",
+                "FAKE_CRASH_REPLICA": "0",
+                "FAKE_CRASH_STAMP": stamp,
+            }
+        )
+        try:
+            _wait_ready(host, port, 2)
+            failures = []
+            stop = threading.Event()
+
+            def client():
+                while not stop.is_set():
+                    try:
+                        status, _ = _predict(host, port)
+                        if status != 200:
+                            failures.append(status)
+                    except OSError as e:
+                        failures.append(repr(e))
+                    time.sleep(0.02)
+
+            t = threading.Thread(target=client)
+            t.start()
+            # Crash fires at ~1s; backoff 0.4s; relaunch + probe ~0.5s.
+            # Watch the registry for the full arc: crash observed (the
+            # slot leaves probed-ok) then recovery (back to 2 ok).
+            deadline = time.monotonic() + 20.0
+            seen_down = False
+            recovered = False
+            while time.monotonic() < deadline:
+                try:
+                    _, payload = _router_get(
+                        host, port, "/router/replicas"
+                    )
+                except OSError:
+                    time.sleep(0.05)
+                    continue
+                states = [
+                    r["probe_state"] for r in payload.get("replicas", [])
+                ]
+                if any(s != "ok" for s in states):
+                    seen_down = True
+                if (
+                    seen_down
+                    and os.path.exists(stamp)
+                    and states.count("ok") == 2
+                ):
+                    recovered = True
+                    break
+                time.sleep(0.05)
+            # Keep the client hammering a moment past recovery.
+            time.sleep(0.5)
+            stop.set()
+            t.join(timeout=5)
+            assert os.path.exists(stamp), "scripted crash never fired"
+            assert seen_down, (
+                "crashed replica never observed leaving rotation"
+            )
+            assert recovered, (
+                "crashed replica was not restarted into rotation"
+            )
+            assert not failures, (
+                f"client saw failures during crash+restart: {failures[:5]}"
+            )
+        finally:
+            err = _stop(proc, expect_rc=0)
+        assert re.search(r"replica 0 crashed rc=3; relaunch", err), err
+
+    def test_budget_exhausted_slot_retired_supervisor_exits_1(self):
+        """A replica that keeps crashing burns its budget and is retired;
+        when every slot is gone the supervisor exits 1 (distinct from the
+        operator-initiated rc=0)."""
+        proc, host, port = _start_fleet(
+            env_extra={"FAKE_CRASH_AFTER_S": "0.3"},  # all replicas, always
+            replicas=1,
+            extra_args=("--retries", "1", "--backoff", "0.2"),
+        )
+        try:
+            rc = proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+        proc.fleet_err_thread.join(timeout=10)
+        err = "".join(proc.fleet_err)
+        assert rc == 1, f"rc={rc}\n{err}"
+        assert "budget exhausted" in err and "slot retired" in err, err
+
+
+# ------------------------------------------------------- bench accounting
+class TestBenchServeAccounting:
+    """Satellite: bench_serve must account per-request errors instead of
+    aborting, and gate on the SLO."""
+
+    def _fake_target(self, script):
+        """A live HTTP /predict endpoint whose responses follow
+        ``script`` (a list of (status, error_code)); cycles past the end
+        with 200s."""
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        hits = {"n": 0}
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                i = hits["n"]
+                hits["n"] += 1
+                status, code = (
+                    script[i] if i < len(script) else (200, "")
+                )
+                body = json.dumps(
+                    {"error": code} if code else {"ok": True}
+                ).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server, server.server_address[1]
+
+    def _run(self, port, tmp_path, *extra):
+        import bench_serve
+
+        out = str(tmp_path / "bench.json")
+        rc = bench_serve.main([
+            "--url", f"http://127.0.0.1:{port}",
+            "--requests", "10",
+            "--concurrency", "2",
+            "--window", "8",
+            "--output", out,
+            *extra,
+        ])
+        with open(out) as f:
+            return rc, json.load(f)
+
+    def test_errors_counted_not_aborting(self, tmp_path):
+        script = [(429, "queue_full"), (503, "shed"), (504, "deadline")]
+        server, port = self._fake_target(script)
+        try:
+            rc, result = self._run(port, tmp_path)
+        finally:
+            server.shutdown()
+            server.server_close()
+        assert rc == 0  # no gate requested: errors reported, not fatal
+        assert result["ok"] == 7 and result["errors"] == 3
+        assert result["error_rate"] == pytest.approx(0.3)
+        assert result["by_status"] == {
+            "200": 7, "429": 1, "503": 1, "504": 1
+        }
+        assert result["by_error_code"] == {
+            "deadline": 1, "queue_full": 1, "shed": 1
+        }
+
+    def test_slo_gate_trips_on_errors_and_passes_clean(self, tmp_path):
+        server, port = self._fake_target([(503, "shed")])
+        try:
+            rc, result = self._run(
+                port, tmp_path, "--slo-p99-ms", "60000"
+            )
+            assert rc == 3  # SLO_EXIT_CODE: error budget (default 0) blown
+            assert result["slo_violations"]
+            # Same target, tolerant error budget: the gate passes.
+            rc2, result2 = self._run(
+                port, tmp_path, "--slo-p99-ms", "60000",
+                "--max-error-rate", "0.5",
+            )
+            assert rc2 == 0 and result2["slo_violations"] == []
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_slo_gate_trips_on_p99(self, tmp_path):
+        server, port = self._fake_target([])
+        try:
+            rc, result = self._run(
+                port, tmp_path, "--slo-p99-ms", "0.000001"
+            )
+        finally:
+            server.shutdown()
+            server.server_close()
+        assert rc == 3
+        assert any("p99" in v for v in result["slo_violations"])
+
+    def test_open_loop_reports_client_overruns(self, tmp_path):
+        """Open-loop arrivals beyond the in-flight cap must be counted as
+        status-0 client_overrun errors, not silently skipped."""
+        import bench_serve
+
+        calls = {"n": 0}
+
+        def slow_one(i):
+            calls["n"] += 1
+            time.sleep(0.5)
+
+        stats = bench_serve._Stats()
+        bench_serve._drive_open_loop(
+            slow_one, n_requests=30, arrival_rps=500.0, max_inflight=1,
+            stats=stats,
+        )
+        # cap = 4 in flight; at 500 rps vs 0.5 s service, most arrivals
+        # overrun the client.
+        assert stats.by_code.get("client_overrun", 0) > 0
+        assert calls["n"] + stats.by_code["client_overrun"] == 30

@@ -1,18 +1,33 @@
-"""Serving latency/throughput bench -> BENCH-style one-line JSON.
+"""Serving latency/throughput bench + SLO gate -> BENCH-style JSON.
 
-Drives the in-process ServeService (no sockets — measures batching +
-forward + decode, not loopback TCP) with a closed-loop client pool, then
-reports client-observed latency percentiles, throughput and the
-batch-fill ratio from /metrics:
+Two client modes against two kinds of target:
+
+* **closed-loop** (default): ``--concurrency`` workers each fire the next
+  request as soon as the previous answers — measures the service's
+  best-case batching behavior.
+* **open-loop** (``--arrival-rps R``): requests are *launched on a
+  Poisson-less fixed-interval arrival clock* regardless of completions —
+  the production traffic model (arXiv:2605.25645: closed-loop numbers
+  flatter a service because overload slows the offered load down).
+  Combined with ``--slo-p99-ms`` this is the ROADMAP SLO harness: exit 3
+  when the p99 (or the error budget, ``--max-error-rate``) is violated.
+
+* **in-process** (default): builds a ServeService in this process — no
+  sockets, measures batching + forward + decode.
+* **HTTP** (``--url http://host:port``): drives a live replica or the
+  fleet router over real sockets — the serve-chaos lane's client.
+
+Every request error is caught and *accounted*, never aborts the bench:
+the JSON carries ``error_rate`` and per-status counts (a shed 503 and a
+queue-full 429 are different statuses by design — docs/SERVING.md).
 
     python tools/bench_serve.py --model-name phasenet --window 256 \
-        --requests 64 --concurrency 8 [--checkpoint CKPT] \
-        [--output BENCH_serve.json]
+        --requests 64 --concurrency 8 [--checkpoint CKPT]
+    python tools/bench_serve.py --url http://127.0.0.1:8080 \
+        --arrival-rps 200 --requests 400 --priority alert \
+        --slo-p99-ms 250 --window 256
 
-Emits {"metric": "serve_predict_latency", "p50_ms": ..., "p99_ms": ...,
-"throughput_rps": ..., "batch_fill_ratio": ...} — the same trajectory
-shape as the BENCH_*.json training numbers. `make serve-smoke` runs a
-small CPU configuration of exactly this.
+`make serve-smoke` runs a small CPU configuration of the in-process mode.
 """
 
 from __future__ import annotations
@@ -21,104 +36,335 @@ import argparse
 import json
 import os
 import sys
+import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from datetime import datetime, timezone
+from typing import Any, Dict, List, Optional
 
 _TOOLS = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_TOOLS))
 
+#: exit code for an SLO-gate violation (distinct from crash=1/usage=2)
+SLO_EXIT_CODE = 3
 
-def main() -> None:
-    ap = argparse.ArgumentParser(description="serve micro-batching bench")
+
+def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(description="serve bench + SLO gate")
     ap.add_argument("--model-name", default="phasenet")
     ap.add_argument("--checkpoint", default="",
                     help="optional; fresh-init weights when omitted")
     ap.add_argument("--window", type=int, default=256)
     ap.add_argument("--requests", type=int, default=64)
-    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop workers; in open-loop mode the "
+                    "client-side in-flight cap is 4x this (burst "
+                    "headroom so overload is shed by the SERVICE, not "
+                    "dropped at the client)")
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--max-delay-ms", type=float, default=10.0)
     ap.add_argument("--max-queue", type=int, default=256)
     ap.add_argument("--timeout-ms", type=float, default=60_000.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--output", default="", help="also write JSON here")
-    args = ap.parse_args()
+    # --- new: target / traffic shape / gate -------------------------------
+    ap.add_argument("--url", default="",
+                    help="drive a live HTTP endpoint (replica or router) "
+                    "instead of an in-process service")
+    ap.add_argument("--in-channels", type=int, default=3,
+                    help="trace channels for --url mode (in-process mode "
+                    "reads it from the model)")
+    ap.add_argument("--priority", default="",
+                    help="request tier: alert | interactive | batch "
+                    "(empty = service default)")
+    ap.add_argument("--arrival-rps", type=float, default=0.0,
+                    help="open-loop arrival rate (0 = closed loop)")
+    ap.add_argument("--slo-p99-ms", type=float, default=0.0,
+                    help=f"gate: exit {SLO_EXIT_CODE} if p99 of SUCCESSFUL "
+                    "requests exceeds this (0 = no gate)")
+    ap.add_argument("--max-error-rate", type=float, default=0.0,
+                    help="gate companion: tolerated error_rate before the "
+                    "SLO gate trips (default 0 = any error trips it when "
+                    "--slo-p99-ms is set)")
+    return ap.parse_args(argv)
 
-    from seist_tpu.utils.platform import honor_jax_platforms
 
-    honor_jax_platforms()
+class _Stats:
+    """Thread-safe per-request accounting: latencies of successes, error
+    counts by HTTP status and by serve error code."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.latencies_ms: List[float] = []
+        self.by_status: Dict[str, int] = {}
+        self.by_code: Dict[str, int] = {}
+        self.ok = 0
+        self.errors = 0
+
+    def success(self, latency_ms: float) -> None:
+        with self._lock:
+            self.ok += 1
+            self.by_status["200"] = self.by_status.get("200", 0) + 1
+            self.latencies_ms.append(latency_ms)
+
+    def error(self, status: int, code: str) -> None:
+        with self._lock:
+            self.errors += 1
+            key = str(status)
+            self.by_status[key] = self.by_status.get(key, 0) + 1
+            if code:
+                self.by_code[code] = self.by_code.get(code, 0) + 1
+
+
+def _http_client(url: str, timeout_ms: float):
+    """-> fn(payload_dict) that POSTs /predict and returns (status, body
+    dict); network failures surface as status 0. Transport is the
+    router's own jax-free helper so the bench client and the front tier
+    can't drift on HTTP semantics."""
+    import http.client
+
+    from seist_tpu.serve.router import _http_request
+
+    def call(payload: Dict[str, Any]):
+        body = json.dumps(payload).encode()
+        try:
+            status, _, raw = _http_request(
+                url, "POST", "/predict", body,
+                timeout_s=timeout_ms / 1000.0 + 5.0,
+            )
+        except (OSError, http.client.HTTPException) as e:
+            return 0, {"error": "unreachable", "message": str(e)}
+        try:
+            out = json.loads(raw)
+        except ValueError:
+            out = {}
+        # A non-object error body (some LBs answer 503 with a bare JSON
+        # string) must not crash the accounting downstream.
+        return status, out if isinstance(out, dict) else {"error": str(out)}
+
+    return call
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = get_args(argv)
+
+    if not args.url:
+        # --url mode must run from jax-free front-tier boxes (the same
+        # constraint as serve/router.py): nothing below may import jax.
+        from seist_tpu.utils.platform import honor_jax_platforms
+
+        honor_jax_platforms()
 
     import numpy as np
 
-    from seist_tpu.serve import BatcherConfig, ModelPool, ServeService
     from seist_tpu.utils.profiling import stopwatch
 
-    pool = ModelPool(
-        [(args.model_name, args.checkpoint)], window=args.window,
-        seed=args.seed,
-    )
-    service = ServeService(
-        pool,
-        BatcherConfig(
-            max_batch=args.max_batch,
-            max_delay_ms=args.max_delay_ms,
-            max_queue=args.max_queue,
-        ),
-    )
-    entry = pool.get(args.model_name)
+    options: Dict[str, Any] = {"timeout_ms": args.timeout_ms}
+    if args.priority:
+        options["priority"] = args.priority
+
+    service = None
+    if args.url:
+        in_channels = args.in_channels
+        call = _http_client(args.url, args.timeout_ms)
+
+        def one_request(trace) -> Any:
+            payload = {"data": trace, "options": options}
+            if args.model_name:
+                payload["model"] = args.model_name
+            return call(payload)
+
+    else:
+        from seist_tpu.serve import BatcherConfig, ModelPool, ServeService
+        from seist_tpu.serve.protocol import ServeError
+
+        pool = ModelPool(
+            [(args.model_name, args.checkpoint)], window=args.window,
+            seed=args.seed,
+        )
+        service = ServeService(
+            pool,
+            BatcherConfig(
+                max_batch=args.max_batch,
+                max_delay_ms=args.max_delay_ms,
+                max_queue=args.max_queue,
+            ),
+        )
+        entry = pool.get(args.model_name)
+        in_channels = entry.in_channels
+        if entry.is_picker:
+            options.update(ppk_threshold=0.05, spk_threshold=0.05)
+
+        def one_request(trace) -> Any:
+            try:
+                service.predict(trace, options=options)
+                return 200, {}
+            except ServeError as e:
+                return e.status, e.payload()
+
     rng = np.random.default_rng(args.seed)
     traces = [
-        rng.standard_normal((args.window, entry.in_channels))
+        rng.standard_normal((args.window, in_channels))
         .astype(np.float32).tolist()
         for _ in range(min(args.requests, 32))  # cycle a small pool
     ]
-    options = {"timeout_ms": args.timeout_ms}
-    if entry.is_picker:
-        options.update(ppk_threshold=0.05, spk_threshold=0.05)
 
-    latencies_ms = []
+    stats = _Stats()
 
     def one(i: int) -> None:
         with stopwatch() as elapsed:
-            service.predict(traces[i % len(traces)], options=options)
-        latencies_ms.append(elapsed() * 1000.0)
+            try:
+                status, body = one_request(traces[i % len(traces)])
+            except Exception as e:  # noqa: BLE001
+                # The docstring contract: every request error is counted,
+                # never aborts the bench. A raise here would abort the
+                # closed-loop ex.map — or, worse, vanish inside an
+                # open-loop daemon thread so the request is counted
+                # neither ok nor error and the SLO gate reads a fake pass.
+                status, body = 0, {"error": "client_exception",
+                                   "message": repr(e)}
+        if status == 200:
+            stats.success(elapsed() * 1000.0)
+        else:
+            stats.error(status, str(body.get("error", "")))
 
     with stopwatch() as wall:
-        with ThreadPoolExecutor(args.concurrency) as ex:
-            list(ex.map(one, range(args.requests)))
-    service.shutdown()
+        if args.arrival_rps > 0:
+            _drive_open_loop(one, args.requests, args.arrival_rps,
+                             args.concurrency, stats)
+        else:
+            with ThreadPoolExecutor(args.concurrency) as ex:
+                # ex.map would abort the whole bench on the first raised
+                # error; one() catches per-request instead.
+                list(ex.map(one, range(args.requests)))
+    wall_s = wall()
 
-    lat = np.asarray(latencies_ms)
-    stats = service.metrics()["models"][args.model_name]
-    import jax
+    batcher_stats: Dict[str, Any] = {}
+    if service is not None:
+        batcher_stats = service.metrics()["models"][args.model_name]
+        service.shutdown()
+
+    lat = np.asarray(stats.latencies_ms) if stats.latencies_ms else None
+    total = stats.ok + stats.errors
+    error_rate = stats.errors / total if total else 0.0
+
+    def pct(q: float) -> float:
+        return round(float(np.percentile(lat, q)), 3) if lat is not None else -1.0
+
+    if args.url:
+        device = "remote"
+    else:
+        import jax
+
+        device = jax.devices()[0].device_kind
 
     result = {
         "metric": "serve_predict_latency",
         "model": args.model_name,
+        "target": args.url or "in-process",
+        "mode": "open-loop" if args.arrival_rps > 0 else "closed-loop",
         "window": args.window,
         "requests": args.requests,
         "concurrency": args.concurrency,
+        "arrival_rps": args.arrival_rps,
+        "priority": args.priority or "default",
         "max_batch": args.max_batch,
         "max_delay_ms": args.max_delay_ms,
-        "p50_ms": round(float(np.percentile(lat, 50)), 3),
-        "p90_ms": round(float(np.percentile(lat, 90)), 3),
-        "p99_ms": round(float(np.percentile(lat, 99)), 3),
-        "mean_ms": round(float(lat.mean()), 3),
-        "throughput_rps": round(args.requests / wall(), 2),
-        "batch_fill_ratio": round(stats["batch_fill_ratio"], 4),
-        "forwards": stats["forwards"],
-        "completed": stats["completed"],
-        "device": jax.devices()[0].device_kind,
+        "p50_ms": pct(50),
+        "p90_ms": pct(90),
+        "p99_ms": pct(99),
+        "mean_ms": round(float(lat.mean()), 3) if lat is not None else -1.0,
+        "throughput_rps": round(stats.ok / wall_s, 2) if wall_s else 0.0,
+        "ok": stats.ok,
+        "errors": stats.errors,
+        "error_rate": round(error_rate, 4),
+        "by_status": dict(sorted(stats.by_status.items())),
+        "by_error_code": dict(sorted(stats.by_code.items())),
+        "device": device,
         "measured_at": datetime.now(timezone.utc).strftime(
             "%Y-%m-%dT%H:%M:%SZ"
         ),
     }
+    if batcher_stats:
+        result["batch_fill_ratio"] = round(
+            batcher_stats["batch_fill_ratio"], 4
+        )
+        result["forwards"] = batcher_stats["forwards"]
+        result["completed"] = batcher_stats["completed"]
+
+    rc = 0
+    if args.slo_p99_ms > 0:
+        violations = []
+        if lat is None:
+            violations.append("no successful requests")
+        elif result["p99_ms"] > args.slo_p99_ms:
+            violations.append(
+                f"p99 {result['p99_ms']:.1f} ms > SLO {args.slo_p99_ms:.1f} ms"
+            )
+        if error_rate > args.max_error_rate:
+            violations.append(
+                f"error_rate {error_rate:.4f} > {args.max_error_rate:.4f}"
+            )
+        if violations:
+            result["slo_violations"] = violations
+            print(f"[bench_serve] SLO GATE FAILED: {'; '.join(violations)}",
+                  file=sys.stderr, flush=True)
+            rc = SLO_EXIT_CODE
+        else:
+            result["slo_violations"] = []
+
     line = json.dumps(result)
     print(line)
     if args.output:
         with open(args.output, "w") as f:
             f.write(line + "\n")
+    return rc
+
+
+def _drive_open_loop(
+    one, n_requests: int, arrival_rps: float, max_inflight: int,
+    stats: "_Stats",
+) -> None:
+    """Launch request i at t0 + i/rps on a worker thread, independent of
+    completions (the open-loop arrival model). The thread pool is capped
+    at ``4 * max_inflight`` — the 4x headroom lets a backlog build so an
+    overloaded SERVICE gets to exercise its shedding tiers instead of the
+    client silently throttling arrivals. Past that cap, further arrivals
+    are dropped ON THE CLIENT and counted as status 0 ``client_overrun``
+    errors — an open-loop bench that quietly stopped offering load would
+    otherwise report a fake SLO pass."""
+    interval = 1.0 / arrival_rps
+    cap = max(1, max_inflight) * 4
+    sem = threading.Semaphore(cap)
+    n_over = 0
+    threads: List[threading.Thread] = []
+    t0 = time.monotonic()
+    for i in range(n_requests):
+        target = t0 + i * interval
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        if not sem.acquire(blocking=False):
+            n_over += 1
+            stats.error(0, "client_overrun")
+            continue
+
+        def run(idx: int) -> None:
+            try:
+                one(idx)
+            finally:
+                sem.release()
+
+        t = threading.Thread(target=run, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    if n_over:
+        print(f"[bench_serve] WARNING: {n_over} arrivals dropped client-side "
+              f"(in-flight cap {cap}); offered load was lower than requested",
+              file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,259 @@
+"""Serving-fleet supervisor: spawn N replicas + the front-tier router,
+restart what dies, roll the registry.
+
+The serving analog of tools/supervise.py (which babysits one training
+process): this babysits a *fleet* — N ``python main.py serve`` replica
+processes on consecutive ports plus an in-process
+:mod:`seist_tpu.serve.router` front tier that load-balances, retries and
+circuit-breaks across them (docs/SERVING.md)::
+
+    python tools/supervise_fleet.py --replicas 2 --router-port 8080 \\
+        --base-port 18100 -- \\
+        python main.py serve --model seist_s_dpk=CKPT --window 8192
+
+Replica lifecycle (mirrors the train-plane exit-code contract,
+docs/FAULT_TOLERANCE.md):
+
+* exit ``75`` (EX_TEMPFAIL) — the replica caught SIGTERM, drained its
+  in-flight requests and left cleanly (a managed preemption). Relaunched
+  IMMEDIATELY; the failure budget is untouched.
+* any other nonzero exit (SIGKILL shows as -9) — a crash. The replica is
+  pulled from the router's rotation at once (faster than a probe
+  interval), relaunched after ``--backoff`` seconds, up to ``--retries``
+  consecutive crashes; staying up ``--healthy-reset-s`` refills the
+  budget. A replica that exhausts its budget is deregistered for good.
+* exit ``0`` — voluntary stop (operator SIGINT); the slot is retired.
+
+The supervisor exits 0 on SIGTERM/SIGINT (after draining the replicas)
+and 1 once every replica slot has been retired. Each replica gets
+``SEIST_SERVE_REPLICA=<index>`` in its environment — the handle
+``SEIST_FAULT_SERVE_REPLICA`` uses to aim a chaos fault at exactly one
+member of the fleet (utils/faults.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS))
+
+# Keep in sync with seist_tpu.serve.server.PREEMPT_EXIT_CODE /
+# seist_tpu.train.checkpoint.PREEMPT_EXIT_CODE
+# (tests/test_serve_fleet.py pins all three together).
+PREEMPT_EXIT_CODE = 75
+
+
+def _log(msg: str) -> None:
+    print(f"[fleet] {msg}", file=sys.stderr, flush=True)
+
+
+class ReplicaSlot:
+    """One fleet position: its port, process handle and failure budget."""
+
+    def __init__(self, index: int, port: int, cmd: List[str]):
+        self.index = index
+        self.port = port
+        self.url = f"127.0.0.1:{port}"
+        self.cmd = list(cmd) + ["--host", "127.0.0.1", "--port", str(port)]
+        self.proc: Optional[subprocess.Popen] = None
+        self.failures = 0  # consecutive crashes since last healthy stretch
+        self.started_at = 0.0
+        self.restart_at: Optional[float] = None  # backoff schedule
+        self.retired = False
+
+    def spawn(self) -> None:
+        env = dict(os.environ)
+        env["SEIST_SERVE_REPLICA"] = str(self.index)
+        self.proc = subprocess.Popen(self.cmd, env=env)
+        self.started_at = time.monotonic()
+        self.restart_at = None
+        _log(
+            f"replica {self.index} (port {self.port}) started "
+            f"pid={self.proc.pid}"
+        )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serving-fleet supervisor: replicas + router",
+        usage="supervise_fleet.py [opts] -- python main.py serve ...",
+    )
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--base-port", type=int, default=18100,
+                    help="replica i serves on base-port + i")
+    ap.add_argument("--router-host", default="127.0.0.1")
+    ap.add_argument("--router-port", type=int, default=8080,
+                    help="front-tier port (0 = ephemeral, printed)")
+    ap.add_argument("--retries", type=int, default=3,
+                    help="consecutive crash relaunches per replica before "
+                    "the slot is retired (exit-75 preempts are free)")
+    ap.add_argument("--backoff", type=float, default=2.0,
+                    help="seconds before a crash relaunch")
+    ap.add_argument("--healthy-reset-s", type=float, default=60.0,
+                    help="uptime that refills a replica's crash budget")
+    ap.add_argument("--drain-timeout-s", type=float, default=30.0,
+                    help="SIGTERM->SIGKILL grace on supervisor shutdown")
+    # Router knobs (forwarded to seist_tpu.serve.router.RouterConfig).
+    ap.add_argument("--router-retries", type=int, default=2)
+    ap.add_argument("--request-timeout-s", type=float, default=10.0)
+    ap.add_argument("--hedge-ms", type=float, default=0.0)
+    ap.add_argument("--probe-interval-s", type=float, default=0.5)
+    ap.add_argument("--breaker-failures", type=int, default=3)
+    ap.add_argument("--breaker-cooldown-s", type=float, default=2.0)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="the replica command, after `--` (without "
+                    "--host/--port, which the supervisor assigns)")
+    args = ap.parse_args(argv)
+
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no replica command (use: supervise_fleet.py [opts] -- "
+                 "python main.py serve ...)")
+    if args.replicas < 1:
+        ap.error("--replicas must be >= 1")
+
+    from seist_tpu.serve.router import (
+        Router,
+        RouterConfig,
+        start_router_server,
+    )
+
+    router = Router(
+        config=RouterConfig(
+            retries=args.router_retries,
+            request_timeout_s=args.request_timeout_s,
+            hedge_ms=args.hedge_ms,
+            probe_interval_s=args.probe_interval_s,
+            breaker_failures=args.breaker_failures,
+            breaker_cooldown_s=args.breaker_cooldown_s,
+        )
+    )
+    slots = [
+        ReplicaSlot(i, args.base_port + i, cmd)
+        for i in range(args.replicas)
+    ]
+    for slot in slots:
+        slot.spawn()
+        router.registry.add(slot.url)
+    server = start_router_server(router, args.router_host, args.router_port)
+    host, port = server.server_address[:2]
+    # Machine-greppable for harnesses driving an ephemeral-port fleet.
+    print(f"[fleet] ROUTER=http://{host}:{port}", flush=True)
+    _log(f"router on http://{host}:{port}, {len(slots)} replica(s)")
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+
+    try:
+        _monitor(slots, router, args, stop)
+    finally:
+        _drain(slots, args.drain_timeout_s)
+        server.shutdown()
+        router.stop()
+    live_slots = [s for s in slots if not s.retired]
+    if stop.is_set():
+        _log("stopped (signal)")
+        return 0
+    _log("stopped (all replica slots retired)" if not live_slots
+         else "stopped")
+    return 0 if live_slots else 1
+
+
+def _monitor(
+    slots: List["ReplicaSlot"], router, args, stop: threading.Event
+) -> None:
+    """Poll replica processes; restart / retire per the exit contract."""
+    while not stop.is_set():
+        active = 0
+        for slot in slots:
+            if slot.retired:
+                continue
+            active += 1
+            now = time.monotonic()
+            if slot.proc is None:
+                # In backoff: relaunch when its clock expires.
+                if slot.restart_at is not None and now >= slot.restart_at:
+                    slot.spawn()
+                    router.registry.add(slot.url)
+                continue
+            if (
+                slot.failures
+                and now - slot.started_at >= args.healthy_reset_s
+            ):
+                _log(f"replica {slot.index} healthy "
+                     f"{args.healthy_reset_s:.0f}s: crash budget reset")
+                slot.failures = 0
+            rc = slot.proc.poll()
+            if rc is None:
+                continue
+            slot.proc = None
+            # Pull it from rotation NOW — the router should stop routing
+            # to a dead port before the next health probe finds out.
+            router.registry.mark_down(slot.url, reason=f"rc={rc}")
+            if rc == 0:
+                _log(f"replica {slot.index} exited 0 (voluntary); "
+                     "slot retired")
+                slot.retired = True
+                router.registry.remove(slot.url)
+            elif rc == PREEMPT_EXIT_CODE:
+                _log(f"replica {slot.index} clean preempt (rc={rc}): "
+                     "immediate relaunch, budget untouched")
+                slot.spawn()
+                router.registry.add(slot.url)
+            else:
+                slot.failures += 1
+                if slot.failures > args.retries:
+                    _log(f"replica {slot.index} crashed rc={rc}; budget "
+                         f"exhausted ({slot.failures - 1}/{args.retries}) "
+                         "— slot retired")
+                    slot.retired = True
+                    router.registry.remove(slot.url)
+                else:
+                    _log(f"replica {slot.index} crashed rc={rc}; relaunch "
+                         f"in {args.backoff:.1f}s "
+                         f"(budget {slot.failures}/{args.retries})")
+                    slot.restart_at = now + args.backoff
+        if active == 0:
+            return  # every slot retired: the fleet is gone
+        stop.wait(0.2)
+
+
+def _drain(slots: List["ReplicaSlot"], timeout_s: float) -> None:
+    """SIGTERM every live replica (graceful drain, expect exit 75), then
+    SIGKILL stragglers after the grace period."""
+    live = [s for s in slots if s.proc is not None and s.proc.poll() is None]
+    for slot in live:
+        try:
+            slot.proc.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.monotonic() + timeout_s
+    for slot in live:
+        remaining = max(0.1, deadline - time.monotonic())
+        try:
+            rc = slot.proc.wait(timeout=remaining)
+            _log(f"replica {slot.index} drained (rc={rc})")
+        except subprocess.TimeoutExpired:
+            _log(f"replica {slot.index} did not drain in "
+                 f"{timeout_s:.0f}s; SIGKILL")
+            slot.proc.kill()
+            slot.proc.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
