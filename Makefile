@@ -4,8 +4,8 @@ NATIVE_DIR := seist_tpu/native
 CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
-.PHONY: native test t1 lint lint-baseline serve-smoke serve-chaos obs-smoke \
-	chaos clean
+.PHONY: native test t1 lint lint-baseline lockgraph serve-smoke \
+	serve-chaos obs-smoke chaos clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -15,16 +15,30 @@ $(NATIVE_DIR)/libwavekit.so: $(NATIVE_DIR)/wavekit.cpp
 test:
 	python -m pytest tests/ -x -q
 
-# jaxlint static-analysis gate (docs/STATIC_ANALYSIS.md): JAX hot-path
-# hazards — host syncs, PRNG key reuse, missing donate_argnums, retrace
-# hazards, wall-clock intervals, broad excepts. Fails only on findings
-# NOT grandfathered in tools/jaxlint_baseline.json.
+# Static-analysis gate, BOTH analyzers (docs/STATIC_ANALYSIS.md):
+# jaxlint — JAX hot-path hazards (host syncs, PRNG key reuse, missing
+# donate_argnums, retraces, wall-clock intervals, broad excepts);
+# threadlint — concurrency/lifecycle hazards (unguarded shared attrs,
+# unsafe signal handlers, silent thread death, untimed waits, SYN-drop
+# backlogs, exit-code contract). Each fails only on findings not
+# grandfathered in its tools/<tool>_baseline.json.
 lint:
 	python -m tools.jaxlint seist_tpu
+	python -m tools.threadlint seist_tpu tools
 
-# Re-accept the current findings (review the diff before committing!).
+# Re-accept the current jaxlint findings (review the diff before
+# committing!). Deliberately does NOT touch tools/threadlint_baseline.json:
+# that baseline is empty by construction — fix the code or add a
+# rationale'd `# threadlint: disable` instead of grandfathering.
 lint-baseline:
 	python -m tools.jaxlint seist_tpu --update-baseline
+
+# threadlint runtime audit lane (docs/STATIC_ANALYSIS.md): the smoke
+# lane with every in-test lock instrumented — fails on lock-order
+# cycles (potential deadlocks) and locks held across blocking calls.
+lockgraph:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m smoke --lock-graph \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
 
 # Tier-1 verify: the exact line from ROADMAP.md (fast lane, CPU backend,
 # slow-marked kill/resume e2e excluded). Prints DOTS_PASSED for the driver.

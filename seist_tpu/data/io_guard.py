@@ -527,14 +527,24 @@ class StallWatchdog:
         self._armed_since = None
 
     def _run(self) -> None:
-        while not self._stop.wait(self._poll_s):
-            armed = self._armed_since
-            if armed is None:
-                continue
-            waited = time.monotonic() - armed
-            if waited > self.timeout_s:
-                self._trip(waited)
-                return
+        # A watchdog that dies silently IS the failure it guards against:
+        # the stall it would have caught then hangs the run forever. Log
+        # loudly and re-raise (threadlint thread-target-raises).
+        try:
+            while not self._stop.wait(self._poll_s):
+                armed = self._armed_since
+                if armed is None:
+                    continue
+                waited = time.monotonic() - armed
+                if waited > self.timeout_s:
+                    self._trip(waited)
+                    return
+        except Exception:
+            logger.exception(
+                "[io-guard] stall watchdog thread died — stall protection "
+                "is GONE for the rest of this run"
+            )
+            raise
 
     def _trip(self, waited: float) -> None:
         self.tripped = True

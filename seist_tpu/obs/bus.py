@@ -221,7 +221,12 @@ class MetricsBus:
         self.histogram(f"{span.name}_ms", **span.labels).observe(
             (span.duration_s or 0.0) * 1e3
         )
-        for sink in self._span_sinks:
+        # Snapshot under the lock: install()/remove_span_sink mutate the
+        # list from other threads (flight-recorder swap on a death path),
+        # and iterating a list being resized raises mid-span.
+        with self._lock:
+            sinks = list(self._span_sinks)
+        for sink in sinks:
             try:
                 sink(span)
             except Exception:  # noqa: BLE001 - a sick sink (e.g. a closed

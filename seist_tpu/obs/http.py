@@ -23,6 +23,7 @@ are unauthenticated, do not bind a public interface.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -36,25 +37,38 @@ DEFAULT_PROFILE_STEPS = 5
 
 
 class ProfileTrigger:
-    """One-slot request box for an on-demand profiler capture. HTTP and
-    SIGUSR2 call :meth:`request`; the train loop calls :meth:`consume` at
-    step boundaries and starts a capture when it returns > 0."""
+    """Request box for an on-demand profiler capture. HTTP and SIGUSR2
+    call :meth:`request`; the train loop calls :meth:`consume` at step
+    boundaries and starts a capture when it returns > 0 (several pending
+    requests coalesce into one capture, last-requested width wins).
+
+    Deliberately lock-free (threadlint signal-handler-unsafe audit):
+    :meth:`request` runs inside the SIGUSR2 handler, which interrupts the
+    main thread at an arbitrary bytecode boundary — if that thread were
+    inside a locked :meth:`consume` at that moment, a lock here would
+    self-deadlock the process. ``deque.append`` and ``deque.popleft``
+    are each one GIL-atomic operation, so a request landing at any point
+    during :meth:`consume` is either drained by it or sits intact for
+    the next step-boundary poll — nothing is ever consumed-and-dropped
+    (the maxlen bounds pathological signal storms; overflow discards
+    oldest, and consume takes the newest anyway).
+    """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._steps = 0
+        self._requests: "deque[int]" = deque(maxlen=64)
 
     def request(self, steps: int = DEFAULT_PROFILE_STEPS) -> None:
-        steps = max(1, int(steps))
-        with self._lock:
-            self._steps = steps
+        self._requests.append(max(1, int(steps)))
 
     def consume(self) -> int:
-        if not self._steps:  # lock-free fast path for the per-step poll
+        if not self._requests:  # cheap per-step fast path
             return 0
-        with self._lock:
-            steps, self._steps = self._steps, 0
-        return steps
+        steps = 0
+        while True:
+            try:
+                steps = self._requests.popleft()
+            except IndexError:
+                return steps
 
 
 def _json_bytes(payload) -> bytes:
@@ -161,6 +175,11 @@ class _Handler(BaseHTTPRequestHandler):
 class MetricsHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # Scrape bursts are mild next to serve traffic, but socketserver's
+    # backlog-5 default drops SYNs whenever a dashboard + operator curl +
+    # Prometheus collide; same contract as ServeHTTPServer/RouterHTTPServer
+    # (threadlint http-server-backlog).
+    request_queue_size = 1024
 
     def __init__(
         self,

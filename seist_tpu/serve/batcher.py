@@ -263,6 +263,11 @@ class MicroBatcher:
                     elif self._stopping:
                         return
                     else:
+                        # threadlint: disable=wait-no-timeout -- parked on
+                        # an empty queue; every producer (submit) and
+                        # shutdown() notifies under this same condition,
+                        # and the thread is daemon so a dying process
+                        # never waits on it.
                         self._cond.wait()
                 take = min(len(self._queue), self.config.max_batch)
                 pending = self._queue[:take]

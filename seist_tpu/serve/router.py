@@ -421,10 +421,26 @@ class Router:
         self._bus.unregister_collector("router", fn=self._collect)
 
     def _probe_loop(self) -> None:
-        while not self._stop.is_set():
-            for replica in self.registry.replicas():
-                self._probe_one(replica)
-            self._stop.wait(self.config.probe_interval_s)
+        # A dead prober freezes the routable set silently: drained
+        # replicas would keep taking traffic and restarted ones never
+        # re-enter. Survive any per-cycle surprise, and if the loop
+        # machinery itself dies, say so loudly before the thread goes
+        # (threadlint thread-target-raises).
+        try:
+            while not self._stop.is_set():
+                try:
+                    for replica in self.registry.replicas():
+                        self._probe_one(replica)
+                # a single bad probe cycle must not end probing forever
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(f"[router] probe cycle failed: {e!r}")
+                self._stop.wait(self.config.probe_interval_s)
+        except BaseException:
+            logger.exception(
+                "[router] prober thread died — the routable set is frozen "
+                "until the router restarts"
+            )
+            raise
 
     def _probe_one(self, replica: Replica) -> None:
         try:
@@ -582,8 +598,19 @@ class Router:
         results: "Queue[Tuple[_Outcome, Replica]]" = Queue()
 
         def run(replica: Replica) -> None:
-            out = self._attempt(replica, path, body, deadline)
-            results.put((out, replica))
+            # The waiter blocks on `results`: an attempt thread dying
+            # without putting would stall the race to the full deadline,
+            # so any surprise becomes a poisoned net-error outcome
+            # (threadlint thread-target-raises).
+            try:
+                results.put((
+                    self._attempt(replica, path, body, deadline), replica
+                ))
+            except BaseException as e:  # noqa: BLE001
+                results.put((
+                    _Outcome(0, {}, b"", error=f"attempt crashed: {e!r}"),
+                    replica,
+                ))
 
         threading.Thread(
             target=run, args=(primary,), daemon=True,
@@ -655,14 +682,21 @@ class Router:
         )
 
     def _drain_loser(self, results: Queue, n: int) -> None:
-        for _ in range(n):
-            try:
-                outcome, replica = results.get(
-                    timeout=self.config.request_timeout_s + 1.0
-                )
-            except Empty:
-                return
-            self._settle(replica, outcome)
+        # Best-effort breaker accounting for hedge losers; a surprise here
+        # must not die silently mid-drain (threadlint
+        # thread-target-raises) — log it, the breaker just misses one
+        # sample.
+        try:
+            for _ in range(n):
+                try:
+                    outcome, replica = results.get(
+                        timeout=self.config.request_timeout_s + 1.0
+                    )
+                except Empty:
+                    return
+                self._settle(replica, outcome)
+        except Exception as e:  # noqa: BLE001 — accounting-only thread
+            logger.warning(f"[router] hedge drain failed: {e!r}")
 
     _TIMEOUT_MS_RE = re.compile(rb'"timeout_ms"\s*:\s*([0-9eE.+-]+)')
 
@@ -939,6 +973,9 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
+    # threadlint: disable=wait-no-timeout -- main thread parked until the
+    # signal handler (the only setter) fires; CPython wakes an untimed
+    # main-thread Event.wait to run handlers, so no wakeup can be lost.
     stop.wait()
     server.shutdown()
     router.stop()

@@ -745,6 +745,12 @@ def main(argv: Optional[List[str]] = None) -> None:
     def _term(signum, frame):
         if signum == signal.SIGTERM:
             exit_code["rc"] = PREEMPT_EXIT_CODE
+        # threadlint: disable=signal-handler-unsafe -- begin_drain is a
+        # plain flag store + edge-triggered publish; the interrupted main
+        # thread is parked in watch_until_shutdown's stop.wait and never
+        # holds service._lock, and logging's RLock is reentrant from the
+        # same thread. Flipping 503s on immediately (vs at the next poll
+        # tick) is what lets the load balancer route away during drain.
         service.begin_drain()
         stop.set()
 

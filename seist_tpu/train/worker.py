@@ -91,6 +91,11 @@ class _PreemptionHandler:
         if threading.current_thread() is threading.main_thread():
             def _on_term(signum, frame):
                 self.triggered = True
+                # threadlint: disable=signal-handler-unsafe -- best-effort
+                # operator notice; logging's RLock is reentrant from the
+                # interrupted main thread (worst case: interleaved output,
+                # never a deadlock), and the flag above is already set so
+                # the preempt proceeds even if this line dies.
                 logger.warning(
                     "SIGTERM received: will checkpoint at the next step "
                     f"boundary and exit {PREEMPT_EXIT_CODE}"
@@ -834,7 +839,14 @@ def train_worker(args: Any) -> str:
         and hasattr(signal, "SIGUSR2")
     ):
         def _on_usr2(signum, frame):
+            # threadlint: disable=signal-handler-unsafe -- request() is a
+            # single lock-free GIL-atomic deque append (ProfileTrigger is
+            # deliberately lockless for exactly this call site: the
+            # interrupted main thread may be inside consume()).
             profile_trigger.request()
+            # threadlint: disable=signal-handler-unsafe -- best-effort
+            # notice; logging's RLock is reentrant from the interrupted
+            # main thread, worst case interleaved output.
             logger.info(
                 "[obs] SIGUSR2: profiler capture requested "
                 f"({obs.http.DEFAULT_PROFILE_STEPS} steps)"

@@ -448,10 +448,11 @@ class ServeFaultInjector:
                 fire = self._blackholed < p.blackhole_count
                 if fire:
                     self._blackholed += 1
+                    n_holed = self._blackholed
             if fire:
                 logger.warning(
                     f"[faults] serve black-hole: request {n} accepted, "
-                    f"never answered ({self._blackholed}/{p.blackhole_count})"
+                    f"never answered ({n_holed}/{p.blackhole_count})"
                 )
                 # Hold the handler thread (and the client's socket) open:
                 # the request is accepted but no bytes ever come back —

@@ -81,6 +81,12 @@ class LatencyHistogram:
             counts = list(self._counts)
             total = self._count
             mx = self._max
+        return self._percentile_from(q, counts, total, mx)
+
+    def _percentile_from(
+        self, q: float, counts: List[int], total: int, mx: float
+    ) -> float:
+        """Quantile over an already-taken snapshot (no locking)."""
         if total == 0:
             return 0.0
         rank = q * total
@@ -100,7 +106,8 @@ class LatencyHistogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def mean(self) -> float:
@@ -116,14 +123,20 @@ class LatencyHistogram:
             return list(self._bounds), list(self._counts), self._count, self._sum
 
     def summary(self) -> Dict[str, float]:
-        """{count, mean, p50, p90, p99, max} — the /metrics payload."""
+        """{count, mean, p50, p90, p99, max} — the /metrics payload.
+        Computed from ONE locked snapshot so the fields are mutually
+        consistent even with concurrent observes (count and p99 over the
+        same histogram state)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, sm, mx = self._count, self._sum, self._max
         return {
-            "count": float(self._count),
-            "mean": self.mean,
-            "p50": self.percentile(0.50),
-            "p90": self.percentile(0.90),
-            "p99": self.percentile(0.99),
-            "max": self._max,
+            "count": float(total),
+            "mean": sm / total if total else 0.0,
+            "p50": self._percentile_from(0.50, counts, total, mx),
+            "p90": self._percentile_from(0.90, counts, total, mx),
+            "p99": self._percentile_from(0.99, counts, total, mx),
+            "max": mx,
         }
 
 

@@ -78,6 +78,16 @@ def pytest_addoption(parser):
         help="run every test under jax.check_tracer_leaks "
         "(jaxlint runtime audit lane; see docs/STATIC_ANALYSIS.md)",
     )
+    parser.addoption(
+        "--lock-graph",
+        action="store_true",
+        default=False,
+        help="run every test under threadlint's LockGraph: locks created "
+        "during the test are instrumented, and the test fails on a "
+        "lock-acquisition-order cycle (potential deadlock) or a lock "
+        "held across a known blocking call (threadlint runtime audit "
+        "lane; see docs/STATIC_ANALYSIS.md)",
+    )
 
 
 @pytest.fixture
@@ -99,6 +109,23 @@ def _tracer_leak_lane(request):
 
         with tracer_leak_check():
             yield
+    else:
+        yield
+
+
+@pytest.fixture(autouse=True)
+def _lock_graph_lane(request):
+    """`pytest --lock-graph` (threadlint runtime lane, `make lockgraph`):
+    every lock CREATED during the test is instrumented; teardown fails
+    the test on an acquisition-order cycle or a lock held across a
+    blocking call. Graphs nest, so tests that drive their own LockGraph
+    still work inside the lane."""
+    if request.config.getoption("--lock-graph", default=False):
+        from tools.threadlint.runtime import LockGraph
+
+        with LockGraph() as graph:
+            yield
+        graph.assert_clean()
     else:
         yield
 
@@ -129,6 +156,8 @@ _SMOKE_FILES = {
     "test_obs.py",
     "test_meters.py",
     "test_router.py",
+    "test_threadlint.py",
+    "test_dist_broadcast.py",
 }
 
 

@@ -1,0 +1,171 @@
+"""Direct tests for parallel/dist.broadcast_object's two transports.
+
+PR 7 moved the primary transport to the coordination-service KV store
+(jaxlib 0.4.37's gloo allreduce corrupts back-to-back differently-shaped
+broadcasts on CPU) but kept the legacy two-phase collective as the
+fallback for runtimes without the private client API — and only the KV
+path was exercised (by test_multihost's real worker processes). These
+units pin BOTH paths' semantics process-locally with fake transports, so
+a regression in either shows up in the smoke lane instead of only on a
+multi-host launch."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+
+from seist_tpu.parallel import dist
+
+
+@pytest.fixture(autouse=True)
+def _reset_seq():
+    prev = dist._broadcast_seq
+    dist._broadcast_seq = 0
+    yield
+    dist._broadcast_seq = prev
+
+
+class _FakeKVClient:
+    """In-memory stand-in for the jax coordination-service client."""
+
+    def __init__(self, store=None):
+        self.store = store if store is not None else {}
+        self.barriers = []
+        self.deleted = []
+
+    def key_value_set_bytes(self, key, value):
+        self.store[key] = value
+
+    def blocking_key_value_get_bytes(self, key, timeout_ms):
+        try:
+            return self.store[key]
+        except KeyError:
+            raise TimeoutError(f"key {key} never published") from None
+
+    def wait_at_barrier(self, name, timeout_ms):
+        self.barriers.append(name)
+
+    def key_value_delete(self, key):
+        self.deleted.append(key)
+        self.store.pop(key, None)
+
+
+def _fake_multiprocess(monkeypatch, index, count=2):
+    monkeypatch.setattr(jax, "process_count", lambda: count)
+    monkeypatch.setattr(jax, "process_index", lambda: index)
+
+
+def test_single_process_passthrough():
+    obj = {"a": 1}
+    assert dist.broadcast_object(obj) is obj
+
+
+def test_kv_path_rank0_publishes_and_cleans_up(monkeypatch):
+    _fake_multiprocess(monkeypatch, index=0)
+    client = _FakeKVClient()
+    monkeypatch.setattr(dist, "_coordination_client", lambda: client)
+    obj = {"ckpt": "/path/step_120", "step": 120}
+    assert dist.broadcast_object(obj) == obj
+    # sequenced key, read barrier, then the key is deleted (a relaunched
+    # incarnation restarting its sequence must not read stale values)
+    assert client.barriers == ["seist_tpu/broadcast_object/0/read"]
+    assert client.deleted == ["seist_tpu/broadcast_object/0"]
+    assert client.store == {}
+
+
+def test_kv_path_rank1_reads_rank0_payload(monkeypatch):
+    _fake_multiprocess(monkeypatch, index=1)
+    obj = ["eval", 0.25, np.float64(3.5)]
+    store = {"seist_tpu/broadcast_object/0": pickle.dumps(obj)}
+    client = _FakeKVClient(store)
+    monkeypatch.setattr(dist, "_coordination_client", lambda: client)
+    assert dist.broadcast_object(None) == obj
+    # non-zero ranks wait at the barrier but never delete (rank 0 owns it)
+    assert client.barriers == ["seist_tpu/broadcast_object/0/read"]
+    assert client.deleted == []
+
+
+def test_kv_path_sequences_successive_broadcasts(monkeypatch):
+    _fake_multiprocess(monkeypatch, index=0)
+    client = _FakeKVClient()
+    monkeypatch.setattr(dist, "_coordination_client", lambda: client)
+    dist.broadcast_object("first")
+    dist.broadcast_object("second")
+    assert client.deleted == [
+        "seist_tpu/broadcast_object/0",
+        "seist_tpu/broadcast_object/1",
+    ]
+
+
+class _FakeCollective:
+    """Stand-in for multihost_utils.broadcast_one_to_all: echoes rank 0's
+    value. For rank 0 that is the argument itself; for other ranks the
+    test provides what rank 0 'sent' for the payload phase."""
+
+    def __init__(self, rank0_payload=None):
+        self.calls = []
+        self._rank0_payload = rank0_payload
+
+    def __call__(self, value):
+        self.calls.append(np.asarray(value).copy())
+        arr = np.asarray(value)
+        if self._rank0_payload is None:
+            return arr  # rank 0: input IS the broadcast value
+        if arr.ndim == 0:  # length phase
+            return np.int64(self._rank0_payload.size)
+        return self._rank0_payload  # buffer phase
+
+
+def test_legacy_collective_fallback_rank0(monkeypatch):
+    """No coordination client -> the two-phase length+buffer collective."""
+    from jax.experimental import multihost_utils
+
+    _fake_multiprocess(monkeypatch, index=0)
+    monkeypatch.setattr(dist, "_coordination_client", lambda: None)
+    fake = _FakeCollective()
+    monkeypatch.setattr(multihost_utils, "broadcast_one_to_all", fake)
+    obj = {"resume": True, "epoch": 3}
+    assert dist.broadcast_object(obj) == obj
+    # exactly two collectives: scalar length, then the uint8 pickle buffer
+    assert len(fake.calls) == 2
+    assert fake.calls[0].ndim == 0
+    assert fake.calls[1].dtype == np.uint8
+    assert int(fake.calls[0]) == fake.calls[1].size
+
+
+def test_legacy_collective_fallback_rank1(monkeypatch):
+    """A non-zero rank must reconstruct the object purely from what the
+    collective returns (its own buffer contribution is zeros)."""
+    from jax.experimental import multihost_utils
+
+    _fake_multiprocess(monkeypatch, index=1)
+    monkeypatch.setattr(dist, "_coordination_client", lambda: None)
+    obj = ("ckpt", 120, [1.5, 2.5])
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    fake = _FakeCollective(rank0_payload=payload)
+    monkeypatch.setattr(multihost_utils, "broadcast_one_to_all", fake)
+    assert dist.broadcast_object(None) == obj
+    # rank 1 contributed a zero buffer of the broadcast length — the
+    # result came from the collective, not local state
+    assert len(fake.calls) == 2
+    assert not fake.calls[1].any()
+
+
+def test_legacy_fallback_engages_when_client_api_gone(monkeypatch):
+    """_coordination_client returning None (private API changed/removed)
+    must route to the fallback rather than crash."""
+    from jax.experimental import multihost_utils
+
+    _fake_multiprocess(monkeypatch, index=0)
+
+    def _broken_client():
+        raise AssertionError("must go through dist._coordination_client")
+
+    # simulate the private-API import failing inside the helper
+    monkeypatch.setattr(dist, "_coordination_client", lambda: None)
+    fake = _FakeCollective()
+    monkeypatch.setattr(multihost_utils, "broadcast_one_to_all", fake)
+    assert dist.broadcast_object([1, 2]) == [1, 2]
+    assert len(fake.calls) == 2

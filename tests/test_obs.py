@@ -306,6 +306,32 @@ def test_profile_trigger_last_write_wins():
     assert t.consume() == 1
 
 
+def test_profile_trigger_request_during_consume_not_dropped():
+    """The PR 6 consumed-and-dropped hazard, re-pinned after the
+    lock-free rework: a request landing while consume() is mid-drain
+    (HTTP handler thread vs the train loop's step poll) must be captured
+    by that poll or the next one, never silently discarded."""
+    from collections import deque
+
+    t = obs.ProfileTrigger()
+
+    class MidDrainRequest(deque):
+        injected = False
+
+        def popleft(self):
+            v = deque.popleft(self)
+            if not self.injected:
+                # a second requester fires exactly between the drain's
+                # atomic popleft operations
+                MidDrainRequest.injected = True
+                t.request(20)
+            return v
+
+    t._requests = MidDrainRequest([5], maxlen=64)
+    assert t.consume() == 20  # the mid-drain request survives
+    assert t.consume() == 0
+
+
 # -------------------------------------------------------------- attribution
 def test_attribution_dot_flops_exact():
     import jax.numpy as jnp

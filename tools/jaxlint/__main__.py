@@ -1,4 +1,5 @@
-"""jaxlint CLI.
+"""jaxlint CLI (the generic frontend both analyzers share — threadlint's
+``python -m tools.threadlint`` calls :func:`run` with its own catalog).
 
     python -m tools.jaxlint seist_tpu                    # gate vs baseline
     python -m tools.jaxlint seist_tpu --no-baseline      # everything
@@ -14,6 +15,7 @@ import argparse
 import json
 import os
 import sys
+from typing import Dict, Optional, Sequence
 
 from tools.jaxlint.engine import (
     META_RULES,
@@ -21,24 +23,36 @@ from tools.jaxlint.engine import (
     iter_python_files,
     lint_paths,
 )
-from tools.jaxlint.rules import RULES, RULES_BY_NAME
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
-_DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools", "jaxlint_baseline.json")
 
 
-def main(argv=None) -> int:
+def run(
+    argv: Optional[Sequence[str]],
+    *,
+    tag: str,
+    catalog: Sequence,
+    rules_by_name: Dict[str, object],
+    default_baseline: str,
+    docs: str,
+    example_paths: str = "seist_tpu",
+) -> int:
+    """The shared gate frontend. ``tag`` is both the suppression-comment
+    tag and the ``python -m tools.<tag>`` program name."""
     ap = argparse.ArgumentParser(
-        prog="python -m tools.jaxlint",
-        description="JAX-aware static analysis (see docs/STATIC_ANALYSIS.md)",
+        prog=f"python -m tools.{tag}",
+        description=f"{tag} static analysis (see {docs})",
     )
     ap.add_argument("paths", nargs="*", default=[], help="files/dirs to lint")
     ap.add_argument(
         "--baseline",
-        default=_DEFAULT_BASELINE,
-        help="grandfather list (default tools/jaxlint_baseline.json)",
+        default=default_baseline,
+        help=(
+            "grandfather list (default "
+            f"{os.path.relpath(default_baseline, _REPO_ROOT)})"
+        ),
     )
     ap.add_argument(
         "--no-baseline",
@@ -67,12 +81,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for rule in RULES:
+        for rule in catalog:
             print(f"{rule.name}\n    {rule.summary}\n    fix: {rule.hint}")
         return 0
 
     if not args.paths:
-        ap.error("no paths given (try: python -m tools.jaxlint seist_tpu)")
+        ap.error(
+            f"no paths given (try: python -m tools.{tag} {example_paths})"
+        )
 
     rules = None
     if args.select:
@@ -83,17 +99,19 @@ def main(argv=None) -> int:
                 "entry for the linted files; update with the full catalog"
             )
         names = [n.strip() for n in args.select.split(",") if n.strip()]
-        unknown = [n for n in names if n not in RULES_BY_NAME]
+        unknown = [n for n in names if n not in rules_by_name]
         if unknown:
             ap.error(
                 f"unknown rule(s) {unknown}; see --list-rules"
             )
-        rules = [RULES_BY_NAME[n] for n in names]
+        rules = [rules_by_name[n] for n in names]
 
     try:
-        findings = lint_paths(args.paths, root=args.root, rules=rules)
+        findings = lint_paths(
+            args.paths, root=args.root, rules=rules, tag=tag, catalog=catalog
+        )
     except FileNotFoundError as e:
-        print(f"jaxlint: {e}", file=sys.stderr)
+        print(f"{tag}: {e}", file=sys.stderr)
         return 2
     if any(f.rule == "parse-error" for f in findings):
         for f in findings:
@@ -124,7 +142,7 @@ def main(argv=None) -> int:
         acceptable = [f for f in findings if f.rule not in META_RULES]
         merged = Baseline(kept)
         merged.counts.update(Baseline.from_findings(acceptable).counts)
-        merged.save(args.baseline)
+        merged.save(args.baseline, tool=tag)
         print(
             f"baseline updated: {len(acceptable)} accepted finding(s) from "
             f"{len(linted)} linted file(s), {len(kept)} entr(ies) for "
@@ -134,7 +152,7 @@ def main(argv=None) -> int:
         skipped = len(findings) - len(acceptable)
         if skipped:
             print(
-                f"jaxlint: {skipped} suppression-hygiene finding(s) NOT "
+                f"{tag}: {skipped} suppression-hygiene finding(s) NOT "
                 "accepted (fix the annotations instead)"
             )
         return 0
@@ -174,18 +192,33 @@ def main(argv=None) -> int:
             print(f.render())
         grandfathered = len(findings) - len(new)
         print(
-            f"jaxlint: {len(new)} new finding(s), "
+            f"{tag}: {len(new)} new finding(s), "
             f"{grandfathered} grandfathered (baseline: "
             f"{os.path.relpath(args.baseline, args.root)})"
         )
         if stale:
             print(
-                f"jaxlint: note — {len(stale)} baseline entr(ies) no longer "
+                f"{tag}: note — {len(stale)} baseline entr(ies) no longer "
                 "observed; tighten with --update-baseline:"
             )
             for k in stale:
                 print(f"    {k}")
     return 1 if new else 0
+
+
+def main(argv=None) -> int:
+    from tools.jaxlint.rules import RULES, RULES_BY_NAME
+
+    return run(
+        argv,
+        tag="jaxlint",
+        catalog=RULES,
+        rules_by_name=RULES_BY_NAME,
+        default_baseline=os.path.join(
+            _REPO_ROOT, "tools", "jaxlint_baseline.json"
+        ),
+        docs="docs/STATIC_ANALYSIS.md",
+    )
 
 
 if __name__ == "__main__":

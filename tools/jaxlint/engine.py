@@ -1,4 +1,7 @@
-"""jaxlint core: module analysis, suppression handling, baseline gate.
+"""Lint-framework core: module analysis, suppression handling, baseline
+gate. Shared by jaxlint (JAX hot-path rules) and threadlint (concurrency
+and process-lifecycle rules, tools/threadlint/) — the two analyzers differ
+only in their rule catalog and suppression ``tag``.
 
 The engine is rule-agnostic: it parses each file once into a
 :class:`ModuleInfo` (AST + parent links + comment map + jit registry) and
@@ -19,10 +22,21 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
-# Comment grammar:  # jaxlint: disable=rule-a,rule-b -- rationale text
-_SUPPRESS_RE = re.compile(
-    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,-]+)\s*(?:--\s*(.*))?$"
-)
+# Comment grammar:  # <tag>: disable=rule-a,rule-b -- rationale text
+# (tag = "jaxlint" or "threadlint"; each analyzer only honors its own tag,
+# so a jaxlint suppression can never silence a threadlint finding.)
+_SUPPRESS_RES: Dict[str, "re.Pattern[str]"] = {}
+
+
+def _suppress_re(tag: str) -> "re.Pattern[str]":
+    pat = _SUPPRESS_RES.get(tag)
+    if pat is None:
+        pat = re.compile(
+            r"#\s*" + re.escape(tag)
+            + r":\s*disable=([A-Za-z0-9_,-]+)\s*(?:--\s*(.*))?$"
+        )
+        _SUPPRESS_RES[tag] = pat
+    return pat
 
 # Findings about the lint annotations themselves — never eligible for the
 # baseline: grandfathering a rationale-less or stale suppression would
@@ -218,7 +232,7 @@ class ModuleInfo:
 
 # --------------------------------------------------------------- suppressions
 def parse_suppressions(
-    info: ModuleInfo,
+    info: ModuleInfo, tag: str = "jaxlint"
 ) -> Tuple[Dict[int, Suppression], List[Finding]]:
     """Line -> suppression. A suppression covers its own line; a comment
     alone on its line also covers the next source line (comment-above
@@ -227,7 +241,7 @@ def parse_suppressions(
     by_line: Dict[int, Suppression] = {}
     problems: List[Finding] = []
     for lineno, comment in info.comments.items():
-        m = _SUPPRESS_RE.search(comment)
+        m = _suppress_re(tag).search(comment)
         if not m:
             continue
         rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
@@ -240,10 +254,10 @@ def parse_suppressions(
                     col=0,
                     rule="suppression-missing-rationale",
                     message=(
-                        "jaxlint suppression without a rationale is ignored"
+                        f"{tag} suppression without a rationale is ignored"
                     ),
                     hint=(
-                        "write `# jaxlint: disable=<rule> -- <why this is "
+                        f"write `# {tag}: disable=<rule> -- <why this is "
                         "safe here>`"
                     ),
                     text=info.line_text(lineno),
@@ -291,13 +305,14 @@ class Baseline:
             counts[f.key] = counts.get(f.key, 0) + 1
         return cls(counts)
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, tool: str = "jaxlint") -> None:
         with open(path, "w") as f:
             json.dump(
                 {
                     "comment": (
-                        "jaxlint grandfather list — regenerate with "
-                        "`python -m tools.jaxlint <paths> --update-baseline`. "
+                        f"{tool} grandfather list — regenerate with "
+                        f"`python -m tools.{tool} <paths> "
+                        "--update-baseline`. "
                         "Keys are file::rule::source-line; the gate fails "
                         "only on findings beyond these counts."
                     ),
@@ -336,10 +351,16 @@ def lint_source(
     source: str,
     path: str = "<string>",
     rules: Optional[Sequence] = None,
+    tag: str = "jaxlint",
+    catalog: Optional[Sequence] = None,
 ) -> List[Finding]:
     """Lint one source blob. ``path`` should be the posix relpath used in
-    baseline keys."""
-    from tools.jaxlint.rules import RULES
+    baseline keys. ``tag`` selects the suppression grammar; ``catalog``
+    is the analyzer's full rule set (default: jaxlint's), used when
+    ``rules`` is None — passing ``rules`` explicitly means a --select
+    subset run, which disables unused-suppression reporting."""
+    if catalog is None:
+        from tools.jaxlint.rules import RULES as catalog
 
     try:
         info = ModuleInfo(path, source)
@@ -354,9 +375,9 @@ def lint_source(
                 text="",
             )
         ]
-    suppressions, problems = parse_suppressions(info)
+    suppressions, problems = parse_suppressions(info, tag)
     findings: List[Finding] = list(problems)
-    for rule in rules if rules is not None else RULES:
+    for rule in rules if rules is not None else catalog:
         for f in rule.check(info):
             sup = suppressions.get(f.line)
             if sup is not None and sup.covers(f.rule):
@@ -383,7 +404,7 @@ def lint_source(
                         f"(rules: {', '.join(sup.rules)}) — the code it "
                         "excused is gone or the rule name is wrong"
                     ),
-                    hint="delete the stale `# jaxlint: disable` comment",
+                    hint=f"delete the stale `# {tag}: disable` comment",
                     text=info.line_text(sup.line),
                 )
             )
@@ -427,6 +448,8 @@ def lint_paths(
     paths: Sequence[str],
     root: Optional[str] = None,
     rules: Optional[Sequence] = None,
+    tag: str = "jaxlint",
+    catalog: Optional[Sequence] = None,
 ) -> List[Finding]:
     root = os.path.abspath(root or os.getcwd())
     findings: List[Finding] = []
@@ -436,5 +459,5 @@ def lint_paths(
         )
         with open(fpath, encoding="utf-8") as f:
             source = f.read()
-        findings.extend(lint_source(source, rel, rules))
+        findings.extend(lint_source(source, rel, rules, tag, catalog))
     return findings

@@ -1,0 +1,14 @@
+"""threadlint — host-side concurrency & process-lifecycle static analysis.
+
+The jaxlint sibling (same engine, same suppression/baseline machinery,
+``# threadlint: disable=<rule> -- <rationale>`` comments) aimed at the
+bug class every hard failure of PRs 4-7 belonged to: unguarded shared
+state, async-unsafe signal handlers, silently-dying threads, socketserver
+backlog drops, and undocumented exit codes. ``tools/threadlint/runtime.py``
+adds the opt-in LockGraph lane (lock-acquisition-order cycles + locks held
+across blocking calls) that rides the smoke/chaos test lanes via
+``pytest --lock-graph``. See docs/STATIC_ANALYSIS.md "Concurrency
+analysis".
+"""
+
+from tools.threadlint.engine import lint_paths, lint_source  # noqa: F401

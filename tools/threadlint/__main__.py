@@ -1,0 +1,39 @@
+"""threadlint CLI — the jaxlint frontend bound to the concurrency catalog.
+
+    python -m tools.threadlint seist_tpu tools           # gate vs baseline
+    python -m tools.threadlint seist_tpu --no-baseline   # everything
+    python -m tools.threadlint --list-rules
+
+Exit codes: 0 clean (vs baseline), 1 new findings, 2 usage/parse error.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from tools.jaxlint.__main__ import run
+from tools.threadlint.rules import RULES, RULES_BY_NAME
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_DEFAULT_BASELINE = os.path.join(
+    _REPO_ROOT, "tools", "threadlint_baseline.json"
+)
+
+
+def main(argv=None) -> int:
+    return run(
+        argv,
+        tag="threadlint",
+        catalog=RULES,
+        rules_by_name=RULES_BY_NAME,
+        default_baseline=_DEFAULT_BASELINE,
+        docs="docs/STATIC_ANALYSIS.md §Concurrency analysis",
+        example_paths="seist_tpu tools",
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,649 @@
+"""threadlint rule catalog — concurrency & lifecycle hazards, repo-tuned.
+
+Every rule is a pure function of one
+:class:`~tools.jaxlint.engine.ModuleInfo`. Static analysis cannot prove
+which thread executes a statement, so — like jaxlint — the catalog trades
+soundness for signal and encodes the conventions this repo actually
+relies on:
+
+* **Lock discipline is inferred, not declared**: an attribute written
+  under ``with self._lock`` anywhere in a class is presumed lock-guarded
+  everywhere; ``__init__`` (happens-before publication) and methods named
+  ``*_locked`` (the caller-holds-the-lock convention, e.g.
+  ``CircuitBreaker._open_locked``) are the two sanctioned unguarded
+  contexts.
+* **Signal handlers flip flags**: anything beyond assignments,
+  ``Event.set()`` and the blessed exit funnels (``io_guard.hard_exit``,
+  ``os._exit``, ``os.kill``) is flagged — handlers run at arbitrary
+  bytecode boundaries *on the main thread*, so a non-reentrant lock the
+  main path also takes (logging's, a trigger's) is a self-deadlock.
+* **Exit codes are a contract**: 0/1/2 + ``PREEMPT_EXIT_CODE`` (75, the
+  supervisor relaunch signal — docs/FAULT_TOLERANCE.md); ``os._exit``
+  lives only inside the ``io_guard.hard_exit`` funnel.
+* **request_queue_size is pinned**: socketserver's backlog-5 default
+  silently drops SYNs under conn-per-request load (client retransmit
+  clusters at 1/3/7/15/31 s while the service idles — the PR 7 root
+  cause, encoded here so it can never regress).
+
+False positives are expected to be rare and cheap: suppress inline with
+``# threadlint: disable=<rule> -- <rationale>`` or accept into
+tools/threadlint_baseline.json. See docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.jaxlint.engine import Finding, ModuleInfo
+from tools.jaxlint.rules import Rule
+
+#: threading factory callables whose product is a mutual-exclusion
+#: context manager (Condition wraps an RLock; ``with self._cond`` guards
+#: exactly like ``with self._lock``).
+_LOCKLIKE = ("Lock", "RLock", "Condition")
+_EVENTLIKE = ("Event", "Condition")
+
+#: container mutations that count as writes for lock-discipline purposes
+_MUTATORS = frozenset(
+    (
+        "append",
+        "appendleft",
+        "extend",
+        "insert",
+        "pop",
+        "popleft",
+        "popitem",
+        "remove",
+        "discard",
+        "clear",
+        "add",
+        "update",
+        "setdefault",
+        "sort",
+    )
+)
+
+# Construction contexts: the object is not yet published to other
+# threads, so unguarded writes are happens-before-safe (__setstate__ runs
+# on a freshly unpickled instance, same argument).
+_INIT_METHODS = frozenset(
+    ("__init__", "__new__", "__post_init__", "__setstate__")
+)
+
+
+def _is_threading_factory(
+    info: ModuleInfo, node: ast.AST, kinds: Tuple[str, ...]
+) -> bool:
+    """``threading.Lock()`` / bare ``Lock()`` (from-import) for ``kinds``."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = info.dotted_name(node.func)
+    return name in kinds or any(name == f"threading.{k}" for k in kinds)
+
+
+def _assign_value_targets(node: ast.AST):
+    """``(value, targets)`` for plain and annotated assignments —
+    ``self._lock: threading.Lock = threading.Lock()`` must count exactly
+    like the unannotated form, or a typing-hygiene edit silently turns a
+    rule off."""
+    if isinstance(node, ast.Assign):
+        return node.value, node.targets
+    if isinstance(node, ast.AnnAssign) and node.value is not None:
+        return node.value, [node.target]
+    return None, ()
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _enclosing_class(
+    info: ModuleInfo, node: ast.AST
+) -> Optional[ast.ClassDef]:
+    for a in info.ancestors(node):
+        if isinstance(a, ast.ClassDef):
+            return a
+    return None
+
+
+def _enclosing_method(info: ModuleInfo, node: ast.AST):
+    """The function whose body directly contains ``node`` (first function
+    ancestor)."""
+    return info.enclosing_function(node)
+
+
+def _held_locks(
+    info: ModuleInfo, node: ast.AST, lock_attrs: Set[str]
+) -> Set[str]:
+    """Lock attrs held via ``with self.<lock>:`` around ``node``, within
+    the same function (a nested def's body does not run under an outer
+    with)."""
+    held: Set[str] = set()
+    for a in info.ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+        if isinstance(a, ast.With):
+            for item in a.items:
+                attr = _self_attr(item.context_expr)
+                if attr in lock_attrs:
+                    held.add(attr)
+    return held
+
+
+class UnguardedAttr(Rule):
+    name = "unguarded-attr"
+    summary = (
+        "attribute written under `with self._lock` elsewhere in the class "
+        "is read/written on an unguarded path"
+    )
+    hint = (
+        "take the lock (or snapshot under it), move the access into "
+        "__init__, or rename the method *_locked if every caller already "
+        "holds the lock"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for cls in ast.walk(info.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(info, cls)
+
+    def _check_class(
+        self, info: ModuleInfo, cls: ast.ClassDef
+    ) -> Iterator[Finding]:
+        lock_attrs: Set[str] = set()
+        for node in ast.walk(cls):
+            value, targets = _assign_value_targets(node)
+            if value is not None and _is_threading_factory(
+                info, value, _LOCKLIKE
+            ):
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        lock_attrs.add(attr)
+        if not lock_attrs:
+            return
+
+        # (attr, node, is_write, held, method) for every self.<attr> access
+        accesses: List[Tuple[str, ast.AST, bool, Set[str], Optional[str]]] = []
+        for node in ast.walk(cls):
+            if _enclosing_class(info, node) is not cls:
+                continue  # a nested class owns its own discipline
+            attr = _self_attr(node)
+            if attr is None or attr in lock_attrs:
+                continue
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            # self.<attr>.append(...) and friends mutate the container
+            parent = info.parents.get(node)
+            if (
+                isinstance(parent, ast.Attribute)
+                and parent.attr in _MUTATORS
+                and isinstance(info.parents.get(parent), ast.Call)
+                and info.parents[parent].func is parent
+            ):
+                is_write = True
+            # self.<attr>[k] = v / del self.<attr>[k]
+            if isinstance(parent, ast.Subscript) and isinstance(
+                parent.ctx, (ast.Store, ast.Del)
+            ):
+                is_write = True
+            method = _enclosing_method(info, node)
+            accesses.append(
+                (
+                    attr,
+                    node,
+                    is_write,
+                    _held_locks(info, node, lock_attrs),
+                    method.name if method is not None else None,
+                )
+            )
+
+        guarded = {
+            attr for attr, _, is_write, held, _ in accesses if is_write and held
+        }
+        for attr, node, is_write, held, method in accesses:
+            if attr not in guarded:
+                continue
+            # Holding a lock only counts if it is one of the locks the
+            # attribute is actually written under — a DIFFERENT lock is
+            # still a race (the two-lock wrong-lock shape).
+            if held & guarded_locks(accesses, attr):
+                continue
+            if method is None or method in _INIT_METHODS:
+                continue  # construction happens-before publication
+            if method.endswith("_locked"):
+                continue  # caller-holds-the-lock convention
+            verb = "written" if is_write else "read"
+            yield self.finding(
+                info,
+                node,
+                f"self.{attr} is {verb} without the lock here but written "
+                f"under `with self.{sorted(guarded_locks(accesses, attr))[0]}`"
+                f" elsewhere in {cls.name} — a torn read/lost update race",
+            )
+
+
+def guarded_locks(accesses, attr: str) -> Set[str]:
+    locks: Set[str] = set()
+    for a, _, is_write, held, _ in accesses:
+        if a == attr and is_write and held:
+            locks |= held
+    return locks or {"_lock"}
+
+
+class SignalHandlerUnsafe(Rule):
+    name = "signal-handler-unsafe"
+    summary = (
+        "signal handler does more than flip a flag / funnel to a blessed "
+        "exit"
+    )
+    hint = (
+        "handlers run at arbitrary bytecode boundaries on the main "
+        "thread: set an Event/flag and act at a poll point, or funnel to "
+        "io_guard.hard_exit / os._exit; anything that allocates, logs, or "
+        "takes a lock the main path also takes can self-deadlock"
+    )
+
+    #: call targets a handler may invoke directly
+    _ALLOWED = frozenset(
+        ("os._exit", "os.kill", "hard_exit", "signal.signal", "getattr")
+    )
+    _ALLOWED_SUFFIX = (".set", ".hard_exit")
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        # One handler can serve several signals (SIGTERM+SIGINT is the
+        # repo idiom) — analyze each handler body exactly once.
+        seen: Set[int] = set()
+        for node in ast.walk(info.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and info.dotted_name(node.func) == "signal.signal"
+                and len(node.args) == 2
+            ):
+                continue
+            handler = node.args[1]
+            bodies: List[ast.AST] = []
+            if isinstance(handler, ast.Lambda):
+                bodies.append(handler.body)
+            elif isinstance(handler, ast.Name):
+                bodies.extend(info.defs_by_name.get(handler.id, ()))
+            for body in bodies:
+                if id(body) in seen:
+                    continue
+                seen.add(id(body))
+                yield from self._check_handler(info, body)
+
+    def _check_handler(
+        self, info: ModuleInfo, handler: ast.AST
+    ) -> Iterator[Finding]:
+        # No self-skip here: for a lambda handler, ``handler`` IS the
+        # offending Call expression itself.
+        for node in ast.walk(handler):
+            if not isinstance(node, ast.Call):
+                continue
+            name = info.dotted_name(node.func)
+            if name in self._ALLOWED:
+                continue
+            if any(name.endswith(s) for s in self._ALLOWED_SUFFIX):
+                continue
+            yield self.finding(
+                info,
+                node,
+                f"signal handler calls {name or 'an expression'}() — "
+                "not async-signal-safe (allocation / logging locks / "
+                "locks shared with the interrupted main thread)",
+            )
+
+
+class ThreadNoJoin(Rule):
+    name = "thread-no-join"
+    summary = "non-daemon thread with no join() on any shutdown path"
+    hint = (
+        "pass daemon=True (if the thread may be abandoned at exit) or "
+        "join it on every shutdown path — otherwise threading._shutdown "
+        "blocks interpreter exit forever on a wedged thread"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        joined = self._joined_names(info)
+        for node in ast.walk(info.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and info.dotted_name(node.func)
+                in ("threading.Thread", "Thread")
+            ):
+                continue
+            daemon = next(
+                (kw.value for kw in node.keywords if kw.arg == "daemon"),
+                None,
+            )
+            if (
+                isinstance(daemon, ast.Constant)
+                and daemon.value is True
+            ):
+                continue
+            bound = self._binding(info, node)
+            if bound is not None and bound in joined:
+                continue
+            yield self.finding(
+                info,
+                node,
+                "non-daemon Thread is never join()ed in this module — a "
+                "wedged run loop makes clean interpreter exit impossible",
+            )
+
+    @staticmethod
+    def _binding(info: ModuleInfo, call: ast.Call) -> Optional[str]:
+        """'x' or 'self.y' when the Thread lands in a simple (plain or
+        annotated) binding."""
+        parent = info.parents.get(call)
+        value, targets = _assign_value_targets(parent)
+        if value is call and len(targets) == 1:
+            t = targets[0]
+            if isinstance(t, ast.Name):
+                return t.id
+            attr = _self_attr(t)
+            if attr:
+                return f"self.{attr}"
+        return None
+
+    @staticmethod
+    def _joined_names(info: ModuleInfo) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(info.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                v = node.func.value
+                if isinstance(v, ast.Name):
+                    out.add(v.id)
+                else:
+                    attr = _self_attr(v)
+                    if attr:
+                        out.add(f"self.{attr}")
+        return out
+
+
+class ThreadTargetRaises(Rule):
+    name = "thread-target-raises"
+    summary = (
+        "Thread target can raise past its top frame (silent thread death)"
+    )
+    hint = (
+        "wrap the target's whole body in try/except that records the "
+        "death (log, fail-fast flag, poison result) — an uncaught "
+        "exception only prints to stderr and the thread vanishes (the "
+        "PR 2 batcher-flush bug class)"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and info.dotted_name(node.func)
+                in ("threading.Thread", "Thread")
+            ):
+                continue
+            target = next(
+                (kw.value for kw in node.keywords if kw.arg == "target"),
+                None,
+            )
+            name: Optional[str] = None
+            if isinstance(target, ast.Name):
+                name = target.id
+            else:
+                attr = _self_attr(target) if target is not None else None
+                if attr:
+                    name = attr
+            if name is None:
+                continue  # unresolvable (bound method of another object)
+            defs = info.defs_by_name.get(name, ())
+            if not defs:
+                continue
+            if all(self._shielded(d) for d in defs):
+                continue
+            yield self.finding(
+                info,
+                node,
+                f"thread target '{name}' has top-level statements outside "
+                "any try/except — an exception there kills the thread "
+                "silently",
+            )
+
+    @staticmethod
+    def _shielded(fn: ast.FunctionDef) -> bool:
+        body = list(fn.body)
+        if (
+            body
+            and isinstance(body[0], ast.Expr)
+            and isinstance(body[0].value, ast.Constant)
+            and isinstance(body[0].value.value, str)
+        ):
+            body = body[1:]  # docstring
+        return bool(body) and all(
+            isinstance(stmt, ast.Try) and stmt.handlers for stmt in body
+        )
+
+
+class WaitNoTimeout(Rule):
+    name = "wait-no-timeout"
+    summary = "Event/Condition wait() without a timeout"
+    hint = (
+        "wait with a timeout in a loop (re-checking the predicate): a "
+        "lost set()/notify() — dead producer, shutdown race — otherwise "
+        "parks the thread forever"
+    )
+
+    @staticmethod
+    def _untimed(call: ast.Call) -> bool:
+        """``wait()``, ``wait(None)`` and ``wait(timeout=None)`` are all
+        the same forever-park."""
+        if not call.args and not call.keywords:
+            return True
+        timeout: Optional[ast.AST] = None
+        if call.args:
+            timeout = call.args[0]
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                timeout = kw.value
+        return isinstance(timeout, ast.Constant) and timeout.value is None
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        event_attrs: Set[str] = set()
+        event_names: Set[str] = set()
+        for node in ast.walk(info.tree):
+            value, targets = _assign_value_targets(node)
+            if value is not None and _is_threading_factory(
+                info, value, _EVENTLIKE
+            ):
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        event_attrs.add(attr)
+                    elif isinstance(t, ast.Name):
+                        event_names.add(t.id)
+        if not (event_attrs or event_names):
+            return
+        for node in ast.walk(info.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "wait"
+                and self._untimed(node)
+            ):
+                continue
+            v = node.func.value
+            attr = _self_attr(v)
+            known = (attr in event_attrs) or (
+                isinstance(v, ast.Name) and v.id in event_names
+            )
+            if known:
+                yield self.finding(
+                    info,
+                    node,
+                    "untimed wait(): a lost wakeup parks this thread "
+                    "forever with no watchdog signal",
+                )
+
+
+class HttpServerBacklog(Rule):
+    name = "http-server-backlog"
+    summary = (
+        "socketserver subclass without a pinned request_queue_size"
+    )
+    hint = (
+        "set `request_queue_size = 1024` in the class body: socketserver "
+        "defaults the listen backlog to 5, and under conn-per-request "
+        "bursts dropped SYNs retransmit at 1/3/7/15/31 s — client p99 "
+        "clusters while the service idles (the PR 7 root cause)"
+    )
+
+    _SERVER_BASES = frozenset(
+        (
+            "HTTPServer",
+            "ThreadingHTTPServer",
+            "TCPServer",
+            "ThreadingTCPServer",
+            "ForkingTCPServer",
+            "UnixStreamServer",
+        )
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = {
+                info.dotted_name(b).split(".")[-1] for b in node.bases
+            }
+            if not (bases & self._SERVER_BASES):
+                continue
+            pinned = any(
+                isinstance(stmt, (ast.Assign, ast.AnnAssign))
+                # a bare `request_queue_size: int` annotation assigns
+                # nothing — the backlog silently stays 5
+                and (isinstance(stmt, ast.Assign) or stmt.value is not None)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "request_queue_size"
+                    for t in (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                )
+                for stmt in node.body
+            )
+            if not pinned:
+                yield self.finding(
+                    info,
+                    node,
+                    f"{node.name} subclasses a socketserver server without "
+                    "pinning request_queue_size (backlog defaults to 5: "
+                    "SYN drops under accept bursts)",
+                )
+
+
+class ExitOutsideFunnel(Rule):
+    name = "exit-outside-funnel"
+    summary = (
+        "sys.exit/os._exit outside the blessed funnels, or a "
+        "non-contract exit code"
+    )
+    hint = (
+        "route hard deaths through io_guard.hard_exit (flushes logs, "
+        "dumps the flight recorder); exit codes are a supervisor "
+        "contract — 0 ok, 1 failure, 2 usage, and the named "
+        "PREEMPT_EXIT_CODE constant (75, never the bare literal) for a "
+        "managed preempt (docs/FAULT_TOLERANCE.md)"
+    )
+
+    _CONTRACT_CODES = frozenset((0, 1, 2))
+    _CONTRACT_NAMES = frozenset(("PREEMPT_EXIT_CODE",))
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = info.dotted_name(node.func)
+            if name == "os._exit":
+                fn = info.enclosing_function(node)
+                if fn is not None and fn.name == "hard_exit":
+                    continue  # THE funnel (data/io_guard.py)
+                yield self.finding(
+                    info,
+                    node,
+                    "os._exit outside the io_guard.hard_exit funnel skips "
+                    "log flush + flight-recorder dump",
+                )
+            elif name == "sys.exit":
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Call):
+                    continue  # sys.exit(main()) trampoline
+                # -1 parses as UnaryOp(USub, Constant(1)) — fold it so
+                # the bug-shaped sys.exit(-1) (process rc 255) is judged
+                # as the literal it reads as.
+                if (
+                    isinstance(arg, ast.UnaryOp)
+                    and isinstance(arg.op, ast.USub)
+                    and isinstance(arg.operand, ast.Constant)
+                    and isinstance(arg.operand.value, (int, float))
+                    and not isinstance(arg.operand.value, bool)
+                ):
+                    arg = ast.copy_location(
+                        ast.Constant(value=-arg.operand.value), arg
+                    )
+                if isinstance(arg, ast.Constant):
+                    if isinstance(arg.value, str):
+                        # sys.exit("message") is the stdlib-blessed
+                        # print-to-stderr-and-exit-1 idiom: contract code 1
+                        continue
+                    if (
+                        not isinstance(arg.value, bool)
+                        and arg.value in self._CONTRACT_CODES
+                    ):
+                        # bools are ints (True == 1) but sys.exit(True) is
+                        # a bug-shaped exit code, not the contract
+                        continue
+                    yield self.finding(
+                        info,
+                        node,
+                        f"exit code {arg.value!r} is not a documented "
+                        "contract value (0/1/2/PREEMPT_EXIT_CODE) — "
+                        "supervisors will misclassify this death",
+                    )
+                    continue
+                terminal = info.dotted_name(arg).split(".")[-1]
+                if terminal and terminal not in self._CONTRACT_NAMES:
+                    # A bare variable is unprovable; only flag names that
+                    # LOOK like a constant but aren't the contract one.
+                    if terminal.isupper():
+                        yield self.finding(
+                            info,
+                            node,
+                            f"exit code constant {terminal} is not "
+                            "PREEMPT_EXIT_CODE — document it in the exit "
+                            "contract or reuse 0/1/2/PREEMPT_EXIT_CODE",
+                        )
+
+
+RULES: Tuple[Rule, ...] = (
+    UnguardedAttr(),
+    SignalHandlerUnsafe(),
+    ThreadNoJoin(),
+    ThreadTargetRaises(),
+    WaitNoTimeout(),
+    HttpServerBacklog(),
+    ExitOutsideFunnel(),
+)
+
+RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULES}

@@ -1,0 +1,438 @@
+"""threadlint runtime audit lane: the lock-order graph.
+
+Static rules see lock *discipline*; this module sees lock *ordering* —
+the property whose violation is a deadlock, and which no single-file AST
+pass can check (the two locks of a deadlock usually live in different
+modules). :class:`LockGraph` instruments ``threading.Lock`` /
+``threading.RLock`` (and, transitively, ``threading.Condition``, which
+builds on them) while active:
+
+* every lock is identified by its **creation site** (``file:line`` of the
+  factory call), so the per-instance locks of N replicas/batchers
+  collapse onto one graph node — the meaningful unit for ordering;
+* acquiring lock B while holding lock A adds the edge ``A -> B`` (first
+  observation keeps a sample thread + stack);
+* a **cycle** in the resulting directed graph is a potential deadlock:
+  two threads walking the cycle from different entry points can block
+  each other forever even if the test run happened to interleave safely;
+* holding any instrumented lock across a known **blocking call**
+  (``http.client`` response reads; ``jax.device_get`` when jax is
+  loaded) is recorded as a violation — the serve plane's rule that
+  forwards/HTTP happen outside locks, enforced at runtime.
+
+Mirrors jaxlint's CompileBudget in shape: a context manager plus a
+conftest fixture, ridden over the whole smoke lane with
+``pytest -m smoke --lock-graph`` (``make lockgraph``). Only locks
+*created* while the graph is active are instrumented — module-level
+singletons born at import time are invisible, which is fine for the test
+lanes (every serve/obs object under test is constructed inside a test).
+
+Overhead: one dict operation per acquire/release against an internal
+(uninstrumented) lock — measured single-digit microseconds per pair
+(tests/test_threadlint.py pins the bound), invisible next to a
+millisecond-scale model forward.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+#: the single active graph (nesting is a usage error: two graphs would
+#: fight over the threading factory patch)
+_ACTIVE: Optional["LockGraph"] = None
+
+
+def _site(depth: int = 2) -> Optional[str]:
+    """``file:line`` of the nearest non-threading.py frame above the
+    factory call — for a direct ``threading.Lock()`` that is the call
+    itself; for the RLock inside ``threading.Condition()`` it is the
+    Condition() call site (the name a human recognizes). None when the
+    whole visible stack is threading internals (interpreter plumbing —
+    not part of any user ordering discipline)."""
+    threading_file = threading.__file__
+    f: Any = sys._getframe(depth)
+    for _ in range(12):
+        if f is None:
+            return None
+        if f.f_code.co_filename != threading_file:
+            break
+        f = f.f_back
+    else:
+        return None
+    if f is None:
+        return None
+    name = f.f_code.co_filename
+    for marker in ("/seist_tpu/", "/tools/", "/tests/"):
+        i = name.rfind(marker)
+        if i >= 0:
+            name = name[i + 1 :]
+            break
+    return f"{name}:{f.f_lineno}"
+
+
+class _InstrumentedLock:
+    """Wraps one real primitive Lock; reports acquisition order to a
+    graph.
+
+    The wrapper outlives its creation graph's window (objects created
+    during a test keep their locks afterwards). While the creation graph
+    is live (active or paused by a nested graph) it gets the reports;
+    once it is done for good, the lock RE-ATTACHES to whatever graph is
+    currently active — a process-wide singleton born in test 1's window
+    stays auditable for the rest of a ``--lock-graph`` lane instead of
+    reporting into a dead graph. With no graph live at all, acquire and
+    release degrade to plain delegation.
+
+    Deliberately does NOT expose the RLock-only private protocol
+    (``_release_save``/...): ``threading.Condition`` probes for it with
+    getattr and must fall back to its plain-lock paths here, exactly as
+    with an uninstrumented Lock.
+    """
+
+    def __init__(self, real: Any, site: str, graph: "LockGraph"):
+        self._real = real
+        self._site = site
+        self._graph = graph
+
+    def _target(self) -> Optional["LockGraph"]:
+        g = self._graph
+        if g.active or g._paused:
+            return g
+        return _ACTIVE
+
+    # -- the Lock protocol --------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            g = self._target()
+            if g is not None:
+                g._acquired(self)
+        return got
+
+    def release(self) -> None:
+        g = self._target()
+        if g is not None:
+            g._released(self)
+        self._real.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __repr__(self) -> str:
+        return f"<threadlint lock {self._site} wrapping {self._real!r}>"
+
+
+class _InstrumentedRLock(_InstrumentedLock):
+    # The private protocol threading.Condition prefers on a reentrant
+    # lock: wait() must FULLY release the RLock (all recursion levels)
+    # and restore the count afterwards. The graph's held bookkeeping
+    # mirrors the full release, or a waiting thread would look like it
+    # holds the lock for the whole wait — and carries the recursion
+    # depth through the opaque state, or a depth-2 holder would come
+    # back as depth-1 and the entry would pop while the lock is still
+    # really held (missing edges/violations in the outer with-block).
+    def _release_save(self):
+        state = self._real._release_save()
+        g = self._target()
+        depth = g._released(self, fully=True) if g is not None else None
+        return (state, depth)
+
+    def _acquire_restore(self, state) -> None:
+        real_state, depth = state
+        self._real._acquire_restore(real_state)
+        g = self._target()
+        if g is not None:
+            g._acquired(self, depth=depth)
+
+    def _is_owned(self) -> bool:
+        return self._real._is_owned()
+
+
+class LockGraph:
+    """Cross-thread lock-acquisition-order recorder; see module docstring.
+
+    >>> with LockGraph() as graph:
+    ...     run_the_workload()
+    >>> graph.assert_clean()   # no order cycles, no lock held across I/O
+    """
+
+    #: dotted names patched as known blocking calls while active
+    BLOCKING_PATCHES = (
+        ("http.client", "HTTPConnection", "getresponse"),
+    )
+
+    def __init__(self) -> None:
+        self._mu = _REAL_LOCK()  # internal; never instrumented
+        self.active = False
+        # paused = a nested graph is active on top of this one: edge and
+        # violation RECORDING stops, but held-stack bookkeeping must keep
+        # running — this graph's locks are still acquired/released inside
+        # the inner window, and a stale entry would produce phantom edges
+        # and false HELD-ACROSS-BLOCKING violations after resume.
+        self._paused = False
+        # held lock stacks per thread: ident -> [(site, lock_id, depth)]
+        self._held: Dict[int, List[List[Any]]] = {}
+        # (from_site, to_site) -> {"count": n, "thread": ..., "stack": ...}
+        self.edges: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.sites: Set[str] = set()
+        #: locks held across a blocking call: list of dicts
+        self.violations: List[Dict[str, Any]] = []
+        self._saved: List[Tuple[Any, str, Any]] = []
+        self._prev: Optional["LockGraph"] = None
+        self._saved_factories: Optional[Tuple[Any, Any]] = None
+
+    # ------------------------------------------------------------ patching
+    def __enter__(self) -> "LockGraph":
+        global _ACTIVE
+        # Graphs nest LIFO (an explicit LockGraph test inside a
+        # --lock-graph lane): the outer graph pauses — its locks stop
+        # recording edges/violations, though held bookkeeping continues —
+        # and resumes when the inner one exits.
+        self._prev = _ACTIVE
+        if self._prev is not None:
+            self._prev.active = False
+            self._prev._paused = True
+        self._saved_factories = (threading.Lock, threading.RLock)
+        _ACTIVE = self
+        self.active = True
+        graph = self
+
+        def make_lock():
+            site = _site()
+            if site is None:  # pure threading-internal plumbing
+                return _REAL_LOCK()
+            return _InstrumentedLock(_REAL_LOCK(), site, graph)
+
+        def make_rlock():
+            site = _site()
+            if site is None:
+                return _REAL_RLOCK()
+            return _InstrumentedRLock(_REAL_RLOCK(), site, graph)
+
+        threading.Lock = make_lock  # type: ignore[assignment]
+        threading.RLock = make_rlock  # type: ignore[assignment]
+        for mod_name, cls_name, fn_name in self.BLOCKING_PATCHES:
+            self._patch_blocking(mod_name, cls_name, fn_name)
+        if "jax" in sys.modules:  # never IMPORT jax for the router's sake
+            self._patch_blocking_fn(sys.modules["jax"], "device_get")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        self.active = False
+        self._paused = False  # done for good, not paused
+        _ACTIVE = self._prev
+        if self._prev is not None:
+            self._prev._paused = False
+            self._prev.active = True
+        self._prev = None
+        lock_f, rlock_f = self._saved_factories or (_REAL_LOCK, _REAL_RLOCK)
+        threading.Lock = lock_f  # type: ignore[assignment]
+        threading.RLock = rlock_f  # type: ignore[assignment]
+        self._saved_factories = None
+        for owner, name, orig in self._saved:
+            setattr(owner, name, orig)
+        self._saved.clear()
+
+    def _patch_blocking(
+        self, mod_name: str, cls_name: str, fn_name: str
+    ) -> None:
+        mod = sys.modules.get(mod_name)
+        if mod is None:
+            return
+        self._patch_blocking_fn(getattr(mod, cls_name), fn_name)
+
+    def _patch_blocking_fn(self, owner: Any, fn_name: str) -> None:
+        orig = getattr(owner, fn_name, None)
+        if orig is None:
+            return
+        graph = self
+        label = f"{getattr(owner, '__name__', owner)}.{fn_name}"
+
+        def wrapped(*args, **kw):
+            graph.check_blocking(label)
+            return orig(*args, **kw)
+
+        self._saved.append((owner, fn_name, orig))
+        setattr(owner, fn_name, wrapped)
+
+    # ----------------------------------------------------------- recording
+    def _acquired(
+        self, lock: _InstrumentedLock, depth: Optional[int] = None
+    ) -> None:
+        """Record an acquisition. ``depth`` (from an RLock
+        ``_acquire_restore``) seeds the entry's recursion count; a plain
+        acquire counts 1. While paused, only the held bookkeeping runs —
+        no new edges are recorded."""
+        if not (self.active or self._paused):
+            return
+        ident = threading.get_ident()
+        with self._mu:
+            stack = self._held.setdefault(ident, [])
+            for entry in stack:
+                if entry[1] == id(lock):  # reentrant re-acquire
+                    entry[2] += depth or 1
+                    return
+            new_edges = []
+            if self.active:
+                for held_site, _, _ in stack:
+                    if held_site != lock._site:
+                        key = (held_site, lock._site)
+                        e = self.edges.get(key)
+                        if e is None:
+                            new_edges.append(key)
+                        else:
+                            e["count"] += 1
+                self.sites.add(lock._site)
+            stack.append([lock._site, id(lock), depth or 1])
+        # Stack capture outside the mutex, first observation only (keeps
+        # the steady-state cost to dict ops).
+        for key in new_edges:
+            sample = {
+                "count": 1,
+                "thread": threading.current_thread().name,
+                "stack": "".join(traceback.format_stack(limit=6)[:-1]),
+            }
+            with self._mu:
+                self.edges.setdefault(key, sample)
+
+    def _released(
+        self, lock: _InstrumentedLock, fully: bool = False
+    ) -> Optional[int]:
+        """Record a release; with ``fully`` pop the whole entry and
+        return the recursion depth it held (so an RLock
+        ``_release_save``/``_acquire_restore`` round-trip preserves it).
+        Runs while paused too — see :meth:`_acquired`."""
+        if not (self.active or self._paused):
+            return None
+        ident = threading.get_ident()
+        with self._mu:
+            # Fast path: the releaser is the holder (>99% of releases).
+            # But a primitive Lock may legally be released by ANOTHER
+            # thread (the one-shot handoff idiom) — on a miss, fall back
+            # to scanning the other threads' stacks, or the entry would
+            # sit stale and poison that thread's ordering edges /
+            # blocking checks for the rest of the run.
+            own = self._held.get(ident)
+            hit = self._pop_entry(own, lock, fully) if own else None
+            if hit is None:
+                for i, stack in self._held.items():
+                    if i == ident:
+                        continue
+                    hit = self._pop_entry(stack, lock, fully)
+                    if hit is not None:
+                        break
+            if hit is not None:
+                return hit if fully else None
+        return None
+
+    @staticmethod
+    def _pop_entry(
+        stack: List[List[Any]], lock: "_InstrumentedLock", fully: bool
+    ) -> Optional[int]:
+        """Decrement (or with ``fully`` remove) the stack's entry for
+        ``lock``; return the pre-release depth, None when absent."""
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][1] == id(lock):
+                depth = stack[i][2]
+                stack[i][2] = 0 if fully else stack[i][2] - 1
+                if stack[i][2] <= 0:
+                    stack.pop(i)
+                return depth
+        return None
+
+    def check_blocking(self, label: str) -> None:
+        """Record a violation if the calling thread holds any instrumented
+        lock right now. Public so subsystems can declare their own
+        blocking boundaries (e.g. a batcher's model forward)."""
+        if not self.active:
+            return
+        ident = threading.get_ident()
+        with self._mu:
+            held = [s for s, _, _ in self._held.get(ident, [])]
+        if held:
+            self.violations.append(
+                {
+                    "blocking": label,
+                    "held": held,
+                    "thread": threading.current_thread().name,
+                    "stack": "".join(traceback.format_stack(limit=8)[:-2]),
+                }
+            )
+
+    # ------------------------------------------------------------- queries
+    def cycles(self) -> List[List[str]]:
+        """Site cycles in the acquisition-order graph (each reported once,
+        rotated to start at its smallest site)."""
+        adj: Dict[str, Set[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, set()).add(b)
+        out: List[List[str]] = []
+        seen: Set[Tuple[str, ...]] = set()
+
+        def dfs(start: str, node: str, path: List[str]) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    i = path.index(min(path))
+                    canon = tuple(path[i:] + path[:i])
+                    if canon not in seen:
+                        seen.add(canon)
+                        out.append(list(canon))
+                elif nxt not in path and nxt > start:
+                    # only walk nodes > start: each cycle is found from
+                    # its smallest member exactly once
+                    dfs(start, nxt, path + [nxt])
+
+        for site in sorted(adj):
+            dfs(site, site, [site])
+        return out
+
+    def report(self) -> str:
+        lines = [
+            f"lock graph: {len(self.sites)} site(s), "
+            f"{len(self.edges)} order edge(s)"
+        ]
+        for cyc in self.cycles():
+            lines.append(
+                "  CYCLE (potential deadlock): " + " -> ".join(cyc + cyc[:1])
+            )
+            for a, b in zip(cyc, cyc[1:] + cyc[:1]):
+                e = self.edges.get((a, b))
+                if e:
+                    lines.append(
+                        f"    {a} -> {b} x{e['count']} "
+                        f"(thread {e['thread']})"
+                    )
+        for v in self.violations:
+            lines.append(
+                f"  HELD-ACROSS-BLOCKING: {v['held']} held during "
+                f"{v['blocking']} (thread {v['thread']})"
+            )
+        return "\n".join(lines)
+
+    def assert_clean(self) -> None:
+        cycles = self.cycles()
+        if cycles or self.violations:
+            raise AssertionError(
+                "lock-order audit failed:\n" + self.report()
+                + "\n(fix the ordering, or release the lock before the "
+                "blocking call — see docs/STATIC_ANALYSIS.md)"
+            )
+
+
+def active_graph() -> Optional[LockGraph]:
+    """The currently active LockGraph (None outside a --lock-graph run) —
+    the hook for subsystems declaring custom blocking boundaries."""
+    return _ACTIVE
