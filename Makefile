@@ -67,11 +67,17 @@ chaos:
 obs-smoke:
 	JAX_PLATFORMS=cpu python tools/obs_smoke.py
 
-# Checkpoint-free serving smoke: warm-compile, micro-batch 24 requests,
-# print a BENCH-style latency/throughput/fill-ratio JSON line.
+# Checkpoint-free serving smoke: warm-compile (AOT), micro-batch 24
+# single-task requests, then a multi-task fan-out pass — 12 requests
+# against a shared-trunk seist_s group (dpk+emg+dis on ONE trunk run per
+# trace); bench_serve exits non-zero unless EVERY response answered ALL
+# requested heads (fanout_complete). Each prints a BENCH-style JSON line.
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/bench_serve.py --model-name phasenet \
 		--window 256 --requests 24 --concurrency 6 --max-batch 4
+	JAX_PLATFORMS=cpu python tools/bench_serve.py --model-name seist_s \
+		--tasks dpk,emg,dis --window 256 --requests 12 --concurrency 4 \
+		--max-batch 4
 
 # Serving chaos lane (docs/FAULT_TOLERANCE.md "Serving faults"): real
 # replica subprocesses under SEIST_FAULT_SERVE_* — SIGKILL-mid-load with

@@ -772,7 +772,46 @@ class SeismogramTransformer(nn.Module):
     head_scale: float = 1.0
 
     @nn.compact
-    def __call__(self, x: Array, train: bool = False) -> Array:
+    def __call__(
+        self,
+        x: Array,
+        train: bool = False,
+        *,
+        mode: str = "full",
+        features: Optional[Array] = None,
+    ) -> Array:
+        """Forward pass, optionally split at the trunk/head boundary.
+
+        ``mode`` selects what runs (a static Python switch — jit callers
+        close over it):
+
+        * ``'full'`` (default) — stem + stages + task head, byte-identical
+          to the pre-split behavior.
+        * ``'backbone'`` — stem + stages only; returns the (N, L/64, C')
+          trunk features every task head consumes. The trunk is the ~90%
+          of serving FLOPs the paper's five task heads share — the serve
+          pool runs it ONCE per trace and fans out (serve/pool.py).
+        * ``'head'`` — task head only; ``features`` is a trunk output and
+          ``x`` is the ORIGINAL model input (the dpk upsampling ladder
+          needs its length to rebuild full resolution).
+
+        The param tree is identical in all modes (all submodules carry
+        explicit names), so one checkpoint serves all three; head-only
+        application simply never reads the trunk leaves.
+        """
+        if mode not in ("full", "backbone", "head"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if mode == "head":
+            if features is None:
+                raise ValueError("mode='head' requires features")
+            return self._head(features, x, train)
+        feats = self._backbone(x, train)
+        if mode == "backbone":
+            return feats
+        return self._head(feats, x, train)
+
+    def _backbone(self, x: Array, train: bool) -> Array:
+        """Stem + 4 stages — the shared trunk (ref: seist.py:686-770)."""
         assert (
             len(self.stem_channels)
             == len(self.stem_kernel_sizes)
@@ -786,8 +825,6 @@ class SeismogramTransformer(nn.Module):
             == len(self.attn_blocks)
             == len(self.head_dims)
         )
-
-        x_input = x
 
         # Stem: 4 StemBlocks, strides [2,1,1,2] => L/4 (ref: seist.py:686-703)
         stem_in = [self.in_channels] + list(self.stem_channels[:-1])
@@ -854,7 +891,9 @@ class SeismogramTransformer(nn.Module):
                 x = nn.remat(stage_fn, static_argnums=(2,))(self, x, train)
             else:
                 x = stage_fn(self, x, train)
+        return x
 
+    def _head(self, x: Array, x_input: Array, train: bool) -> Array:
         # Output head (ref: seist.py:773-812)
         if self.head_type == "dpk":
             out_layer_channels = []
@@ -891,6 +930,31 @@ class SeismogramTransformer(nn.Module):
                 out_act=lambda v: nn.sigmoid(v) * scale, name="out_head"
             )(x, x_input, train)
         raise NotImplementedError(f"Unknown head_type '{self.head_type}'")
+
+
+# ------------------------------------------------------- trunk/head split API
+def supports_trunk_split(model: Any) -> bool:
+    """True when ``model`` exposes the backbone/head apply modes (the
+    SeisT family); other registered models (phasenet, eqtransformer, ...)
+    are single-task and serve through the plain forward."""
+    return isinstance(model, SeismogramTransformer)
+
+
+def backbone_apply(model: Any, variables: Any, x: Array) -> Array:
+    """Run ONLY the shared trunk (stem + stages): (N, L, C) waveforms ->
+    (N, L/64, C') features. Inference-mode (train=False), jittable."""
+    return model.apply(variables, x, train=False, mode="backbone")
+
+
+def head_apply(model: Any, variables: Any, features: Array, x_input: Array) -> Array:
+    """Run ONLY the task head on trunk ``features``. ``x_input`` is the
+    original waveform batch — the dpk upsampling ladder reads its length
+    (never its values) to rebuild full-resolution picks; cls/reg heads
+    ignore it. Head-only application reads just the ``out_head`` subtree
+    of ``variables``; unused trunk leaves are ignored by flax."""
+    return model.apply(
+        variables, x_input, train=False, mode="head", features=features
+    )
 
 
 # ---------------------------------------------------------------- size presets

@@ -169,9 +169,16 @@ def annotate(
         pad = batch_size - chunk.shape[0]
         if pad:  # keep ONE compiled shape
             chunk = np.concatenate([chunk, chunk[-1:].repeat(pad, 0)], axis=0)
-        out = np.asarray(jit_apply(jnp.asarray(chunk)))
-        probs.append(out[: batch_size - pad if pad else batch_size])
-    probs_arr = jnp.asarray(np.concatenate(probs, axis=0))
+        # Stay on device: the per-chunk np.asarray readback this loop once
+        # did cost one host sync PER CHUNK (the last jaxlint baseline
+        # entry); outputs now accumulate as device arrays (the unpad slice
+        # is a device op) and everything downstream of the concatenate —
+        # stitch, pick, detect — consumes them device-side in one program
+        # chain, with the single host transfer happening at the final
+        # pick/detect np.asarray calls below.
+        out = jit_apply(jnp.asarray(chunk))
+        probs.append(out[: batch_size - pad] if pad else out)
+    probs_arr = jnp.concatenate(probs, axis=0)
 
     invert0 = channel0 == "non"
     if combine == "max" and invert0:

@@ -4,7 +4,10 @@ tier. See docs/SERVING.md.
 
     seist_tpu.serve.protocol   wire format + error taxonomy (HTTP statuses)
     seist_tpu.serve.batcher    request coalescing, backpressure, deadlines
-    seist_tpu.serve.pool       model loading + per-bucket warm-up + decode
+    seist_tpu.serve.pool       model loading, shared-trunk task groups,
+                               AOT warm-up, output decode
+    seist_tpu.serve.aot        AOT-compiled executables + bf16/int8
+                               quantized variants (parity-gated)
     seist_tpu.serve.shed       priority tiers + queue-delay load shedding
     seist_tpu.serve.server     ServeService core + HTTP shim + `serve` CLI
     seist_tpu.serve.router     front-tier router: health-checked replica

@@ -1,0 +1,273 @@
+"""AOT-compiled serving executables + quantized weight variants.
+
+Live ``jax.jit`` compiles lazily on first call — which for a serving
+replica means the first customer request after every relaunch pays a
+multi-second XLA compile (the "compile storm" cold-start the ROADMAP
+names). This module moves every request-path compile to replica LOAD
+time using jax's ahead-of-time API (the ``tools/check_attn_tpu.py``
+technique)::
+
+    compiled = jax.jit(fn).lower(*shape_structs).compile()
+
+``compiled`` is shape-specialized: calling it with matching (shape,
+dtype) arguments executes the XLA program directly — no tracing, no
+cache lookup through jit machinery, nothing that can compile on the
+request path. The pool warm-up builds one executable per (warm bucket
+shape x program) combination; after warm-up a ``CompileBudget`` window
+over a request storm records zero traces (tests/test_multitask.py).
+
+Programs per entry:
+
+* single-task models: the full forward per bucket;
+* SeisT task groups (serve/pool.py): the shared TRUNK per bucket plus
+  each task HEAD per bucket — the fan-out path runs trunk once and
+  dispatches the requested heads on its features.
+
+Quantized variants (``options.variant``): each program is additionally
+built per enabled variant —
+
+* ``fp32`` — the checkpoint as restored (default, always on);
+* ``bf16`` — params + activations cast to bfloat16, outputs cast back
+  to float32 (half the HBM traffic; on TPU the MXU's native dtype);
+* ``int8`` — weight-only quantization: >=2-D float params stored as
+  int8 with a per-out-channel scale and dequantized on the fly inside
+  the program (weights at rest are 4x smaller than fp32).
+
+Variants are parity-GATED at load (:func:`variant_parity`): a variant
+whose probe outputs diverge from fp32 beyond decision-level tolerance
+(argmax flips for classifiers/pickers, scaled error for regression) is
+disabled for that task rather than served wrong.
+
+Compile cost is published as the ``serve_aot_compile_ms`` gauge (per
+model, cumulative) plus a ``serve_aot_programs`` gauge on the obs bus.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+# ONE source of truth for the variant names: the wire contract
+# (protocol.py is stdlib+numpy only, so this adds no import weight and
+# the two layers cannot drift).
+from seist_tpu.serve.protocol import VARIANTS  # noqa: F401  (re-export)
+
+#: Decision-level parity tolerances per variant (see variant_parity).
+#: bf16 rounds weights+activations to 8 mantissa bits (~4e-3 relative);
+#: int8 weight-only is coarser. Probability outputs compare absolutely,
+#: VALUE outputs relative to the head's output scale.
+_PARITY_TOL = {
+    "bf16": {"abs": 0.02, "rel": 0.01, "argmax_frac": 0.005},
+    "int8": {"abs": 0.05, "rel": 0.02, "argmax_frac": 0.01},
+}
+
+
+@dataclass
+class AotProgram:
+    """One compiled executable + its load-time metadata."""
+
+    key: str  # e.g. "seist_s/trunk/b4/bf16"
+    compiled: Any  # jax.stages.Compiled
+    compile_ms: float
+    flops: float  # XLA cost_analysis FLOPs (0.0 when unavailable)
+
+    def __call__(self, *args):
+        return self.compiled(*args)
+
+
+def compiled_flops(compiled: Any) -> float:
+    """FLOPs from the executable's XLA cost analysis — the number the
+    multi-task acceptance test sums (a 3-task fan-out must cost <= 0.5x
+    three single-task calls). 0.0 when the backend doesn't report."""
+    try:
+        ca = compiled.cost_analysis()
+        entry = ca[0] if isinstance(ca, (list, tuple)) else ca
+        return float(entry.get("flops", 0.0) or 0.0)
+    except Exception:  # noqa: BLE001 - optional metadata, never fatal
+        return 0.0
+
+
+def aot_compile(
+    key: str,
+    fn: Callable[..., Any],
+    arg_shapes: Sequence[Tuple[Tuple[int, ...], Any]],
+    *,
+    model: str = "",
+) -> AotProgram:
+    """lower+compile ``fn`` at the given (shape, dtype) signature.
+
+    ``arg_shapes`` is a sequence of (shape tuple, dtype) pairs — one per
+    positional argument. Publishes cumulative compile time on the
+    ``serve_aot_compile_ms{model=}`` gauge."""
+    import jax
+
+    from seist_tpu.obs.bus import BUS
+
+    structs = [
+        jax.ShapeDtypeStruct(shape, dtype) for shape, dtype in arg_shapes
+    ]
+    t0 = time.monotonic()
+    compiled = jax.jit(fn).lower(*structs).compile()
+    ms = (time.monotonic() - t0) * 1e3
+    BUS.gauge("serve_aot_compile_ms", model=model or key).inc(ms)
+    BUS.gauge("serve_aot_programs", model=model or key).inc(1)
+    return AotProgram(
+        key=key, compiled=compiled, compile_ms=ms,
+        flops=compiled_flops(compiled),
+    )
+
+
+# ------------------------------------------------------------------ variants
+def _is_float(leaf: Any) -> bool:
+    dt = getattr(leaf, "dtype", None)
+    if dt is None:
+        return False
+    import jax.numpy as jnp
+
+    # jnp's lattice, not numpy's: bfloat16 (ml_dtypes) is NOT a subtype
+    # of np.floating, and outputs_to_f32 must catch it.
+    return bool(jnp.issubdtype(dt, jnp.floating))
+
+
+def cast_variables(variables: Any, dtype: Any) -> Any:
+    """Cast every floating leaf (params AND batch stats) to ``dtype``."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype) if _is_float(a) else a, variables
+    )
+
+
+_INT8_MARK = "__int8__"
+
+
+def quantize_int8(variables: Any) -> Any:
+    """Weight-only int8: every >=2-D floating leaf becomes
+    ``{__int8__: q int8, scale f32}`` with a per-out-channel (last axis)
+    symmetric scale; 1-D leaves (biases, norm scales, BN stats) stay
+    fp32 — they are tiny and precision-critical."""
+    import jax.numpy as jnp
+
+    def pack(tree: Any) -> Any:
+        if isinstance(tree, Mapping):
+            return {k: pack(v) for k, v in tree.items()}
+        if _is_float(tree) and getattr(tree, "ndim", 0) >= 2:
+            axes = tuple(range(tree.ndim - 1))
+            w = jnp.asarray(tree, jnp.float32)
+            scale = jnp.maximum(jnp.max(jnp.abs(w), axis=axes), 1e-8) / 127.0
+            q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+            return {_INT8_MARK: q, "scale": scale}
+        return tree
+
+    return pack(variables)
+
+
+def dequantize(tree: Any) -> Any:
+    """Inverse of :func:`quantize_int8`, run INSIDE the traced program so
+    the executable's weights stay int8 in device memory and widen to
+    fp32 only as they stream into the matmuls (weight-only quant)."""
+    import jax.numpy as jnp
+
+    if isinstance(tree, Mapping):
+        if _INT8_MARK in tree:
+            return tree[_INT8_MARK].astype(jnp.float32) * tree["scale"]
+        return {k: dequantize(v) for k, v in tree.items()}
+    return tree
+
+
+def outputs_to_f32(out: Any) -> Any:
+    """Cast every floating leaf of a program's outputs to float32 so
+    decode paths are variant-blind (bf16 trunk features stay bf16 — this
+    is for FINAL outputs only)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.float32) if _is_float(a) else a, out
+    )
+
+
+def make_variant_apply(
+    apply_fn: Callable[[Any, Any], Any],
+    variables: Any,
+    variant: str,
+    *,
+    cast_outputs: bool = True,
+) -> Callable[[Any], Any]:
+    """-> ``fn(x) -> outputs`` computing ``apply_fn(variables', x)`` under
+    the variant's weight/compute dtype, with float outputs cast back to
+    float32 so decode paths are variant-blind (``cast_outputs=False``
+    for INTERIOR programs — a bf16 trunk hands bf16 features to bf16
+    heads, casting in between would forfeit the bandwidth win). Weight
+    transforms run HERE, eagerly — the traced program holds bf16/int8
+    weights at rest, it does not re-derive them per call.
+
+    ``apply_fn(variables, x)`` is the raw two-arg model apply."""
+    import jax.numpy as jnp
+
+    out = outputs_to_f32 if cast_outputs else (lambda o: o)
+    if variant == "fp32":
+        return lambda x: apply_fn(variables, x)
+    if variant == "bf16":
+        vb = cast_variables(variables, jnp.bfloat16)
+
+        def bf16_fn(x):
+            return out(apply_fn(vb, x.astype(jnp.bfloat16)))
+
+        return bf16_fn
+    if variant == "int8":
+        packed = quantize_int8(variables)
+
+        def int8_fn(x):
+            return out(apply_fn(dequantize(packed), x))
+
+        return int8_fn
+    raise ValueError(f"unknown variant {variant!r} (use one of {VARIANTS})")
+
+
+# -------------------------------------------------------------- parity gate
+def variant_parity(
+    fp32_out: Any, variant_out: Any, variant: str, *, kind: str,
+    scale: float = 1.0,
+) -> Tuple[bool, float]:
+    """Decision-level parity of a variant's probe outputs against fp32.
+
+    ``kind``: ``'soft'`` (per-sample probability channels — pickers;
+    compare absolutely AND require the post-argmax channel decision to
+    match on all but a tiny near-tie fraction), ``'onehot'`` (classifier
+    — argmax must be identical), ``'value'`` (regression — error
+    relative to the head's output ``scale``). Returns (ok, err)."""
+    tol = _PARITY_TOL[variant]
+    a = np.asarray(fp32_out, np.float32)
+    b = np.asarray(variant_out, np.float32)
+    if kind == "onehot":
+        ok = bool(np.array_equal(np.argmax(a, -1), np.argmax(b, -1)))
+        return ok, float(np.max(np.abs(a - b)))
+    if kind == "value":
+        err = float(np.max(np.abs(a - b))) / max(scale, 1e-8)
+        return err <= tol["rel"], err
+    # soft: dense per-sample probabilities
+    err = float(np.max(np.abs(a - b)))
+    flips = float(np.mean(np.argmax(a, -1) != np.argmax(b, -1)))
+    return err <= tol["abs"] and flips <= tol["argmax_frac"], err
+
+
+def parity_kind(spec: Any) -> Tuple[str, float]:
+    """Map a taskspec to the parity-gate comparison (kind, scale)."""
+    from seist_tpu import taskspec
+
+    names = [
+        n for group in spec.labels
+        for n in (group if isinstance(group, (tuple, list)) else [group])
+    ]
+    kinds = {
+        taskspec.get_kind(n) for n in names if n in taskspec.IO_ITEMS
+    }
+    if kinds == {taskspec.VALUE}:
+        return "value", 1.0
+    if kinds == {taskspec.ONEHOT}:
+        return "onehot", 1.0
+    return "soft", 1.0

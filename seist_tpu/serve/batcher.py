@@ -94,9 +94,15 @@ class BatcherConfig:
 
 class _Pending:
     __slots__ = ("x", "enqueued_at", "deadline", "event", "result", "error",
-                 "abandoned", "rank")
+                 "abandoned", "rank", "tasks")
 
-    def __init__(self, x: np.ndarray, deadline: float, rank: int = 1):
+    def __init__(
+        self,
+        x: np.ndarray,
+        deadline: float,
+        rank: int = 1,
+        tasks: Optional[frozenset] = None,
+    ):
         self.x = x
         self.enqueued_at = time.monotonic()
         self.deadline = deadline
@@ -105,6 +111,7 @@ class _Pending:
         self.error: Optional[BaseException] = None
         self.abandoned = False  # caller gave up; skip at flush time
         self.rank = rank  # flush order: lower rank first, FIFO within
+        self.tasks = tasks  # multi-task fan-out: heads this caller wants
 
 
 class MicroBatcher:
@@ -164,7 +171,11 @@ class MicroBatcher:
 
     # ------------------------------------------------------------- submit
     def submit(
-        self, x: np.ndarray, timeout_ms: float = 5000.0, rank: int = 1
+        self,
+        x: np.ndarray,
+        timeout_ms: float = 5000.0,
+        rank: int = 1,
+        tasks: Optional[frozenset] = None,
     ) -> Any:
         """Block until the trace's batch is served; returns the per-item
         output slice. Raises QueueFull / DeadlineExceeded / ShuttingDown.
@@ -176,10 +187,18 @@ class MicroBatcher:
         bounds how much low-tier work gets in, and rank ordering keeps
         whatever *was* admitted from standing ahead of an alert, so a
         high-tier request waits at most the in-flight flush plus its own
-        tier's queue regardless of box speed or backlog."""
+        tier's queue regardless of box speed or backlog.
+
+        ``tasks`` (multi-task groups only) names the heads this caller
+        wants. Requests batch by TRUNK INPUT SHAPE, not by task: a flush
+        runs the shared trunk once and fans out to the UNION of its
+        items' tasks — the forward is then called ``forward(batch,
+        tasks)`` and must return ``{task: outputs}``; each caller's
+        slice keeps every task in the union (decode picks its own)."""
         t0 = time.monotonic()
         item = _Pending(
-            np.asarray(x), deadline=t0 + timeout_ms / 1000.0, rank=rank
+            np.asarray(x), deadline=t0 + timeout_ms / 1000.0, rank=rank,
+            tasks=tasks,
         )
         with self._cond:
             if self._fatal is not None:
@@ -301,9 +320,20 @@ class MicroBatcher:
             batch = np.concatenate(
                 [batch, np.repeat(batch[-1:], bucket - n, axis=0)], axis=0
             )
+        # Multi-task fan-out: the flush serves the UNION of its items'
+        # requested heads (trunk once; an extra head is ~10% of a trunk,
+        # re-running the trunk per distinct task subset would cost 10x).
+        task_sets = [item.tasks for item in live if item.tasks is not None]
+        union: Optional[frozenset] = (
+            frozenset().union(*task_sets) if task_sets else None
+        )
         t_fwd0 = time.monotonic()
         try:
-            out = self._forward(batch)
+            out = (
+                self._forward(batch)
+                if union is None
+                else self._forward(batch, union)
+            )
         except Exception as e:  # noqa: BLE001 — must not kill the worker
             err = e if isinstance(e, ServeError) else ServeError(
                 f"forward failed: {e!r}"
@@ -318,11 +348,8 @@ class MicroBatcher:
         # Materialize device output ONCE per flush; per-item slicing below
         # then works on host arrays (np.asarray on ndarray is a no-op) —
         # without this, every item would pull the full batch across the
-        # device boundary again.
-        if isinstance(out, (tuple, list)):
-            out = type(out)(np.asarray(o) for o in out)
-        else:
-            out = np.asarray(out)
+        # device boundary again. Multi-task forwards return {task: out}.
+        out = _materialize(out)
         flush_ms = (time.monotonic() - t_fwd0) * 1e3
         with self._cond:
             self._forwards += 1
@@ -414,10 +441,24 @@ class MicroBatcher:
             }
 
 
+def _materialize(out: Any) -> Any:
+    """Device -> host, preserving structure (array, tuple/list of arrays,
+    or a multi-task ``{task: ...}`` dict thereof)."""
+    if isinstance(out, dict):
+        return {k: _materialize(v) for k, v in out.items()}
+    if isinstance(out, (tuple, list)):
+        return type(out)(np.asarray(o) for o in out)
+    return np.asarray(out)
+
+
 def _slice_outputs(out: Any, i: int) -> Any:
-    """Per-item slice (keeping a leading dim of 1) of an array or a
-    tuple/list of arrays — mirrors model outputs: dpk heads return one
-    (B, L, 3) array, ditingmotion returns a tuple of two (B, classes)."""
+    """Per-item slice (keeping a leading dim of 1) of an array, a
+    tuple/list of arrays, or a multi-task ``{task: ...}`` dict — mirrors
+    model outputs: dpk heads return one (B, L, 3) array, ditingmotion
+    returns a tuple of two (B, classes), a group fan-out returns a dict
+    of per-task outputs."""
+    if isinstance(out, dict):
+        return {k: _slice_outputs(v, i) for k, v in out.items()}
     if isinstance(out, (tuple, list)):
         return type(out)(np.asarray(o)[i : i + 1] for o in out)
     return np.asarray(out)[i : i + 1]

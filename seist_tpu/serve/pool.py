@@ -1,12 +1,23 @@
-"""Model pool: load ``(model_name, checkpoint)`` pairs, pre-compile every
-serving shape, decode per-task outputs.
+"""Model pool: load servable entries, AOT-compile every serving shape,
+decode per-task outputs.
 
-The pool owns exactly one jitted forward per model — a closure over the
-restored variables, so jax's compile cache keys only on the input shape.
-``warmup()`` runs that forward once per batch bucket (and once through the
-default postprocess) before the server accepts traffic: the t5x/seqio
-lesson (PAPERS.md) that a service must pay all its XLA compiles at
-startup, never on a customer request.
+Two kinds of entry:
+
+* :class:`ModelEntry` — one single-task model (phasenet, eqtransformer,
+  any registered name): the PR 1 shape, unchanged on the wire.
+* :class:`MultiTaskEntry` — one SeisT task GROUP (e.g. ``seist_s`` =
+  dpk+emg+dis): ONE shared trunk (models/seist.py ``mode='backbone'``)
+  plus per-task heads. A multi-task request runs the trunk ONCE per
+  trace and fans its features out to every requested head — the ~90%
+  FLOPs the paper's five heads share is paid once instead of per task.
+
+``warmup()`` AOT-compiles (serve/aot.py: ``jax.jit(fn).lower().compile()``)
+every warm bucket shape x program x enabled variant before the server
+accepts traffic — the t5x/seqio lesson (PAPERS.md) that a service must
+pay all its XLA compiles at startup, never on a customer request, now
+enforced by construction: the request path calls shape-specialized
+executables that cannot trace. Quantized variants (bf16 / int8
+weight-only) are parity-gated at load against fp32.
 
 ``load_model_entry`` is also the single checkpoint-loading path for
 offline tools (tools/predict.py) — loader logic lives here exactly once.
@@ -14,11 +25,13 @@ offline tools (tools/predict.py) — loader logic lives here exactly once.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import threading
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from seist_tpu.serve import aot
 from seist_tpu.serve.batcher import _slice_outputs
 from seist_tpu.serve.protocol import (
     BadRequest,
@@ -28,40 +41,17 @@ from seist_tpu.serve.protocol import (
 )
 from seist_tpu.utils.logger import logger
 
-
-@dataclass
-class ModelEntry:
-    """One servable model: everything needed to forward + decode."""
-
-    name: str
-    model: Any
-    variables: Dict[str, Any]
-    spec: Any  # taskspec.TaskSpec
-    window: int
-    in_channels: int
-    channel0: Optional[str]  # 'non'/'det' for picking heads, else None
-    forward: Callable[[Any], Any]  # jitted, (B, window, C) -> outputs
-    apply: Callable[[Any], Any]  # same, unjitted (for jax.jit composition)
-
-    @property
-    def is_picker(self) -> bool:
-        return self.channel0 is not None
+#: The five SeisT task heads (PAPER.md): detection+picking, first-motion
+#: polarity, magnitude, back-azimuth, epicentral distance. A task group
+#: ``<prefix>`` serves ``<prefix>_<task>`` heads on one shared trunk.
+TASKS = ("dpk", "pmp", "emg", "baz", "dis")
 
 
-def load_model_entry(
-    model_name: str,
-    checkpoint: str = "",
-    *,
-    window: int = 8192,
-    seed: int = 0,
-) -> ModelEntry:
-    """Create + restore one model for inference.
-
-    Without ``checkpoint`` the model serves freshly-initialized weights
-    (tests / smoke runs); with one, params (+ BN stats when present) are
-    restored the same way demo_predict.py and tools/predict.py always did
-    — that logic now lives only here.
-    """
+def _load_parts(
+    model_name: str, checkpoint: str, *, window: int, seed: int
+) -> Tuple[Any, Dict[str, Any], Any, int, Optional[str]]:
+    """Create + restore one model: (model, variables, spec, in_channels,
+    channel0). The shared loader behind single entries AND group heads."""
     import seist_tpu
     from seist_tpu import taskspec
     from seist_tpu.models import api
@@ -92,6 +82,487 @@ def load_model_entry(
         and tuple(first)[0] in ("non", "det")
         else None
     )
+    return model, variables, spec, in_channels, channel0
+
+
+@dataclass
+class ModelEntry:
+    """One servable single-task model: everything needed to forward +
+    decode. After ``warmup`` the request path dispatches to AOT
+    executables via :meth:`run`; ``forward`` (live jit) stays as the
+    pre-warm / odd-shape fallback and the offline-tools entry point."""
+
+    name: str
+    model: Any
+    variables: Dict[str, Any]
+    spec: Any  # taskspec.TaskSpec
+    window: int
+    in_channels: int
+    channel0: Optional[str]  # 'non'/'det' for picking heads, else None
+    forward: Callable[[Any], Any]  # jitted, (B, window, C) -> outputs
+    apply: Callable[[Any], Any]  # same, unjitted (for jax.jit composition)
+    variants: Tuple[str, ...] = ("fp32",)
+    # variant -> bucket -> AotProgram (filled by build_programs)
+    programs: Dict[str, Dict[int, aot.AotProgram]] = field(
+        default_factory=dict
+    )
+    # variant -> parity-gate verdict (fp32 implicitly True)
+    variant_ok: Dict[str, bool] = field(default_factory=dict)
+    _fallbacks: Dict[str, Callable] = field(default_factory=dict)
+    _flock: threading.Lock = field(default_factory=threading.Lock)
+
+    @property
+    def is_picker(self) -> bool:
+        return self.channel0 is not None
+
+    @property
+    def is_group(self) -> bool:
+        return False
+
+    def resolve_tasks(self, tasks: Optional[Sequence[str]]) -> None:
+        if tasks is not None:
+            raise BadRequest(
+                f"model '{self.name}' is single-task; 'tasks' is only "
+                "valid for multi-task groups (serve --model-group)"
+            )
+        return None
+
+    def supported_variants(
+        self, tasks: Optional[Sequence[str]] = None
+    ) -> List[str]:
+        return ["fp32"] + [
+            v for v in self.variants
+            if v != "fp32" and self.variant_ok.get(v)
+        ]
+
+    def _fallback(self, variant: str) -> Callable[[Any], Any]:
+        """Live-jitted per-variant forward: serves requests that arrive
+        before warm-up finished (readiness is advisory) or at shapes no
+        program was built for. fp32 reuses the entry's own jit."""
+        if variant == "fp32":
+            return self.forward
+        with self._flock:
+            fn = self._fallbacks.get(variant)
+            if fn is None:
+                import jax
+
+                fn = jax.jit(
+                    aot.make_variant_apply(
+                        lambda v, x: self.model.apply(v, x, train=False),
+                        self.variables,
+                        variant,
+                    )
+                )
+                self._fallbacks[variant] = fn
+            return fn
+
+    def run(self, batch: np.ndarray, variant: str = "fp32") -> Any:
+        """The request-path forward: AOT executable when one matches the
+        batch shape (zero tracing), live-jit fallback otherwise."""
+        prog = self.programs.get(variant, {}).get(int(batch.shape[0]))
+        if prog is not None:
+            return prog(batch)
+        import jax.numpy as jnp
+
+        return self._fallback(variant)(jnp.asarray(batch))
+
+    # ------------------------------------------------------------ warm-up
+    def build_programs(
+        self, buckets: Sequence[int], report: List[Dict[str, Any]]
+    ) -> None:
+        import jax.numpy as jnp
+
+        apply2 = lambda v, x: self.model.apply(v, x, train=False)  # noqa: E731
+        shape = lambda b: [((b, self.window, self.in_channels), jnp.float32)]  # noqa: E731
+        for variant in self.variants:
+            fn = aot.make_variant_apply(apply2, self.variables, variant)
+            progs = self.programs.setdefault(variant, {})
+            for b in buckets:
+                prog = aot.aot_compile(
+                    f"{self.name}/full/b{b}/{variant}", fn, shape(b),
+                    model=self.name,
+                )
+                progs[b] = prog
+                report.append({
+                    "model": self.name, "batch": b, "variant": variant,
+                    "seconds": prog.compile_ms / 1e3, "program": prog.key,
+                })
+                logger.info(
+                    f"[serve] aot {prog.key} ({prog.compile_ms:.0f} ms, "
+                    f"{prog.flops:.3g} flops)"
+                )
+        self._gate_variants(buckets[0])
+
+    def _gate_variants(self, probe_bucket: int) -> None:
+        if all(v == "fp32" for v in self.variants):
+            return
+        probe = _probe_input(probe_bucket, self.window, self.in_channels)
+        ref = np.asarray(
+            _first_leaf(self.run(probe, "fp32")), np.float32
+        )
+        kind, _ = aot.parity_kind(self.spec)
+        scale = float(getattr(self.model, "head_scale", 1.0) or 1.0)
+        for variant in self.variants:
+            if variant == "fp32":
+                continue
+            # jaxlint: disable=host-sync-hot-path -- one-shot load-time
+            # parity gate (one probe per variant), not a request path
+            out = np.asarray(
+                _first_leaf(self.run(probe, variant)), np.float32
+            )
+            ok, err = aot.variant_parity(
+                ref, out, variant, kind=kind, scale=scale
+            )
+            self.variant_ok[variant] = ok
+            logger.info(
+                f"[serve] variant gate {self.name}/{variant}: "
+                f"{'ok' if ok else 'DISABLED'} (err={err:.2g}, {kind})"
+            )
+
+
+@dataclass
+class TaskHead:
+    """One task head of a group: duck-types the slice of ModelEntry that
+    ``decode_outputs`` reads (name/spec/channel0/is_picker)."""
+
+    task: str
+    name: str  # underlying model name, e.g. seist_s_dpk
+    model: Any
+    variables: Dict[str, Any]  # merged: shared trunk leaves + own head
+    spec: Any
+    channel0: Optional[str]
+    head_scale: float = 1.0
+
+    @property
+    def is_picker(self) -> bool:
+        return self.channel0 is not None
+
+
+@dataclass
+class MultiTaskEntry:
+    """One SeisT task group: shared trunk + per-task heads, fanned out.
+
+    ``fanout`` is the request-path forward: trunk ONCE on the batch,
+    then each requested head on the trunk features. Trunk weights are
+    the FIRST listed task's (heads share the arrays — one trunk in
+    memory regardless of head count). Counters: ``serve_trunk_runs``,
+    ``serve_head_runs{task=}`` and ``serve_trunk_flops_saved`` (the
+    amortized trunk FLOPs a per-task serving stack would have re-paid)
+    on the obs bus, mirrored in :meth:`fanout_stats`."""
+
+    name: str
+    window: int
+    in_channels: int
+    tasks: Tuple[str, ...]
+    heads: Dict[str, TaskHead]
+    trunk_model: Any
+    trunk_variables: Dict[str, Any]
+    variants: Tuple[str, ...] = ("fp32",)
+    # (variant, 'trunk'|task, bucket) -> AotProgram
+    programs: Dict[Tuple[str, str, int], aot.AotProgram] = field(
+        default_factory=dict
+    )
+    # variant -> tuple of parity-ok tasks (fp32 -> all)
+    variant_tasks: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    _fallbacks: Dict[Tuple[str, str], Callable] = field(default_factory=dict)
+    _flock: threading.Lock = field(default_factory=threading.Lock)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _trunk_runs: int = 0
+    _head_runs: Dict[str, int] = field(default_factory=dict)
+    _flops_saved: float = 0.0
+
+    def __post_init__(self):
+        self.variant_tasks.setdefault("fp32", tuple(self.tasks))
+
+    @property
+    def is_group(self) -> bool:
+        return True
+
+    @property
+    def is_picker(self) -> bool:
+        """/annotate support: the group can stream-pick iff it serves the
+        dpk head (channel0 comes from it)."""
+        return "dpk" in self.heads and self.heads["dpk"].is_picker
+
+    @property
+    def channel0(self) -> Optional[str]:
+        return self.heads["dpk"].channel0 if "dpk" in self.heads else None
+
+    @property
+    def spec(self) -> Any:
+        """A group has no single spec; decode goes through per-task
+        heads. Kept as an explicit error to catch misuse early."""
+        raise ServeError(
+            f"group '{self.name}' has per-task specs; decode via heads[task]"
+        )
+
+    # --------------------------------------------------------- resolution
+    def resolve_tasks(self, tasks: Optional[Sequence[str]]) -> Tuple[str, ...]:
+        if tasks is None:
+            return tuple(self.tasks)
+        unknown = [t for t in tasks if t not in self.heads]
+        if unknown:
+            raise BadRequest(
+                f"group '{self.name}' does not serve tasks {unknown}; "
+                f"available: {list(self.tasks)}"
+            )
+        return tuple(tasks)
+
+    def supported_variants(
+        self, tasks: Optional[Sequence[str]] = None
+    ) -> List[str]:
+        tasks = tuple(tasks) if tasks is not None else self.tasks
+        out = []
+        for v in self.variants:
+            ok = self.variant_tasks.get(v)
+            if ok is not None and all(t in ok for t in tasks):
+                out.append(v)
+        return out
+
+    # ------------------------------------------------------------ forward
+    def _fallback(self, kind: str, variant: str) -> Callable:
+        """Live-jitted trunk/head programs for pre-warm traffic."""
+        key = (kind, variant)
+        with self._flock:
+            fn = self._fallbacks.get(key)
+            if fn is None:
+                import jax
+
+                fn = jax.jit(self._make_fn(kind, variant))
+                self._fallbacks[key] = fn
+            return fn
+
+    def _make_fn(self, kind: str, variant: str) -> Callable:
+        """Raw (unjitted) trunk or head program for ``variant``.
+
+        The trunk keeps its features in the variant's compute dtype
+        (casting back to fp32 between trunk and head would forfeit the
+        bandwidth win); heads cast their outputs to fp32 so decode is
+        variant-blind. Weight transforms (bf16 cast / int8 pack) happen
+        HERE, eagerly — never inside the traced program, so executables
+        really do hold bf16/int8 weights at rest."""
+        from seist_tpu.models.seist import backbone_apply, head_apply
+
+        if kind == "trunk":
+            return aot.make_variant_apply(
+                lambda v, x: backbone_apply(self.trunk_model, v, x),
+                self.trunk_variables,
+                variant,
+                cast_outputs=False,  # bf16 features flow to bf16 heads
+            )
+        head = self.heads[kind]
+        if variant == "fp32":
+            hv = head.variables
+
+            def head_fn(feats, x):
+                return head_apply(head.model, hv, feats, x)
+
+        elif variant == "bf16":
+            import jax.numpy as jnp
+
+            hv = aot.cast_variables(head.variables, jnp.bfloat16)
+
+            def head_fn(feats, x):
+                return aot.outputs_to_f32(
+                    head_apply(head.model, hv, feats, x.astype(jnp.bfloat16))
+                )
+
+        elif variant == "int8":
+            import jax.numpy as jnp
+
+            packed = aot.quantize_int8(head.variables)
+
+            def head_fn(feats, x):
+                return aot.outputs_to_f32(
+                    head_apply(
+                        head.model,
+                        aot.dequantize(packed),
+                        feats.astype(jnp.float32),
+                        x,
+                    )
+                )
+
+        else:
+            raise ValueError(f"unknown variant {variant!r}")
+        return head_fn
+
+    def fanout(
+        self,
+        batch: np.ndarray,
+        tasks: Sequence[str],
+        variant: str = "fp32",
+        *,
+        account: bool = True,
+    ) -> Dict[str, Any]:
+        """Trunk once, requested heads on its features. Returns
+        {task: raw head outputs} with leading dim == batch.
+
+        ``account=False`` for load-time callers (warm-up, parity-gate
+        probes): the trunk_runs / flops-saved counters measure SERVED
+        traffic — probe runs inflating them would overstate the
+        amortization in /metrics and bench_serve's JSON."""
+        b = int(batch.shape[0])
+        trunk_prog = self.programs.get((variant, "trunk", b))
+        if trunk_prog is not None:
+            feats = trunk_prog(batch)
+            trunk_flops = trunk_prog.flops
+        else:
+            import jax.numpy as jnp
+
+            feats = self._fallback("trunk", variant)(jnp.asarray(batch))
+            trunk_flops = 0.0
+        outs: Dict[str, Any] = {}
+        for t in tasks:
+            head_prog = self.programs.get((variant, t, b))
+            if head_prog is not None:
+                outs[t] = head_prog(feats, batch)
+            else:
+                outs[t] = self._fallback(t, variant)(feats, batch)
+        if account:
+            self._account(tuple(tasks), trunk_flops)
+        return outs
+
+    def picker_forward(self, x: Any) -> Any:
+        """(N, window, C) -> (N, window, 3) dpk probabilities — the warm
+        forward ops/stream.annotate drives for /annotate on a group.
+        ``x`` may be a device array (stream feeds jnp chunks); fanout
+        only reads its shape, so no host round-trip happens here."""
+        return self.fanout(x, ("dpk",), "fp32")["dpk"]
+
+    def _account(self, tasks: Tuple[str, ...], trunk_flops: float) -> None:
+        saved = trunk_flops * max(len(tasks) - 1, 0)
+        with self._lock:
+            self._trunk_runs += 1
+            for t in tasks:
+                self._head_runs[t] = self._head_runs.get(t, 0) + 1
+            self._flops_saved += saved
+        from seist_tpu.obs.bus import BUS
+
+        BUS.counter("serve_trunk_runs", model=self.name).inc()
+        for t in tasks:
+            BUS.counter("serve_head_runs", model=self.name, task=t).inc()
+        if saved:
+            BUS.counter("serve_trunk_flops_saved", model=self.name).inc(saved)
+
+    def fanout_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "trunk_runs": self._trunk_runs,
+                "head_runs": dict(self._head_runs),
+                "trunk_flops_saved": self._flops_saved,
+                "tasks": list(self.tasks),
+                "variants": {
+                    v: list(self.variant_tasks.get(v, ()))
+                    for v in self.variants
+                },
+            }
+
+    # ------------------------------------------------------------ warm-up
+    def build_programs(
+        self, buckets: Sequence[int], report: List[Dict[str, Any]]
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        for variant in self.variants:
+            trunk_fn = self._make_fn("trunk", variant)
+            for b in buckets:
+                xs = jax.ShapeDtypeStruct(
+                    (b, self.window, self.in_channels), jnp.float32
+                )
+                prog = aot.aot_compile(
+                    f"{self.name}/trunk/b{b}/{variant}",
+                    trunk_fn,
+                    [(xs.shape, xs.dtype)],
+                    model=self.name,
+                )
+                self.programs[(variant, "trunk", b)] = prog
+                report.append({
+                    "model": self.name, "batch": b, "variant": variant,
+                    "seconds": prog.compile_ms / 1e3, "program": prog.key,
+                })
+                feats_struct = jax.eval_shape(trunk_fn, xs)
+                for t in self.tasks:
+                    hp = aot.aot_compile(
+                        f"{self.name}/head:{t}/b{b}/{variant}",
+                        self._make_fn(t, variant),
+                        [
+                            (feats_struct.shape, feats_struct.dtype),
+                            (xs.shape, xs.dtype),
+                        ],
+                        model=self.name,
+                    )
+                    self.programs[(variant, t, b)] = hp
+                    report.append({
+                        "model": self.name, "batch": b, "variant": variant,
+                        "seconds": hp.compile_ms / 1e3, "program": hp.key,
+                    })
+                logger.info(
+                    f"[serve] aot {self.name} b={b} {variant}: trunk+"
+                    f"{len(self.tasks)} heads compiled"
+                )
+        self._gate_variants(buckets[0])
+
+    def _gate_variants(self, probe_bucket: int) -> None:
+        probe = _probe_input(probe_bucket, self.window, self.in_channels)
+        ref = self.fanout(probe, self.tasks, "fp32", account=False)
+        for variant in self.variants:
+            if variant == "fp32":
+                continue
+            out = self.fanout(probe, self.tasks, variant, account=False)
+            ok_tasks = []
+            for t in self.tasks:
+                head = self.heads[t]
+                kind, _ = aot.parity_kind(head.spec)
+                ok, err = aot.variant_parity(
+                    _first_leaf(ref[t]),
+                    _first_leaf(out[t]),
+                    variant,
+                    kind=kind,
+                    # jaxlint: disable=host-sync-hot-path -- host-side
+                    # python float config, one-shot load-time gate
+                    scale=float(head.head_scale or 1.0),
+                )
+                if ok:
+                    ok_tasks.append(t)
+                logger.info(
+                    f"[serve] variant gate {self.name}/{t}/{variant}: "
+                    f"{'ok' if ok else 'DISABLED'} (err={err:.2g}, {kind})"
+                )
+            self.variant_tasks[variant] = tuple(ok_tasks)
+
+
+def _probe_input(b: int, window: int, in_channels: int) -> np.ndarray:
+    """Deterministic parity-gate probe: unit-variance noise, the same
+    distribution /predict feeds after std normalization."""
+    rng = np.random.default_rng(0)
+    return rng.standard_normal((b, window, in_channels)).astype(np.float32)
+
+
+def _first_leaf(out: Any) -> Any:
+    """Parity gates compare the primary output (tuple heads: the first —
+    e.g. ditingmotion's polarity)."""
+    return out[0] if isinstance(out, (tuple, list)) else out
+
+
+def load_model_entry(
+    model_name: str,
+    checkpoint: str = "",
+    *,
+    window: int = 8192,
+    seed: int = 0,
+    variants: Sequence[str] = ("fp32",),
+) -> ModelEntry:
+    """Create + restore one model for inference.
+
+    Without ``checkpoint`` the model serves freshly-initialized weights
+    (tests / smoke runs); with one, params (+ BN stats when present) are
+    restored the same way demo_predict.py and tools/predict.py always did
+    — that logic now lives only here.
+    """
+    model, variables, spec, in_channels, channel0 = _load_parts(
+        model_name, checkpoint, window=window, seed=seed
+    )
 
     def apply_fn(x):
         return model.apply(variables, x, train=False)
@@ -108,35 +579,143 @@ def load_model_entry(
         channel0=channel0,
         forward=jax.jit(apply_fn),
         apply=apply_fn,
+        variants=_check_variants(variants),
     )
 
 
+def load_group_entry(
+    group_name: str,
+    task_entries: Sequence[Tuple[str, str]],
+    *,
+    window: int = 8192,
+    seed: int = 0,
+    variants: Sequence[str] = ("fp32",),
+) -> MultiTaskEntry:
+    """Build one shared-trunk task group: ``group_name`` is the SeisT
+    size prefix (e.g. ``seist_s``); each (task, checkpoint) loads
+    ``<group_name>_<task>``. Trunk weights come from the FIRST listed
+    task's checkpoint (heads trained against a common trunk per the
+    paper's design); every head's variable tree shares those arrays."""
+    from seist_tpu.models.seist import supports_trunk_split
+
+    if not task_entries:
+        raise ValueError(f"group '{group_name}' needs at least one task")
+    heads: Dict[str, TaskHead] = {}
+    order: List[str] = []
+    trunk_model = None
+    trunk_vars: Dict[str, Any] = {}
+    in_channels = None
+    for task, ckpt in task_entries:
+        if task not in TASKS:
+            raise ValueError(
+                f"unknown task '{task}' in group '{group_name}'; "
+                f"tasks are {list(TASKS)}"
+            )
+        if task in heads:
+            raise ValueError(f"duplicate task '{task}' in '{group_name}'")
+        model_name = f"{group_name}_{task}"
+        model, variables, spec, chans, channel0 = _load_parts(
+            model_name, ckpt, window=window, seed=seed
+        )
+        if not supports_trunk_split(model):
+            raise ValueError(
+                f"model '{model_name}' has no trunk/head split; groups "
+                "support the SeisT family only"
+            )
+        if in_channels is None:
+            in_channels = chans
+        elif chans != in_channels:
+            raise ValueError(
+                f"group '{group_name}': task '{task}' wants {chans} input "
+                f"channels, group has {in_channels}"
+            )
+        if trunk_model is None:
+            trunk_model = model
+            trunk_vars = {
+                col: {k: v for k, v in tree.items() if k != "out_head"}
+                for col, tree in variables.items()
+            }
+        merged: Dict[str, Any] = {}
+        for col in set(variables) | set(trunk_vars):
+            base = dict(trunk_vars.get(col, {}))
+            own = variables.get(col, {})
+            if "out_head" in own:
+                base["out_head"] = own["out_head"]
+            merged[col] = base
+        heads[task] = TaskHead(
+            task=task,
+            name=model_name,
+            model=model,
+            variables=merged,
+            spec=spec,
+            channel0=channel0,
+            # jaxlint: disable=host-sync-hot-path -- module-attribute
+            # float, one-shot load-time coercion
+            head_scale=float(getattr(model, "head_scale", 1.0) or 1.0),
+        )
+        order.append(task)
+    return MultiTaskEntry(
+        name=group_name,
+        window=window,
+        in_channels=int(in_channels),
+        tasks=tuple(order),
+        heads=heads,
+        trunk_model=trunk_model,
+        trunk_variables=trunk_vars,
+        variants=_check_variants(variants),
+    )
+
+
+def _check_variants(variants: Sequence[str]) -> Tuple[str, ...]:
+    out = tuple(dict.fromkeys(variants))  # dedup, keep order
+    bad = [v for v in out if v not in aot.VARIANTS]
+    if bad:
+        raise ValueError(f"unknown variants {bad}; use {list(aot.VARIANTS)}")
+    if "fp32" not in out:
+        out = ("fp32",) + out  # fp32 is the reference; always served
+    return out
+
+
 class ModelPool:
-    """Loaded entries keyed by model name + the warm-up that compiles all
-    serving shapes up front."""
+    """Loaded entries keyed by model/group name + the warm-up that
+    AOT-compiles all serving programs up front."""
 
     def __init__(
         self,
-        entries: Sequence[Tuple[str, str]],
+        entries: Sequence[Tuple[str, str]] = (),
         *,
         window: int = 8192,
         seed: int = 0,
+        groups: Optional[
+            Sequence[Tuple[str, Sequence[Tuple[str, str]]]]
+        ] = None,
+        variants: Sequence[str] = ("fp32",),
     ):
-        if not entries:
-            raise ValueError("ModelPool needs at least one (name, checkpoint)")
-        self._entries: Dict[str, ModelEntry] = {}
+        if not entries and not groups:
+            raise ValueError(
+                "ModelPool needs at least one (name, checkpoint) entry "
+                "or one task group"
+            )
+        self._entries: Dict[str, Any] = {}
         for name, ckpt in entries:
             if name in self._entries:
                 raise ValueError(f"duplicate model '{name}' in pool")
             self._entries[name] = load_model_entry(
-                name, ckpt, window=window, seed=seed
+                name, ckpt, window=window, seed=seed, variants=variants
+            )
+        for group_name, task_entries in groups or ():
+            if group_name in self._entries:
+                raise ValueError(f"duplicate model '{group_name}' in pool")
+            self._entries[group_name] = load_group_entry(
+                group_name, task_entries, window=window, seed=seed,
+                variants=variants,
             )
         self.warmup_report: List[Dict[str, Any]] = []
 
     def names(self) -> List[str]:
         return list(self._entries)
 
-    def get(self, name: Optional[str]) -> ModelEntry:
+    def get(self, name: Optional[str]) -> Any:
         if name is None:
             if len(self._entries) == 1:
                 return next(iter(self._entries.values()))
@@ -151,49 +730,61 @@ class ModelPool:
             ) from None
 
     def warmup(self, buckets: Sequence[int]) -> List[Dict[str, Any]]:
-        """Compile every (bucket, window, C) forward + the default decode
-        for every entry; returns per-shape compile timings (also kept on
-        ``self.warmup_report`` for /healthz)."""
+        """AOT-compile every (bucket, program, variant) for every entry +
+        warm the default decode programs; returns per-program compile
+        timings (also kept on ``self.warmup_report`` for /healthz)."""
         from seist_tpu.utils.profiling import stopwatch
 
-        report = []
+        report: List[Dict[str, Any]] = []
+        buckets = sorted(set(int(b) for b in buckets))
         for entry in self._entries.values():
-            # jaxlint: disable=host-sync-hot-path -- one-shot warm-up
-            # coercion of a tiny host-side bucket list, not a request path
-            for b in sorted(set(int(b) for b in buckets)):
-                x = np.zeros((b, entry.window, entry.in_channels), np.float32)
-                with stopwatch() as elapsed:
-                    out = entry.forward(x)
-                    _block(out)
-                report.append(
-                    {"model": entry.name, "batch": b, "seconds": elapsed()}
-                )
-                logger.info(
-                    f"[serve] warm {entry.name} batch={b} "
-                    f"({elapsed()*1000:.0f} ms)"
-                )
+            entry.build_programs(buckets, report)
             # Warm the postprocess programs too (pick_peaks/detect_events
-            # jit on static topk/min_peak_dist — defaults compiled here).
-            with stopwatch() as elapsed:
-                decode_outputs(
-                    entry, _slice_outputs(out, 0), PredictOptions()
-                )
-            report.append(
-                {"model": entry.name, "batch": "decode", "seconds": elapsed()}
+            # jit on static topk/min_peak_dist — defaults compiled here),
+            # and prove every executable answers end to end.
+            x = np.zeros(
+                (buckets[-1], entry.window, entry.in_channels), np.float32
             )
+            if entry.is_group:
+                outs = entry.fanout(x, entry.tasks, "fp32", account=False)
+                _block(list(outs.values()))
+                for t in entry.tasks:
+                    with stopwatch() as elapsed:
+                        decode_outputs(
+                            entry.heads[t],
+                            _slice_outputs(outs[t], 0),
+                            PredictOptions(),
+                        )
+                    report.append({
+                        "model": entry.name, "batch": f"decode:{t}",
+                        "seconds": elapsed(),
+                    })
+            else:
+                out = entry.run(x, "fp32")
+                _block(out)
+                with stopwatch() as elapsed:
+                    decode_outputs(
+                        entry, _slice_outputs(out, 0), PredictOptions()
+                    )
+                report.append({
+                    "model": entry.name, "batch": "decode",
+                    "seconds": elapsed(),
+                })
         self.warmup_report = report
         return report
 
 
 def decode_outputs(
-    entry: ModelEntry, outputs: Any, opts: PredictOptions
+    entry: Any, outputs: Any, opts: PredictOptions
 ) -> Dict[str, Any]:
     """One request's raw model outputs (leading dim 1) -> JSON-able result.
 
-    Picking heads route through ops/postprocess (same programs the eval
-    loop uses); VALUE heads go through the task spec's results transform
-    (e.g. magnet's mean-only, baz's (cos,sin)->degrees decode); ONEHOT
-    heads report argmax class + raw scores.
+    ``entry`` is a ModelEntry or a group's TaskHead (same duck type:
+    name/spec/is_picker). Picking heads route through ops/postprocess
+    (same programs the eval loop uses); VALUE heads go through the task
+    spec's results transform (e.g. magnet's mean-only, baz's
+    (cos,sin)->degrees decode); ONEHOT heads report argmax class + raw
+    scores.
     """
     from seist_tpu import taskspec
     from seist_tpu.ops.postprocess import process_outputs
@@ -278,4 +869,7 @@ def decode_outputs(
 def _block(out: Any) -> None:
     """Wait for device completion so warm-up timings mean something."""
     for o in out if isinstance(out, (tuple, list)) else [out]:
-        getattr(o, "block_until_ready", lambda: None)()
+        if isinstance(o, (tuple, list)):
+            _block(o)
+        else:
+            getattr(o, "block_until_ready", lambda: None)()

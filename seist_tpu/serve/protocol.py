@@ -11,6 +11,21 @@ predict request body is::
                  "sampling_rate": 50, "norm_mode": "std",
                  "timeout_ms": 2000}}
 
+Multi-task fan-out (``model`` names a task GROUP served with
+``--model-group``, e.g. ``seist_s``)::
+
+    {"model": "seist_s", "tasks": ["dpk", "emg", "dis"],  # default: all
+     "data": [[...], ...],
+     "options": {"variant": "bf16"}}      # fp32 (default) | bf16 | int8
+
+and the response carries one entry per requested head::
+
+    {"model": "seist_s", "trunk_runs": 1,
+     "tasks": {"dpk": {...picks...}, "emg": {...}, "dis": {...}}}
+
+The single-task request/response shape above is unchanged (PR 1 wire
+compatibility); ``tasks`` on a single-task model is a 400.
+
 ``data`` orientation is resolved against the model's channel count (the
 same (C, L)/(L, C) tolerance as tools/predict.py); windows shorter than
 the model's compiled window are right-padded with zeros AFTER
@@ -24,7 +39,7 @@ from __future__ import annotations
 import json
 import math
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -78,6 +93,14 @@ class ShuttingDown(ServeError):
 PRIORITIES = {"alert": 0, "interactive": 1, "batch": 2}
 DEFAULT_PRIORITY = "interactive"
 
+#: Serving weight variants (serve/aot.py builds + parity-gates them):
+#: fp32 = the checkpoint as restored; bf16 = weights+activations cast;
+#: int8 = weight-only quantization. Selected per request via
+#: ``options.variant``; a variant a model/task wasn't loaded (or failed
+#: its parity gate) for is a 400.
+VARIANTS = ("fp32", "bf16", "int8")
+DEFAULT_VARIANT = "fp32"
+
 
 class Overloaded(ServeError):
     """Adaptive load shedding (serve/shed.py): the replica's queue delay
@@ -120,6 +143,7 @@ class PredictOptions:
     max_events: int = 8
     timeout_ms: float = 5000.0
     priority: str = DEFAULT_PRIORITY  # admission tier (serve/shed.py)
+    variant: str = DEFAULT_VARIANT  # weight variant (serve/aot.py)
     # /annotate only:
     stride: int = 0  # 0 = window // 2
     combine: str = "max"
@@ -134,7 +158,7 @@ class PredictOptions:
         int_fields = ("sampling_rate", "max_events", "stride",
                       "record_max_events")
         for key, value in d.items():
-            if key in ("norm_mode", "combine", "priority"):
+            if key in ("norm_mode", "combine", "priority", "variant"):
                 if not isinstance(value, str):
                     raise BadRequest(f"option '{key}' must be a string")
                 continue
@@ -185,7 +209,36 @@ class PredictOptions:
                 f"priority must be one of {sorted(PRIORITIES)}, "
                 f"got '{opts.priority}'"
             )
+        if opts.variant not in VARIANTS:
+            raise BadRequest(
+                f"variant must be one of {list(VARIANTS)}, "
+                f"got '{opts.variant}'"
+            )
         return opts
+
+
+def parse_tasks(obj: Any) -> Optional[Tuple[str, ...]]:
+    """Validate a request's ``tasks`` field: a non-empty list of unique
+    task-name strings (which tasks EXIST is the pool entry's call —
+    ``resolve_tasks``); ``None`` passes through (single-task request /
+    group default = all its tasks)."""
+    if obj is None:
+        return None
+    if not isinstance(obj, (list, tuple)) or not obj:
+        raise BadRequest(
+            "'tasks' must be a non-empty list of task names, "
+            f"got {type(obj).__name__}"
+        )
+    out = []
+    for t in obj:
+        if not isinstance(t, str):
+            raise BadRequest(
+                f"'tasks' entries must be strings, got {type(t).__name__}"
+            )
+        if t in out:
+            raise BadRequest(f"duplicate task '{t}' in 'tasks'")
+        out.append(t)
+    return tuple(out)
 
 
 def parse_body(raw: bytes) -> Dict[str, Any]:

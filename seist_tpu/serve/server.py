@@ -47,6 +47,7 @@ from seist_tpu.serve.protocol import (
     ShuttingDown,
     json_bytes,
     parse_body,
+    parse_tasks,
     parse_waveform,
 )
 from seist_tpu.serve.shed import AdmissionController, ShedConfig
@@ -95,28 +96,65 @@ class ServeService:
         self._faults = faults if faults is not None else (
             ServeFaultInjector.from_env()
         )
+        # One batcher per (entry, enabled variant): requests batch by
+        # TRUNK INPUT SHAPE within a variant (a bf16 program cannot serve
+        # an fp32 request), task-blind — a group's dpk+emg+dis traffic
+        # coalesces into the same flushes. The fp32 batcher keeps the
+        # bare model name (wire/metrics back-compat); other variants are
+        # keyed "<model>@<variant>".
         self._batchers: Dict[str, MicroBatcher] = {}
         self._shedders: Dict[str, AdmissionController] = {}
         for name in pool.names():
             entry = pool.get(name)
-            import jax.numpy as jnp
-
-            fwd = entry.forward
             injector = self._faults
+            entry_batchers = []
+            # getattr defaults keep bare-namespace test pools (see
+            # watch_until_shutdown) and pre-variant entries working.
+            for variant in getattr(entry, "variants", ("fp32",)):
+                key = name if variant == "fp32" else f"{name}@{variant}"
+                if getattr(entry, "is_group", False):
 
-            def batched_forward(batch, _f=fwd, _inj=injector):
-                # Injected model slowness runs IN the flush thread, so
-                # queued requests age exactly as behind a slow device.
-                _inj.forward_delay()
-                return _f(jnp.asarray(batch))
+                    def batched_forward(
+                        batch, tasks=None, _e=entry, _v=variant,
+                        _inj=injector,
+                    ):
+                        # Injected model slowness runs IN the flush
+                        # thread, so queued requests age exactly as
+                        # behind a slow device.
+                        _inj.forward_delay()
+                        return _e.fanout(
+                            batch, sorted(tasks or _e.tasks), _v
+                        )
 
-            self._batchers[name] = MicroBatcher(
-                batched_forward, self.config, name=name
-            )
-            # Tiered admission gate per model, fed by that model's
-            # batcher queue-delay estimate (serve/shed.py).
+                elif hasattr(entry, "run"):
+
+                    def batched_forward(
+                        batch, _e=entry, _v=variant, _inj=injector
+                    ):
+                        _inj.forward_delay()
+                        return _e.run(batch, _v)
+
+                else:  # bare forward-only entry (test doubles)
+
+                    def batched_forward(
+                        batch, _e=entry, _inj=injector
+                    ):
+                        import jax.numpy as jnp
+
+                        _inj.forward_delay()
+                        return _e.forward(jnp.asarray(batch))
+
+                self._batchers[key] = MicroBatcher(
+                    batched_forward, self.config, name=key
+                )
+                entry_batchers.append(self._batchers[key])
+            # Tiered admission gate per model, fed by the worst
+            # queue-delay estimate across its variant batchers
+            # (serve/shed.py): overload on any variant sheds the entry.
             self._shedders[name] = AdmissionController(
-                self._batchers[name].queue_delay_ms,
+                lambda _bs=tuple(entry_batchers): max(
+                    b.queue_delay_ms() for b in _bs
+                ),
                 self.shed_config,
                 model=name,
             )
@@ -200,17 +238,57 @@ class ServeService:
         )
 
     # ----------------------------------------------------------- predict
+    def _batcher_for(self, name: str, variant: str) -> MicroBatcher:
+        return self._batchers[
+            name if variant == "fp32" else f"{name}@{variant}"
+        ]
+
+    def _check_variant(self, entry: Any, variant: str, tasks: Any) -> None:
+        if variant == "fp32":
+            return
+        if variant not in getattr(entry, "variants", ("fp32",)):
+            # Never loaded — no batcher, no programs: always a 400.
+            raise BadRequest(
+                f"variant '{variant}' is not loaded for model "
+                f"'{entry.name}' (serve --variants); loaded: "
+                f"{list(getattr(entry, 'variants', ('fp32',)))}"
+            )
+        if self._warming:
+            # Parity gates are computed by the (async) warm-up; a loaded
+            # variant must not bounce 400 during the warm-up window when
+            # the documented pre-warm fallback can serve it — the same
+            # contract fp32 traffic gets. Gate verdicts apply once warm.
+            return
+        supported = entry.supported_variants(tasks)
+        if variant not in supported:
+            raise BadRequest(
+                f"variant '{variant}' is not served for this request "
+                f"(model '{entry.name}'"
+                + (f", tasks {list(tasks)}" if tasks else "")
+                + f"); available: {supported} — variants are enabled at "
+                "load (serve --variants) and parity-gated against fp32"
+            )
+
     def predict(
         self,
         data: Any,
         model: Optional[str] = None,
         options: Optional[Dict[str, Any]] = None,
+        tasks: Optional[Any] = None,
     ) -> Dict[str, Any]:
-        """One fixed-window trace through the micro-batcher."""
+        """One fixed-window trace through the micro-batcher.
+
+        ``tasks`` (multi-task groups only): which heads to answer with —
+        the shared trunk runs ONCE and fans out to all of them
+        (serve/pool.MultiTaskEntry); default is every task the group
+        serves. Single-task models keep the PR 1 request/response shape
+        byte-for-byte."""
         if self._draining:
             raise ShuttingDown("service is draining")
         entry = self.pool.get(model)
         opts = PredictOptions.from_dict(options)
+        req_tasks = entry.resolve_tasks(parse_tasks(tasks))
+        self._check_variant(entry, opts.variant, req_tasks)
         # Request arrival: count, fire any scheduled serving fault
         # (SIGKILL at request k / black-hole window), then the admission
         # gate — shedding happens BEFORE the expensive waveform parse, so
@@ -232,14 +310,35 @@ class ServeService:
         if n_real < entry.window:  # pad AFTER normalize: zeros stay zero
             pad = np.zeros((entry.window - n_real, x.shape[1]), dtype=x.dtype)
             x = np.concatenate([x, pad], axis=0)
-        raw = self._batchers[entry.name].submit(
-            x, timeout_ms=opts.timeout_ms, rank=PRIORITIES[opts.priority]
+        raw = self._batcher_for(entry.name, opts.variant).submit(
+            x,
+            timeout_ms=opts.timeout_ms,
+            rank=PRIORITIES[opts.priority],
+            tasks=frozenset(req_tasks) if req_tasks is not None else None,
         )
+        fs = float(opts.sampling_rate)
+        if req_tasks is not None:  # multi-task group: one entry per head
+            per_task: Dict[str, Any] = {}
+            for t in req_tasks:
+                # The flush may have computed the UNION of coalesced
+                # requests' tasks; decode only what THIS caller asked.
+                r = decode_outputs(entry.heads[t], raw[t], opts)
+                if n_real < entry.window:
+                    _clip_picks(r, n_real, fs)
+                per_task[t] = r
+            return {
+                "model": entry.name,
+                "tasks": per_task,
+                # The fan-out contract, observable per response: all
+                # heads above came from ONE trunk execution.
+                "trunk_runs": 1,
+                "variant": opts.variant,
+            }
         result = decode_outputs(entry, raw, opts)
         if n_real < entry.window:
             # The signal->zeros step at the padding boundary can fabricate
             # picks/detections inside samples the client never sent.
-            _clip_picks(result, n_real, float(opts.sampling_rate))
+            _clip_picks(result, n_real, fs)
         result["model"] = entry.name
         return result
 
@@ -261,6 +360,14 @@ class ServeService:
                 "needs (non|det, ppk, spk) outputs"
             )
         opts = PredictOptions.from_dict(options)
+        if opts.variant != "fp32":
+            # /annotate is hardwired to the fp32 picking path; silently
+            # serving fp32 against an explicit bf16/int8 request would
+            # misreport which numerics answered.
+            raise BadRequest(
+                "variant selection is /predict-only; /annotate always "
+                "runs fp32"
+            )
         # Same tiered gate as /predict: an overloaded replica sheds
         # low-tier record backfill before paying the (large) record parse.
         self._shedders[entry.name].admit(opts.priority)
@@ -281,11 +388,19 @@ class ServeService:
             raise DeadlineExceeded(
                 f"/annotate queue wait exceeded {opts.timeout_ms:.0f} ms"
             )
+        # Groups stream through trunk+dpk (the group's picking path);
+        # single-task pickers through their warm AOT forward. Both hit
+        # shapes compiled at warm-up (batch_size = largest bucket).
+        forward = (
+            entry.picker_forward
+            if entry.is_group
+            else (lambda x: entry.run(x, "fp32"))
+        )
         try:
             with self._lock:
                 self._requests["annotate"] += 1
             picks = stream_annotate(
-                entry.forward,
+                forward,
                 record,
                 window=entry.window,
                 stride=opts.stride or None,
@@ -386,6 +501,13 @@ class ServeService:
             "shed": {
                 name: shedder.stats()
                 for name, shedder in self._shedders.items()
+            },
+            # Multi-task groups: trunk-once accounting (trunk_runs,
+            # per-head runs, amortized trunk FLOPs, variant gates).
+            "fanout": {
+                name: self.pool.get(name).fanout_stats()
+                for name in self.pool.names()
+                if getattr(self.pool.get(name), "is_group", False)
             },
         }
 
@@ -557,17 +679,21 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             body = parse_body(self.rfile.read(length))
             if self.path == "/predict":
-                fn = self.service.predict
+                result = self.service.predict(
+                    body.get("data"),
+                    model=body.get("model"),
+                    options=body.get("options"),
+                    tasks=body.get("tasks"),
+                )
             elif self.path == "/annotate":
-                fn = self.service.annotate
+                result = self.service.annotate(
+                    body.get("data"),
+                    model=body.get("model"),
+                    options=body.get("options"),
+                )
             else:
                 self._reply(404, {"error": "not_found", "message": self.path})
                 return
-            result = fn(
-                body.get("data"),
-                model=body.get("model"),
-                options=body.get("options"),
-            )
             self._reply(200, result)
         except ServeError as e:
             # e.headers() carries e.g. the shed path's Retry-After.
@@ -615,6 +741,19 @@ def get_serve_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         help="model to serve, repeatable; NAME alone serves fresh-init "
         "weights (smoke/testing)",
     )
+    ap.add_argument(
+        "--model-group", action="append", default=[],
+        metavar="PREFIX=TASK[:CKPT],TASK[:CKPT],...",
+        help="multi-task SeisT group: PREFIX_TASK models on ONE shared "
+        "trunk, e.g. seist_s=dpk:CKPT,emg:CKPT2 — a multi-task /predict "
+        "runs the trunk once and fans out (docs/SERVING.md)",
+    )
+    ap.add_argument(
+        "--variants", default="fp32",
+        help="comma-separated serving weight variants to AOT-compile at "
+        "load: fp32,bf16,int8 (selected per request via options.variant; "
+        "non-fp32 variants are parity-gated against fp32)",
+    )
     ap.add_argument("--model-name", default="", help="single-model shorthand")
     ap.add_argument("--checkpoint", default="", help="with --model-name")
     ap.add_argument("--window", type=int, default=8192)
@@ -650,9 +789,35 @@ def parse_model_flags(args: argparse.Namespace) -> List[Tuple[str, str]]:
         entries.append((name, ckpt))
     if args.model_name:
         entries.append((args.model_name, args.checkpoint))
-    if not entries:
-        raise SystemExit("serve: need --model NAME[=CKPT] or --model-name")
+    if not entries and not getattr(args, "model_group", None):
+        raise SystemExit(
+            "serve: need --model NAME[=CKPT], --model-name or --model-group"
+        )
     return entries
+
+
+def parse_group_flags(
+    args: argparse.Namespace,
+) -> List[Tuple[str, List[Tuple[str, str]]]]:
+    """--model-group PREFIX=TASK[:CKPT],... -> [(prefix, [(task, ckpt)])]."""
+    groups: List[Tuple[str, List[Tuple[str, str]]]] = []
+    for spec in getattr(args, "model_group", []) or []:
+        prefix, sep, rest = spec.partition("=")
+        if not sep or not prefix or not rest:
+            raise SystemExit(
+                f"serve: bad --model-group '{spec}' "
+                "(want PREFIX=TASK[:CKPT],TASK[:CKPT],...)"
+            )
+        tasks: List[Tuple[str, str]] = []
+        for part in rest.split(","):
+            task, _, ckpt = part.partition(":")
+            if not task:
+                raise SystemExit(
+                    f"serve: empty task in --model-group '{spec}'"
+                )
+            tasks.append((task, ckpt))
+        groups.append((prefix, tasks))
+    return groups
 
 
 def watch_until_shutdown(
@@ -718,7 +883,15 @@ def main(argv: Optional[List[str]] = None) -> None:
     # to the same events.jsonl the train worker writes — one forensic
     # stream per logdir regardless of plane.
     events = EventLog(_os.path.join(logger.logdir(), "events.jsonl"))
-    pool = ModelPool(entries, window=args.window, seed=args.seed)
+    pool = ModelPool(
+        entries,
+        window=args.window,
+        seed=args.seed,
+        groups=parse_group_flags(args),
+        variants=tuple(
+            v.strip() for v in args.variants.split(",") if v.strip()
+        ),
+    )
     # Async warm-up: the socket (and /healthz/ready, reporting 503
     # "warming") comes up immediately; orchestrators gate traffic on
     # readiness instead of timing out their liveness probe on the compile.

@@ -389,3 +389,144 @@ def test_ab_summary_parses_runner_log(tmp_path):
     # Final step of the log: no end marker -> honest blank, never the
     # next day's run.
     assert head2["seconds"] is None
+
+
+# ------------------------------------------------ probe-vs-cache (ISSUE 10)
+def _seed_train_cache(bench, capsys, monkeypatch):
+    """Cache a successful run for the CURRENT env_config so main() can
+    resolve a replay before probing."""
+    for var in list(os.environ):
+        if var.startswith("BENCH_"):
+            monkeypatch.delenv(var, raising=False)
+    config = {k: v for k, v in bench.env_config().items() if k != "model"}
+    metric = f"{bench.env_config()['model']}_train_throughput"
+    payload = {"metric": metric, "value": 42.0,
+               "unit": "waveforms/sec/chip", **config}
+    bench._emit_and_cache(payload, config=config)
+    capsys.readouterr()
+    return metric
+
+
+def test_probe_skipped_entirely_when_cached_and_tunnel_down(
+    bench, capsys, monkeypatch
+):
+    # BENCH_r04 burned 3x180 s probe timeouts + backoff to emit a cached
+    # payload: with a replay in hand AND a fresh tunnel-down signal, the
+    # probe must not run AT ALL.
+    _seed_train_cache(bench, capsys, monkeypatch)
+    monkeypatch.setattr(bench, "_tunnel_known_down", lambda *a, **k: True)
+    monkeypatch.setattr(
+        bench, "probe_backend",
+        lambda *a, **k: pytest.fail("probe ran despite cached replay"),
+    )
+    bench.main()
+    out = _emitted(capsys)
+    assert out["cached"] is True and out["value"] == 42.0
+    assert "probe skipped" in out["error"]
+
+
+def test_probe_ladder_collapses_to_one_short_attempt_when_cached(
+    bench, capsys, monkeypatch
+):
+    # Replay available but no down-signal: still try for a fresh number,
+    # with ONE short attempt instead of the 3x180 s ladder.
+    _seed_train_cache(bench, capsys, monkeypatch)
+    monkeypatch.setattr(bench, "_tunnel_known_down", lambda *a, **k: False)
+    seen = {}
+
+    def fake_probe(attempts=None, timeout=None):
+        seen["args"] = (attempts, timeout)
+        fake_probe.last_attempts = attempts
+        return None
+
+    monkeypatch.setattr(bench, "probe_backend", fake_probe)
+    bench.main()
+    out = _emitted(capsys)
+    assert seen["args"] == (1, 60)
+    assert out["cached"] is True and out["value"] == 42.0
+    # Explicit BENCH_PROBE_* env always wins over the collapse: main()
+    # hands the ladder back to probe_backend's own env handling.
+    monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "3")
+    seen.clear()
+    bench.main()
+    capsys.readouterr()
+    assert seen["args"] == (None, None)
+
+
+def test_no_cache_keeps_full_probe_ladder(bench, capsys, monkeypatch):
+    for var in list(os.environ):
+        if var.startswith("BENCH_"):
+            monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr(bench, "_tunnel_known_down", lambda *a, **k: False)
+    seen = {}
+
+    def fake_probe(attempts=None, timeout=None):
+        seen["args"] = (attempts, timeout)
+        fake_probe.last_attempts = attempts or 3
+        return None
+
+    monkeypatch.setattr(bench, "probe_backend", fake_probe)
+    bench.main()
+    out = _emitted(capsys)
+    assert seen["args"] == (None, None)  # default ladder untouched
+    assert out["cached"] is False and out["value"] == 0
+
+
+# ------------------------------------- stale-watcher quarantine (ISSUE 10)
+def test_stale_watcher_warns_once_then_quarantines(
+    bench, tmp_path, capsys
+):
+    stale = tmp_path / "ab_results.log"
+    stale.write_text("runner start Thu Jul 30\n| row |\n")
+    done = tmp_path / "ab_done.log"
+    done.write_text("watcher start\nALL DONE\n")
+    fresh = tmp_path / "ab_fresh.log"
+    fresh.write_text("watcher start\n")
+    old = time.time() - 3600
+    os.utime(stale, (old, old))
+    os.utime(done, (old, old))
+
+    bench._warn_stale_watcher_queues(str(tmp_path))
+    err = capsys.readouterr().err
+    assert "stale watcher queue" in err and "quarantined" in err
+    # In-band quarantine: the file stays put (consumers read it by name,
+    # and renaming would race a watcher that was merely slow) with an
+    # appended ABANDONED terminal marker; content preserved; the
+    # finished and the fresh (mid-run) logs untouched.
+    text = stale.read_text()
+    assert "| row |" in text and "ABANDONED" in text
+    assert "ALL DONE" in done.read_text().splitlines()[-1]
+    assert fresh.read_text() == "watcher start\n"
+
+    # Second run: the marker terminates the last start — noise is gone.
+    old = time.time() - 3600
+    os.utime(stale, (old, old))
+    bench._warn_stale_watcher_queues(str(tmp_path))
+    assert "stale watcher queue" not in capsys.readouterr().err
+
+    # A NEW watcher appending a fresh `start` re-arms detection.
+    with open(stale, "a") as f:
+        f.write("watcher start again\n")
+    os.utime(stale, (old, old))
+    bench._warn_stale_watcher_queues(str(tmp_path))
+    assert "stale watcher queue" in capsys.readouterr().err
+
+
+def test_explicit_probe_env_beats_replay_shortcuts(bench, capsys, monkeypatch):
+    # An operator forcing a fresh measurement (BENCH_PROBE_*) must get
+    # the full ladder even when a replay exists AND the tunnel is known
+    # down — neither shortcut may swallow the explicit request.
+    _seed_train_cache(bench, capsys, monkeypatch)
+    monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "5")
+    monkeypatch.setattr(bench, "_tunnel_known_down", lambda *a, **k: True)
+    seen = {}
+
+    def fake_probe(attempts=None, timeout=None):
+        seen["args"] = (attempts, timeout)
+        fake_probe.last_attempts = attempts or 5
+        return None
+
+    monkeypatch.setattr(bench, "probe_backend", fake_probe)
+    bench.main()
+    capsys.readouterr()
+    assert seen["args"] == (None, None)  # probe ran, env-driven ladder

@@ -1,0 +1,573 @@
+"""Shared-backbone multi-task serving (ISSUE 10): trunk/head split,
+trunk-once fan-out, AOT zero-compile request path, quantized variants.
+
+The acceptance pins live here:
+
+* a multi-task /predict answers ALL requested heads from ONE trunk run
+  (trunk-application counter == 1 per trace);
+* compiled cost_analysis FLOPs of a 3-task fan-out <= 0.5x the sum of
+  three single-task calls;
+* after warm-up, a CompileBudget window over a mixed single-/multi-task
+  request storm records ZERO traces/compiles;
+* bf16 variant picks identical to fp32 post-decode (parity gate);
+* the PR 1 single-task wire format is unchanged against the rewired
+  pool (tests/test_serve.py runs its full e2e on the same pool code).
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from seist_tpu.serve import aot
+from seist_tpu.serve.batcher import BatcherConfig, MicroBatcher, _slice_outputs
+from seist_tpu.serve.protocol import BadRequest, PredictOptions, parse_tasks
+
+WINDOW = 256
+TASKS = ("dpk", "emg", "dis")
+
+
+# ------------------------------------------------------------ model split
+def test_trunk_head_split_parity():
+    """full forward == head(backbone) bit-for-bit, per head family."""
+    import jax
+    import jax.numpy as jnp
+
+    import seist_tpu
+    from seist_tpu.models import api
+    from seist_tpu.models.seist import (
+        backbone_apply,
+        head_apply,
+        supports_trunk_split,
+    )
+
+    seist_tpu.load_all()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((2, 128, 3)).astype(np.float32)
+    )
+    for name in ("seist_s_dpk", "seist_s_emg", "seist_s_pmp"):
+        model = api.create_model(name, in_channels=3, in_samples=128)
+        assert supports_trunk_split(model)
+        variables = api.init_variables(
+            model, seed=0, in_samples=128, in_channels=3
+        )
+        full = model.apply(variables, x, train=False)
+        feats = backbone_apply(model, variables, x)
+        assert feats.shape[1] == 128 // 64  # stem /4, 4 stages /2 each
+        split = head_apply(model, variables, feats, x)
+        assert jax.tree_util.tree_all(
+            jax.tree_util.tree_map(
+                lambda a, b: jnp.array_equal(a, b), full, split
+            )
+        )
+
+
+def test_split_unknown_mode_and_missing_features_rejected():
+    import seist_tpu
+    from seist_tpu.models import api
+    from seist_tpu.models.seist import supports_trunk_split
+
+    seist_tpu.load_all()
+    model = api.create_model("seist_s_emg", in_channels=3, in_samples=128)
+    variables = api.init_variables(
+        model, seed=0, in_samples=128, in_channels=3
+    )
+    x = np.zeros((1, 128, 3), np.float32)
+    with pytest.raises(ValueError, match="unknown mode"):
+        model.apply(variables, x, train=False, mode="sideways")
+    with pytest.raises(ValueError, match="requires features"):
+        model.apply(variables, x, train=False, mode="head")
+    # phasenet has no split: groups must refuse it at load.
+    assert not supports_trunk_split(
+        api.create_model("phasenet", in_channels=3, in_samples=128)
+    )
+
+
+# ------------------------------------------------------------ group pool
+@pytest.fixture(scope="module")
+def group_service():
+    """One pool serving a 3-task seist_s group (fp32+bf16) AND a plain
+    phasenet entry — the mixed fleet the storm test exercises."""
+    from seist_tpu.serve.pool import ModelPool
+    from seist_tpu.serve.server import ServeService
+
+    pool = ModelPool(
+        [("phasenet", "")],
+        groups=[("seist_s", [(t, "") for t in TASKS])],
+        window=WINDOW,
+        variants=("fp32", "bf16"),
+    )
+    svc = ServeService(
+        pool, BatcherConfig(max_batch=2, max_delay_ms=5.0, max_queue=64)
+    )
+    yield svc, pool
+    svc.shutdown()
+
+
+@pytest.fixture(scope="module")
+def trace():
+    rng = np.random.default_rng(7)
+    return rng.standard_normal((WINDOW, 3)).astype(np.float32).tolist()
+
+
+def test_trunk_weights_shared_across_heads(group_service):
+    _, pool = group_service
+    entry = pool.get("seist_s")
+    trunk_leaves = entry.trunk_variables["params"]
+    for task in TASKS:
+        hv = entry.heads[task].variables["params"]
+        for key, val in trunk_leaves.items():
+            assert hv[key] is val  # same arrays, not copies
+        assert "out_head" in hv
+
+
+def test_multitask_predict_one_trunk_run_all_heads(group_service, trace):
+    svc, pool = group_service
+    entry = pool.get("seist_s")
+    before = entry.fanout_stats()
+    res = svc.predict(trace, model="seist_s", tasks=list(TASKS))
+    after = entry.fanout_stats()
+    # All requested heads answered, from ONE trunk execution.
+    assert sorted(res["tasks"]) == sorted(TASKS)
+    assert res["trunk_runs"] == 1
+    assert after["trunk_runs"] - before["trunk_runs"] == 1
+    for t in TASKS:
+        assert after["head_runs"][t] - before["head_runs"].get(t, 0) == 1
+    assert res["tasks"]["dpk"]["task"] == "picking"
+    assert res["tasks"]["emg"]["task"] == "regression"
+    # Amortization is accounted: 2 extra heads' trunk FLOPs were saved.
+    assert (
+        after["trunk_flops_saved"] > before["trunk_flops_saved"]
+    )
+
+
+def test_default_tasks_is_all_and_subset_respected(group_service, trace):
+    svc, _ = group_service
+    res = svc.predict(trace, model="seist_s")  # no tasks field
+    assert sorted(res["tasks"]) == sorted(TASKS)
+    res = svc.predict(trace, model="seist_s", tasks=["emg"])
+    assert list(res["tasks"]) == ["emg"]
+
+
+def test_unknown_task_and_single_task_model_rejected(group_service, trace):
+    svc, pool = group_service
+    with pytest.raises(BadRequest, match="does not serve tasks"):
+        svc.predict(trace, model="seist_s", tasks=["baz"])
+    with pytest.raises(BadRequest, match="single-task"):
+        svc.predict(trace, model="phasenet", tasks=["dpk"])
+    # and the resolve contract directly:
+    assert pool.get("seist_s").resolve_tasks(None) == TASKS
+
+
+def test_single_task_wire_format_unchanged(group_service, trace):
+    """PR 1 shape on the rewired pool: flat result, model key, no tasks
+    envelope."""
+    svc, _ = group_service
+    res = svc.predict(
+        trace, model="phasenet",
+        options={"ppk_threshold": 0.05, "spk_threshold": 0.05},
+    )
+    assert res["model"] == "phasenet"
+    assert res["task"] == "picking"
+    assert "tasks" not in res and "trunk_runs" not in res
+
+
+def test_fanout_flops_at_most_half_of_three_singles(group_service):
+    """The headline acceptance: compiled cost_analysis FLOPs of the
+    3-task fan-out (trunk + 3 heads) vs three single-task calls (each
+    trunk + head)."""
+    _, pool = group_service
+    entry = pool.get("seist_s")
+    trunk = entry.programs[("fp32", "trunk", 1)].flops
+    heads = {t: entry.programs[("fp32", t, 1)].flops for t in TASKS}
+    assert trunk > 0 and all(f > 0 for f in heads.values())
+    fanout_flops = trunk + sum(heads.values())
+    three_singles = sum(trunk + h for h in heads.values())
+    assert fanout_flops <= 0.5 * three_singles, (
+        f"fan-out {fanout_flops:.3g} > 0.5x singles {three_singles:.3g}"
+    )
+
+
+def test_storm_after_warmup_compiles_nothing(group_service, trace):
+    """AOT acceptance: a mixed single-/multi-task, fp32/bf16 request
+    storm after warm-up triggers ZERO jax traces — every forward is a
+    pre-compiled executable, every decode a warm program."""
+    from tools.jaxlint.runtime import CompileBudget
+
+    svc, _ = group_service
+    # Settle anything the fixture's own construction left pending.
+    svc.predict(trace, model="seist_s")
+    svc.predict(trace, model="phasenet")
+    svc.predict(trace, model="seist_s", options={"variant": "bf16"})
+
+    reqs = [
+        lambda: svc.predict(trace, model="seist_s", tasks=["dpk", "emg"]),
+        lambda: svc.predict(trace, model="seist_s", tasks=["emg"]),
+        lambda: svc.predict(trace, model="phasenet"),
+        lambda: svc.predict(trace, model="seist_s"),
+        lambda: svc.predict(
+            trace, model="seist_s", tasks=["dis"],
+            options={"variant": "bf16"},
+        ),
+    ] * 3
+    with CompileBudget() as budget:
+        with ThreadPoolExecutor(4) as ex:
+            results = [f.result() for f in [ex.submit(r) for r in reqs]]
+    assert len(results) == len(reqs)
+    assert budget.compiles == {}, (
+        f"request path compiled after warm-up: {budget.compiles}"
+    )
+
+
+def test_bf16_variant_picks_identical_post_decode(group_service, trace):
+    """Quantized-variant parity acceptance: bf16 answers the same
+    decoded picks (and regression values within float noise) as fp32."""
+    svc, pool = group_service
+    entry = pool.get("seist_s")
+    assert entry.variant_tasks["bf16"] == TASKS  # gate passed at load
+    r32 = svc.predict(trace, model="seist_s")
+    r16 = svc.predict(trace, model="seist_s", options={"variant": "bf16"})
+    assert r16["variant"] == "bf16"
+    for kind in ("ppk", "spk", "det"):
+        assert r32["tasks"]["dpk"].get(kind) == r16["tasks"]["dpk"].get(kind)
+    for t in ("emg", "dis"):
+        v32 = r32["tasks"][t][t]
+        v16 = r16["tasks"][t][t]
+        assert v16 == pytest.approx(v32, abs=1e-2)
+
+
+def test_variant_not_loaded_or_gated_is_400(group_service, trace):
+    svc, pool = group_service
+    with pytest.raises(BadRequest, match="variant 'int8' is not loaded"):
+        svc.predict(trace, model="seist_s", options={"variant": "int8"})
+    # A gate failure disables a loaded variant the same way:
+    entry = pool.get("seist_s")
+    saved = entry.variant_tasks["bf16"]
+    try:
+        entry.variant_tasks["bf16"] = ("emg",)  # dpk/dis "failed" parity
+        with pytest.raises(BadRequest, match="variant 'bf16'"):
+            svc.predict(
+                trace, model="seist_s", tasks=["dpk"],
+                options={"variant": "bf16"},
+            )
+        svc.predict(  # still served where it passed
+            trace, model="seist_s", tasks=["emg"],
+            options={"variant": "bf16"},
+        )
+    finally:
+        entry.variant_tasks["bf16"] = saved
+
+
+def test_loaded_variant_served_during_warmup_window(group_service, trace):
+    """A loaded variant must not bounce 400 while the async warm-up is
+    still computing parity gates — the pre-warm fallback contract fp32
+    gets applies to every LOADED variant (review finding: fleet rolls
+    were 400ing bf16 clients for the whole warm-up window). An UNLOADED
+    variant stays a 400 even then (it has no batcher at all)."""
+    svc, pool = group_service
+    entry = pool.get("seist_s")
+    saved_tasks = dict(entry.variant_tasks)
+    try:
+        svc._warming = True
+        entry.variant_tasks.pop("bf16", None)  # gates "not yet computed"
+        res = svc.predict(
+            trace, model="seist_s", tasks=["emg"],
+            options={"variant": "bf16"},
+        )
+        assert res["variant"] == "bf16"
+        with pytest.raises(BadRequest, match="not loaded"):
+            svc.predict(trace, model="seist_s", options={"variant": "int8"})
+    finally:
+        svc._warming = False
+        entry.variant_tasks.clear()
+        entry.variant_tasks.update(saved_tasks)
+
+
+def test_warmup_probes_do_not_inflate_fanout_accounting():
+    """trunk_runs / flops-saved counters measure SERVED traffic: a
+    freshly warmed group starts at zero (review finding: warm-up +
+    parity-gate probes were pre-charging the amortization stats that
+    bench_serve copies into its JSON)."""
+    from seist_tpu.serve.pool import load_group_entry
+
+    entry = load_group_entry(
+        "seist_s", [("emg", ""), ("dis", "")], window=128,
+        variants=("fp32", "bf16"),
+    )
+    entry.build_programs([1], [])  # includes the parity-gate probes
+    stats = entry.fanout_stats()
+    assert stats["trunk_runs"] == 0
+    assert stats["head_runs"] == {}
+    assert stats["trunk_flops_saved"] == 0.0
+    entry.fanout(np.zeros((1, 128, 3), np.float32), ("emg",))
+    assert entry.fanout_stats()["trunk_runs"] == 1
+
+
+def test_annotate_rejects_variant_selection(group_service):
+    svc, _ = group_service
+    rng = np.random.default_rng(5)
+    record = rng.standard_normal((WINDOW * 2, 3)).astype(np.float32)
+    with pytest.raises(BadRequest, match="/predict-only"):
+        svc.annotate(
+            record.tolist(), model="seist_s", options={"variant": "bf16"}
+        )
+
+
+def test_annotate_streams_through_group_trunk(group_service):
+    """/annotate on a group: sliding windows through trunk+dpk AOT."""
+    svc, pool = group_service
+    entry = pool.get("seist_s")
+    rng = np.random.default_rng(3)
+    record = rng.standard_normal((WINDOW * 3, 3)).astype(np.float32)
+    before = entry.fanout_stats()["trunk_runs"]
+    res = svc.annotate(record.tolist(), model="seist_s")
+    assert res["model"] == "seist_s"
+    assert res["windows"] >= 5
+    assert entry.fanout_stats()["trunk_runs"] > before
+
+
+def test_aot_compile_gauge_and_healthz_report(group_service):
+    from seist_tpu.obs.bus import BUS
+
+    svc, pool = group_service
+    assert BUS.gauge("serve_aot_compile_ms", model="seist_s").value > 0
+    assert BUS.gauge("serve_aot_programs", model="seist_s").value >= 16
+    # warm-up report carries per-program compile entries + decode warms
+    programs = [
+        r for r in pool.warmup_report
+        if r["model"] == "seist_s" and "program" in r
+    ]
+    assert len(programs) == 2 * 2 * (1 + len(TASKS))  # buckets x variants
+    decodes = [
+        r for r in pool.warmup_report
+        if str(r.get("batch", "")).startswith("decode")
+    ]
+    assert len(decodes) == len(TASKS) + 1  # per group task + phasenet
+    # and the metrics surface exposes the fan-out accounting
+    m = svc.metrics()
+    assert "seist_s" in m["fanout"]
+    assert set(m["models"]) >= {
+        "phasenet", "seist_s", "seist_s@bf16", "phasenet@bf16",
+    }
+
+
+# ------------------------------------------------------- batcher fan-out
+def test_batcher_unions_tasks_and_slices_dict_outputs():
+    """Task-blind batching: concurrent requests wanting different heads
+    coalesce into ONE forward over the UNION of their tasks."""
+    seen = []
+    release = threading.Event()
+
+    def forward(batch, tasks=None):
+        seen.append((batch.shape[0], tasks))
+        return {t: np.full((batch.shape[0], 2), ord(t[0])) for t in tasks}
+
+    b = MicroBatcher(
+        forward,
+        BatcherConfig(max_batch=2, max_delay_ms=40.0),
+        name="union-test",
+    )
+    try:
+        with ThreadPoolExecutor(2) as ex:
+            release.set()
+            f1 = ex.submit(
+                b.submit, np.zeros((4, 1)), 2000.0, 1, frozenset({"aa"})
+            )
+            f2 = ex.submit(
+                b.submit, np.zeros((4, 1)), 2000.0, 1, frozenset({"bb"})
+            )
+            r1, r2 = f1.result(), f2.result()
+        # One coalesced forward saw the union (or, under unlucky timing,
+        # two flushes each saw their own task set — never a mixed-up one).
+        for n, tasks in seen:
+            assert tasks is not None and tasks <= {"aa", "bb"}
+        for r in (r1, r2):
+            for t in r:
+                assert r[t].shape == (1, 2)
+        total = b.stats()
+        assert total["completed"] == 2
+    finally:
+        b.shutdown()
+
+
+def test_slice_outputs_handles_dicts():
+    out = {
+        "dpk": np.arange(12).reshape(3, 4),
+        "pmp": (np.arange(6).reshape(3, 2), np.arange(3).reshape(3, 1)),
+    }
+    s = _slice_outputs(out, 1)
+    assert s["dpk"].shape == (1, 4) and s["dpk"][0, 0] == 4
+    assert s["pmp"][0].shape == (1, 2) and s["pmp"][1].shape == (1, 1)
+
+
+# ----------------------------------------------------------- aot units
+def test_aot_compile_returns_flops_and_runs():
+    import jax.numpy as jnp
+
+    prog = aot.aot_compile(
+        "unit/matmul", lambda x: x @ x.T, [((8, 16), jnp.float32)],
+        model="unit",
+    )
+    assert prog.flops > 0
+    out = prog(np.ones((8, 16), np.float32))
+    assert np.asarray(out).shape == (8, 8)
+    assert prog.compile_ms > 0
+
+
+def test_quantize_int8_roundtrip_and_structure():
+    rng = np.random.default_rng(0)
+    variables = {
+        "params": {
+            "w": rng.standard_normal((16, 8)).astype(np.float32) * 3.0,
+            "b": rng.standard_normal((8,)).astype(np.float32),
+        }
+    }
+    packed = aot.quantize_int8(variables)
+    q = packed["params"]["w"]
+    assert set(q) == {"__int8__", "scale"}
+    assert np.asarray(q["__int8__"]).dtype == np.int8
+    # 1-D leaves stay fp32 untouched
+    assert np.array_equal(
+        np.asarray(packed["params"]["b"]), variables["params"]["b"]
+    )
+    restored = aot.dequantize(packed)
+    w = variables["params"]["w"]
+    # symmetric per-out-channel quant: error <= one step = scale
+    step = np.abs(w).max(axis=0) / 127.0
+    assert np.all(
+        np.abs(np.asarray(restored["params"]["w"]) - w) <= step + 1e-7
+    )
+
+
+def test_make_variant_apply_is_eager_and_casts_outputs():
+    import jax.numpy as jnp
+
+    w = np.full((4, 4), 2.0, np.float32)
+    calls = []
+
+    def apply_fn(variables, x):
+        calls.append(jnp.asarray(variables["w"]).dtype)
+        return x @ variables["w"]
+
+    x = np.ones((2, 4), np.float32)
+    for variant, want_dtype in (
+        ("fp32", jnp.float32),
+        ("bf16", jnp.bfloat16),
+        ("int8", jnp.float32),  # weight-only: dequantized to f32 compute
+    ):
+        fn = aot.make_variant_apply(apply_fn, {"w": w}, variant)
+        out = fn(jnp.asarray(x))
+        assert out.dtype == jnp.float32  # decode is variant-blind
+        assert np.allclose(np.asarray(out), x @ w, atol=0.1)
+        assert calls[-1] == want_dtype
+    with pytest.raises(ValueError, match="unknown variant"):
+        aot.make_variant_apply(apply_fn, {"w": w}, "fp8")
+
+
+def test_variant_parity_gate_decisions():
+    a = np.zeros((1, 32, 3), np.float32)
+    a[0, :, 0] = 0.9  # clear channel-0 winner
+    ok, _ = aot.variant_parity(a, a + 1e-3, "bf16", kind="soft")
+    assert ok
+    flipped = a.copy()
+    flipped[0, :, 1] = 1.5  # argmax flips everywhere
+    ok, _ = aot.variant_parity(a, flipped, "bf16", kind="soft")
+    assert not ok
+    big = a + 0.5  # same argmax, but way past abs tolerance
+    ok, _ = aot.variant_parity(a, big, "bf16", kind="soft")
+    assert not ok
+    # onehot: any argmax change fails
+    c = np.asarray([[0.2, 0.8]], np.float32)
+    assert aot.variant_parity(c, c + 1e-4, "int8", kind="onehot")[0]
+    assert not aot.variant_parity(
+        c, c[:, ::-1], "int8", kind="onehot"
+    )[0]
+    # value: relative to the head's output scale
+    v = np.asarray([[180.0]], np.float32)
+    assert aot.variant_parity(
+        v, v + 1.0, "bf16", kind="value", scale=360.0
+    )[0]
+    assert not aot.variant_parity(
+        v, v + 30.0, "bf16", kind="value", scale=360.0
+    )[0]
+
+
+# ------------------------------------------------------- protocol units
+def test_parse_tasks_validation():
+    assert parse_tasks(None) is None
+    assert parse_tasks(["dpk", "emg"]) == ("dpk", "emg")
+    for bad in ("dpk", [], [1], ["dpk", "dpk"], {"dpk": 1}):
+        with pytest.raises(BadRequest):
+            parse_tasks(bad)
+
+
+def test_variant_option_validated():
+    assert PredictOptions.from_dict({"variant": "bf16"}).variant == "bf16"
+    with pytest.raises(BadRequest, match="variant"):
+        PredictOptions.from_dict({"variant": "fp8"})
+    with pytest.raises(BadRequest):
+        PredictOptions.from_dict({"variant": 16})
+
+
+def test_parse_group_flags():
+    import argparse
+
+    from seist_tpu.serve.server import parse_group_flags
+
+    ns = argparse.Namespace(
+        model_group=["seist_s=dpk:ck1,emg", "seist_l=dis:ck2"]
+    )
+    assert parse_group_flags(ns) == [
+        ("seist_s", [("dpk", "ck1"), ("emg", "")]),
+        ("seist_l", [("dis", "ck2")]),
+    ]
+    for bad in (["seist_s"], ["=dpk"], ["seist_s="], ["seist_s=dpk,,"]):
+        with pytest.raises(SystemExit):
+            parse_group_flags(argparse.Namespace(model_group=bad))
+
+
+def test_group_loader_validation(monkeypatch):
+    from seist_tpu.serve import pool as pool_mod
+
+    with pytest.raises(ValueError, match="unknown task"):
+        pool_mod.load_group_entry("seist_s", [("xyz", "")], window=128)
+    with pytest.raises(ValueError, match="at least one task"):
+        pool_mod.load_group_entry("seist_s", [], window=128)
+    with pytest.raises(ValueError, match="duplicate task"):
+        pool_mod.load_group_entry(
+            "seist_s", [("emg", ""), ("emg", "")], window=128
+        )
+
+    # A model family without the trunk/head split must be refused at
+    # load, not crash at serve time: splice phasenet in as 'the model'.
+    def fake_parts(model_name, checkpoint, *, window, seed):
+        import seist_tpu
+        from seist_tpu import taskspec
+        from seist_tpu.models import api
+
+        seist_tpu.load_all()
+        model = api.create_model(
+            "phasenet", in_channels=3, in_samples=window
+        )
+        return (
+            model,
+            {"params": {}},
+            taskspec.get_task_spec("phasenet"),
+            3,
+            "non",
+        )
+
+    monkeypatch.setattr(pool_mod, "_load_parts", fake_parts)
+    with pytest.raises(ValueError, match="no trunk/head split"):
+        pool_mod.load_group_entry("seist_s", [("dpk", "")], window=128)
+
+
+def test_check_variants_normalization():
+    from seist_tpu.serve.pool import _check_variants
+
+    assert _check_variants(("bf16",)) == ("fp32", "bf16")
+    assert _check_variants(("fp32", "fp32", "int8")) == ("fp32", "int8")
+    with pytest.raises(ValueError, match="unknown variants"):
+        _check_variants(("fp4",))

@@ -406,3 +406,117 @@ def test_dropout_custom_vjp_matches_einsum_grads(rng, h):
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
             err_msg=f"d{name}",
         )
+
+
+# --------------------------------------------------- env surface (ISSUE 10)
+class TestEnvSurface:
+    """SEIST_ATTN_IMPL routing + kernel_status_summary() shape — the env
+    contract worker.py/bench.py rely on, previously exercised only
+    indirectly through worker runs."""
+
+    def test_unknown_impl_value_rejected(self, rng, monkeypatch):
+        monkeypatch.setenv("SEIST_ATTN_IMPL", "turbo")
+        q, k, v = _qkv(rng)
+        with pytest.raises(ValueError, match="unknown SEIST_ATTN_IMPL"):
+            fused_pooled_attention(q, k, v)
+
+    def test_einsum_forces_xla_path(self, rng, monkeypatch):
+        # =einsum must bypass the kernel entirely, even where the kernel
+        # would be chosen: a booby-trapped _fused proves it is not called.
+        from seist_tpu.ops import pallas_attention as pa
+
+        monkeypatch.setenv("SEIST_ATTN_IMPL", "einsum")
+        monkeypatch.setattr(
+            pa, "_fused",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("kernel path taken under =einsum")
+            ),
+        )
+        q, k, v = _qkv(rng)
+        want = np.asarray(
+            _einsum_attention(q, k, v, 1.0 / np.sqrt(q.shape[-1]))
+        )
+        got = np.asarray(fused_pooled_attention(q, k, v))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_einsum_yields_to_explicit_kernel_request(self, rng, monkeypatch):
+        # Parity tooling's interpret/force beats the ambient env var.
+        from seist_tpu.ops import pallas_attention as pa
+
+        monkeypatch.setenv("SEIST_ATTN_IMPL", "einsum")
+        called = {}
+
+        def spy(q3, k3, v3, seed, scale, rate, h, interpret):
+            called["interpret"] = interpret
+            return pa._einsum_attention(
+                q3.reshape(q3.shape[0], q3.shape[1], h, -1),
+                k3.reshape(k3.shape[0], k3.shape[1], h, -1),
+                v3.reshape(v3.shape[0], v3.shape[1], h, -1),
+                scale,
+            ).reshape(q3.shape)
+
+        monkeypatch.setattr(pa, "_fused", spy)
+        q, k, v = _qkv(rng)
+        fused_pooled_attention(q, k, v, interpret=True)
+        assert called == {"interpret": True}
+
+    def test_fused_forces_kernel_skipping_probe(self, rng, monkeypatch):
+        # =fused must reach _fused without consulting the health probe
+        # (a Mosaic rejection is supposed to surface raw).
+        from seist_tpu.ops import pallas_attention as pa
+
+        monkeypatch.setenv("SEIST_ATTN_IMPL", "fused")
+        monkeypatch.setattr(pa, "_on_tpu", lambda: True)
+        monkeypatch.setattr(
+            pa, "_kernel_usable",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("probe consulted under =fused")
+            ),
+        )
+        called = {}
+
+        def spy(q3, k3, v3, seed, scale, rate, h, interpret):
+            called["hit"] = True
+            return q3
+
+        monkeypatch.setattr(pa, "_fused", spy)
+        q, k, v = _qkv(rng)
+        out = fused_pooled_attention(q, k, v)
+        assert called == {"hit": True}
+        assert out.shape == q.shape
+
+    def test_kernel_status_summary_unprobed(self, monkeypatch):
+        from seist_tpu.ops import pallas_attention as pa
+
+        monkeypatch.setattr(pa, "_KERNEL_EVENTS", {})
+        assert pa.kernel_status_summary() == {
+            "overall": "unprobed", "signatures": {},
+        }
+
+    def test_kernel_status_summary_shape_and_overall(self, monkeypatch):
+        from seist_tpu.ops import pallas_attention as pa
+
+        key_a = (512, 16, 96, 8, False, "bf16")
+        key_b = (1024, 128, 24, 3, True, "f32")
+        monkeypatch.setattr(
+            pa, "_KERNEL_EVENTS", {key_a: "fused", key_b: "fused"}
+        )
+        s = pa.kernel_status_summary()
+        assert set(s) == {"overall", "signatures"}
+        assert s["overall"] == "fused"
+        assert s["signatures"] == {
+            "L512/M16/HE96/H8/drop=False/bf16": "fused",
+            "L1024/M128/HE24/H3/drop=True/f32": "fused",
+        }
+        # ANY non-fused signature (including a transient-tagged one)
+        # degrades the overall verdict — bench's `degraded` flag hangs
+        # off this exact contract.
+        monkeypatch.setattr(
+            pa,
+            "_KERNEL_EVENTS",
+            {key_a: "fused",
+             key_b: "einsum-fallback (transient RESOURCE_EXHAUSTED)"},
+        )
+        s = pa.kernel_status_summary()
+        assert s["overall"] == "einsum-fallback"
+        assert "transient" in s["signatures"]["L1024/M128/HE24/H3/drop=True/f32"]

@@ -77,6 +77,15 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     ap.add_argument("--priority", default="",
                     help="request tier: alert | interactive | batch "
                     "(empty = service default)")
+    ap.add_argument("--tasks", default="",
+                    help="comma-separated task heads for multi-task "
+                    "fan-out (e.g. dpk,emg,dis): --model-name is then a "
+                    "SeisT group prefix (e.g. seist_s) served on one "
+                    "shared trunk; every response is checked to contain "
+                    "ALL requested heads (missing_head error otherwise)")
+    ap.add_argument("--variant", default="",
+                    help="serving weight variant (fp32 | bf16 | int8); "
+                    "in-process mode loads fp32 + the requested variant")
     ap.add_argument("--arrival-rps", type=float, default=0.0,
                     help="open-loop arrival rate (0 = closed loop)")
     ap.add_argument("--slo-p99-ms", type=float, default=0.0,
@@ -162,6 +171,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     options: Dict[str, Any] = {"timeout_ms": args.timeout_ms}
     if args.priority:
         options["priority"] = args.priority
+    if args.variant:
+        options["variant"] = args.variant
+    tasks = [t for t in args.tasks.split(",") if t] if args.tasks else None
 
     service = None
     if args.url:
@@ -172,16 +184,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             payload = {"data": trace, "options": options}
             if args.model_name:
                 payload["model"] = args.model_name
+            if tasks:
+                payload["tasks"] = tasks
             return call(payload)
 
     else:
         from seist_tpu.serve import BatcherConfig, ModelPool, ServeService
         from seist_tpu.serve.protocol import ServeError
 
-        pool = ModelPool(
-            [(args.model_name, args.checkpoint)], window=args.window,
-            seed=args.seed,
-        )
+        variants = ("fp32",) + ((args.variant,) if args.variant else ())
+        if tasks:
+            # Multi-task fan-out: --model-name is the SeisT group prefix;
+            # one shared trunk serves every requested head.
+            pool = ModelPool(
+                groups=[(args.model_name, [(t, "") for t in tasks])],
+                window=args.window, seed=args.seed, variants=variants,
+            )
+        else:
+            pool = ModelPool(
+                [(args.model_name, args.checkpoint)], window=args.window,
+                seed=args.seed, variants=variants,
+            )
         service = ServeService(
             pool,
             BatcherConfig(
@@ -192,13 +215,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         entry = pool.get(args.model_name)
         in_channels = entry.in_channels
-        if entry.is_picker:
+        if entry.is_picker and not tasks:
             options.update(ppk_threshold=0.05, spk_threshold=0.05)
 
         def one_request(trace) -> Any:
             try:
-                service.predict(trace, options=options)
-                return 200, {}
+                return 200, service.predict(
+                    trace, options=options, tasks=tasks
+                )
             except ServeError as e:
                 return e.status, e.payload()
 
@@ -223,6 +247,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # neither ok nor error and the SLO gate reads a fake pass.
                 status, body = 0, {"error": "client_exception",
                                    "message": repr(e)}
+        if status == 200 and tasks:
+            # Multi-task acceptance: a 200 that silently dropped a head
+            # is an error, not a success — the fan-out contract is that
+            # ONE trunk run answers EVERY requested head.
+            answered = body.get("tasks") or {}
+            if sorted(answered) != sorted(tasks):
+                status = 0
+                body = {"error": "missing_head",
+                        "message": f"answered {sorted(answered)} of "
+                                   f"{sorted(tasks)}"}
         if status == 200:
             stats.success(elapsed() * 1000.0)
         else:
@@ -240,8 +274,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     wall_s = wall()
 
     batcher_stats: Dict[str, Any] = {}
+    fanout_stats: Dict[str, Any] = {}
     if service is not None:
-        batcher_stats = service.metrics()["models"][args.model_name]
+        metrics = service.metrics()
+        key = args.model_name
+        if args.variant and args.variant != "fp32":
+            key = f"{args.model_name}@{args.variant}"
+        batcher_stats = metrics["models"][key]
+        fanout_stats = metrics.get("fanout", {}).get(args.model_name, {})
         service.shutdown()
 
     lat = np.asarray(stats.latencies_ms) if stats.latencies_ms else None
@@ -268,6 +308,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "concurrency": args.concurrency,
         "arrival_rps": args.arrival_rps,
         "priority": args.priority or "default",
+        "tasks": tasks or [],
+        "variant": args.variant or "fp32",
         "max_batch": args.max_batch,
         "max_delay_ms": args.max_delay_ms,
         "p50_ms": pct(50),
@@ -291,8 +333,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         result["forwards"] = batcher_stats["forwards"]
         result["completed"] = batcher_stats["completed"]
+    if fanout_stats:
+        result["trunk_runs"] = fanout_stats.get("trunk_runs", 0)
+        result["head_runs"] = fanout_stats.get("head_runs", {})
+        result["trunk_flops_saved"] = fanout_stats.get(
+            "trunk_flops_saved", 0.0
+        )
 
     rc = 0
+    if tasks:
+        missing = stats.by_code.get("missing_head", 0)
+        result["fanout_complete"] = missing == 0 and stats.ok > 0
+        if not result["fanout_complete"]:
+            print(
+                f"[bench_serve] FAN-OUT INCOMPLETE: {missing} responses "
+                f"missing heads, {stats.ok} complete",
+                file=sys.stderr, flush=True,
+            )
+            rc = 1
     if args.slo_p99_ms > 0:
         violations = []
         if lat is None:
