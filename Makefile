@@ -5,7 +5,7 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
 .PHONY: native test t1 lint lint-baseline lockgraph serve-smoke \
-	serve-chaos obs-smoke chaos clean
+	serve-chaos obs-smoke trace-smoke chaos clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -78,6 +78,15 @@ serve-smoke:
 	JAX_PLATFORMS=cpu python tools/bench_serve.py --model-name seist_s \
 		--tasks dpk,emg,dis --window 256 --requests 12 --concurrency 4 \
 		--max-batch 4
+
+# Distributed-tracing smoke (docs/OBSERVABILITY.md "Distributed
+# tracing"): 2-replica fleet + router under bench_serve with hedging
+# forced on every request; a hedged request's stitched cross-process
+# trace (tools/trace_report.py) must total within 10% of the
+# client-observed latency, carry queue-wait + device-program spans, and
+# GET /fleet/metrics.json must aggregate router + both replicas.
+trace-smoke:
+	JAX_PLATFORMS=cpu python tools/trace_smoke.py
 
 # Serving chaos lane (docs/FAULT_TOLERANCE.md "Serving faults"): real
 # replica subprocesses under SEIST_FAULT_SERVE_* — SIGKILL-mid-load with

@@ -9,11 +9,16 @@ Three pillars, one import:
   jaxpr walk + roofline time shares behind BENCH's ``step_breakdown``.
 * **Flight recorder** (:mod:`~seist_tpu.obs.flight`): ring buffer of the
   last N steps' metrics/spans, dumped to JSON on every death path.
+* **Distributed request tracing** (:mod:`~seist_tpu.obs.trace`):
+  W3C-``traceparent`` IDs propagated across the serving fleet, per-process
+  span rings with tail-based retention, ``GET /traces`` exposition.
+* **Fleet metrics aggregation** (:mod:`~seist_tpu.obs.fleet`): merge N
+  replicas' bus snapshots into one ``GET /fleet/metrics`` pane.
 
 ``obs/http.py`` serves the bus on the train worker's ``--metrics-port``.
 """
 
-from seist_tpu.obs import flight
+from seist_tpu.obs import flight, trace
 from seist_tpu.obs.attribution import attribute_step, jaxpr_op_costs
 from seist_tpu.obs.bus import (
     BUS,
@@ -30,6 +35,7 @@ from seist_tpu.obs.http import (
     ProfileTrigger,
     start_metrics_server,
 )
+from seist_tpu.obs.trace import RequestTrace, TraceBuffer
 
 __all__ = [
     "BUS",
@@ -38,6 +44,8 @@ __all__ = [
     "MetricsBus",
     "MetricsHTTPServer",
     "ProfileTrigger",
+    "RequestTrace",
+    "TraceBuffer",
     "attribute_step",
     "flight",
     "jaxpr_op_costs",
@@ -46,4 +54,5 @@ __all__ = [
     "start_metrics_server",
     "stopwatch",
     "timed_iter",
+    "trace",
 ]

@@ -296,7 +296,11 @@ class MetricsBus:
     # ----------------------------------------------------------- snapshot
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able view of everything on the bus (the /metrics.json
-        payload and the flight recorder's final-state stamp)."""
+        payload and the flight recorder's final-state stamp). Histogram
+        entries carry their raw buckets (``bounds`` + ``bucket_counts``)
+        on top of the summary so the fleet aggregator (obs/fleet.py) can
+        merge replicas' histograms bucket-wise instead of averaging
+        percentiles (which is statistically meaningless)."""
         with self._lock:
             metrics = list(self._metrics.values())
         out: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
@@ -307,7 +311,12 @@ class MetricsBus:
             elif isinstance(m, Gauge):
                 out["gauges"][m.name + label_sfx] = m.value
             elif isinstance(m, Histogram):
-                out["histograms"][m.name + label_sfx] = m.summary()
+                entry = m.summary()
+                bounds, counts, _, total_sum = m.buckets()
+                entry["bounds"] = bounds
+                entry["bucket_counts"] = counts
+                entry["sum"] = total_sum
+                out["histograms"][m.name + label_sfx] = entry
         out["collectors"] = {
             name + _label_suffix(labels): value
             for name, labels, value in self._collect()

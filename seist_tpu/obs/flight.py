@@ -145,12 +145,19 @@ class FlightRecorder:
         """Write the JSON dump; returns the path (None when the write
         itself failed — death paths must still exit)."""
         if path is None:
+            # The replica ordinal (SEIST_SERVE_REPLICA) disambiguates N
+            # fleet members sharing one --logdir; pid+seq already keeps
+            # relaunched attempts apart.
+            from seist_tpu.obs.trace import replica_suffix
+
             d = os.path.join(logger.logdir(), "flight")
             with self._lock:
                 self._dump_seq += 1
                 seq = self._dump_seq
             path = os.path.join(
-                d, f"flight_{_slug(reason)}_{os.getpid()}_{seq}.json"
+                d,
+                f"flight_{_slug(reason)}{replica_suffix()}"
+                f"_{os.getpid()}_{seq}.json",
             )
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -236,6 +243,11 @@ def dump_on_death(
         and now - _LAST_DUMP_MONO < dedup_s
     ):
         return None
+    if "path" in fields:
+        # ``path`` is :meth:`FlightRecorder.dump`'s file-location
+        # parameter — a payload field of that name would silently
+        # redirect the dump file to an arbitrary location. Remap it.
+        fields["path_field"] = fields.pop("path")
     try:
         path = rec.dump(reason, **fields)
     except Exception:  # noqa: BLE001 - death path: the exit must proceed

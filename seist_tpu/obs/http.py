@@ -12,6 +12,8 @@ Endpoints::
     GET  /metrics        Prometheus text exposition (bus + collectors)
     GET  /metrics.json   JSON snapshot of the bus
     GET  /flight         live flight-recorder ring (no file written)
+    GET  /traces         distributed-trace index (obs/trace.py ring)
+    GET  /traces/<id>    one trace's span segments (this process)
     POST /profile[?steps=N]  request a profiler capture (default 5 steps)
     GET  /healthz        {"status": "ok"} liveness
 
@@ -121,6 +123,20 @@ class _Handler(BaseHTTPRequestHandler):
                         200,
                         _json_bytes(rec.payload("live")),
                         "application/json",
+                    )
+            elif parsed.path.startswith("/traces"):
+                from seist_tpu.obs import trace as trace_mod
+
+                routed = trace_mod.handle_traces_path(self.path)
+                if routed is None:
+                    self._reply(
+                        404, _json_bytes({"error": "not_found"}),
+                        "application/json",
+                    )
+                else:
+                    status, payload = routed
+                    self._reply(
+                        status, _json_bytes(payload), "application/json"
                     )
             elif parsed.path == "/healthz":
                 self._reply(200, _json_bytes({"status": "ok"}), "application/json")

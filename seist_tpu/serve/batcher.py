@@ -48,6 +48,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from seist_tpu.obs import trace as obs_trace
 from seist_tpu.serve.protocol import (
     DeadlineExceeded,
     QueueFull,
@@ -94,7 +95,7 @@ class BatcherConfig:
 
 class _Pending:
     __slots__ = ("x", "enqueued_at", "deadline", "event", "result", "error",
-                 "abandoned", "rank", "tasks")
+                 "abandoned", "rank", "tasks", "trace")
 
     def __init__(
         self,
@@ -102,6 +103,7 @@ class _Pending:
         deadline: float,
         rank: int = 1,
         tasks: Optional[frozenset] = None,
+        trace: Optional[Any] = None,
     ):
         self.x = x
         self.enqueued_at = time.monotonic()
@@ -112,6 +114,7 @@ class _Pending:
         self.abandoned = False  # caller gave up; skip at flush time
         self.rank = rank  # flush order: lower rank first, FIFO within
         self.tasks = tasks  # multi-task fan-out: heads this caller wants
+        self.trace = trace  # obs.trace.RequestTrace (None = untraced)
 
 
 class MicroBatcher:
@@ -176,6 +179,7 @@ class MicroBatcher:
         timeout_ms: float = 5000.0,
         rank: int = 1,
         tasks: Optional[frozenset] = None,
+        trace: Optional[Any] = None,
     ) -> Any:
         """Block until the trace's batch is served; returns the per-item
         output slice. Raises QueueFull / DeadlineExceeded / ShuttingDown.
@@ -194,11 +198,18 @@ class MicroBatcher:
         runs the shared trunk once and fans out to the UNION of its
         items' tasks — the forward is then called ``forward(batch,
         tasks)`` and must return ``{task: outputs}``; each caller's
-        slice keeps every task in the union (decode picks its own)."""
+        slice keeps every task in the union (decode picks its own).
+
+        ``trace`` (obs/trace.RequestTrace) makes the queueing visible on
+        the request's distributed trace: the flush thread records a
+        ``queue_wait`` child (enqueue -> flush start, annotated with the
+        flush ordinal / bucket / occupancy) and a shared ``forward``
+        child carrying whatever serve/pool.py annotated on the flush
+        scope (program key, AOT-hit, variant)."""
         t0 = time.monotonic()
         item = _Pending(
             np.asarray(x), deadline=t0 + timeout_ms / 1000.0, rank=rank,
-            tasks=tasks,
+            tasks=tasks, trace=trace,
         )
         with self._cond:
             if self._fatal is not None:
@@ -263,6 +274,15 @@ class MicroBatcher:
                         item.event.set()
                 self._queue.clear()
                 self._inflight = []
+            # A dead flush thread is a replica death sentence (the
+            # watchdog exits 1); leave the forensic record the train
+            # plane's death paths leave — no-op when no recorder is
+            # installed (offline tools, bare batcher tests).
+            from seist_tpu.obs import flight
+
+            flight.dump_on_death(
+                "batcher_flush_death", batcher=self.name, error=repr(e)
+            )
 
     def _loop_inner(self) -> None:
         while True:
@@ -300,11 +320,18 @@ class MicroBatcher:
         now = time.monotonic()
         live: List[_Pending] = []
         with self._cond:
+            flush_id = self._forwards + 1
             for item in pending:
                 if item.abandoned:
                     continue  # caller already raised DeadlineExceeded
                 if item.deadline < now:
                     self._expired += 1
+                    if item.trace is not None:
+                        item.trace.add_child(
+                            "queue_wait",
+                            (now - item.enqueued_at) * 1e3,
+                            expired=True,
+                        )
                     item.error = DeadlineExceeded(
                         "expired while queued (server overloaded?)"
                     )
@@ -328,16 +355,42 @@ class MicroBatcher:
             frozenset().union(*task_sets) if task_sets else None
         )
         t_fwd0 = time.monotonic()
+        # Queue-wait becomes a trace span per member: enqueue -> flush
+        # start, annotated with which flush wave served it and how full
+        # the bucket ran.
+        for item in live:
+            if item.trace is not None:
+                item.trace.add_child(
+                    "queue_wait",
+                    (t_fwd0 - item.enqueued_at) * 1e3,
+                    flush=flush_id,
+                    bucket=bucket,
+                    batch_n=n,
+                )
         try:
-            out = (
-                self._forward(batch)
-                if union is None
-                else self._forward(batch, union)
-            )
+            # The flush scope carries the member traces through the
+            # forward so pool programs can annotate the shared span
+            # (program key / AOT-hit / variant) without plumbing.
+            with obs_trace.flush_scope(
+                [item.trace for item in live]
+            ) as scope:
+                out = (
+                    self._forward(batch)
+                    if union is None
+                    else self._forward(batch, union)
+                )
         except Exception as e:  # noqa: BLE001 — must not kill the worker
             err = e if isinstance(e, ServeError) else ServeError(
                 f"forward failed: {e!r}"
             )
+            for item in live:
+                if item.trace is not None:
+                    item.trace.add_child(
+                        "forward",
+                        (time.monotonic() - t_fwd0) * 1e3,
+                        flush=flush_id,
+                        error=type(e).__name__,
+                    )
             with self._cond:  # same atomicity argument as the success path
                 for item in live:
                     item.error = err
@@ -351,6 +404,16 @@ class MicroBatcher:
         # device boundary again. Multi-task forwards return {task: out}.
         out = _materialize(out)
         flush_ms = (time.monotonic() - t_fwd0) * 1e3
+        for item in live:
+            if item.trace is not None:
+                item.trace.add_child(
+                    "forward",
+                    flush_ms,
+                    flush=flush_id,
+                    bucket=bucket,
+                    occupancy=round(n / bucket, 3),
+                    **scope.annotations,
+                )
         with self._cond:
             self._forwards += 1
             self._batch_items += n

@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from seist_tpu.obs import trace as obs_trace
 from seist_tpu.serve import aot
 from seist_tpu.serve.batcher import _slice_outputs
 from seist_tpu.serve.protocol import (
@@ -158,10 +159,19 @@ class ModelEntry:
 
     def run(self, batch: np.ndarray, variant: str = "fp32") -> Any:
         """The request-path forward: AOT executable when one matches the
-        batch shape (zero tracing), live-jit fallback otherwise."""
+        batch shape (zero tracing), live-jit fallback otherwise. Inside a
+        batcher flush the served program + AOT-hit land on the flush's
+        shared trace span (obs/trace.annotate_flush — no-op otherwise)."""
         prog = self.programs.get(variant, {}).get(int(batch.shape[0]))
         if prog is not None:
+            obs_trace.annotate_flush(
+                program=prog.key, aot=True, variant=variant
+            )
             return prog(batch)
+        obs_trace.annotate_flush(
+            program=f"{self.name}/full/b{int(batch.shape[0])}/{variant}:jit",
+            aot=False, variant=variant,
+        )
         import jax.numpy as jnp
 
         return self._fallback(variant)(jnp.asarray(batch))
@@ -412,12 +422,26 @@ class MultiTaskEntry:
             feats = self._fallback("trunk", variant)(jnp.asarray(batch))
             trunk_flops = 0.0
         outs: Dict[str, Any] = {}
+        aot_heads = True
         for t in tasks:
             head_prog = self.programs.get((variant, t, b))
             if head_prog is not None:
                 outs[t] = head_prog(feats, batch)
             else:
+                aot_heads = False
                 outs[t] = self._fallback(t, variant)(feats, batch)
+        # Inside a batcher flush: the trunk-once fan-out becomes visible
+        # on every member request's trace (no-op otherwise).
+        obs_trace.annotate_flush(
+            program=(
+                trunk_prog.key
+                if trunk_prog is not None
+                else f"{self.name}/trunk/b{b}/{variant}:jit"
+            ),
+            aot=trunk_prog is not None and aot_heads,
+            variant=variant,
+            heads=",".join(tasks),
+        )
         if account:
             self._account(tuple(tasks), trunk_flops)
         return outs

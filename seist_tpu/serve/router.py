@@ -66,6 +66,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from queue import Empty, Queue
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from seist_tpu.obs import trace as obs_trace
 from seist_tpu.utils.logger import logger
 
 # Breaker states (also the value of the router_breaker_state gauge).
@@ -383,6 +384,21 @@ def _classify(outcome: _Outcome) -> Tuple[bool, bool]:
     return False, False  # 2xx, 4xx, 504
 
 
+def _classify_label(outcome: _Outcome) -> str:
+    """Human-readable classification for the attempt's trace span —
+    the module-docstring table's row name."""
+    if outcome.is_net_error:
+        return "net_error"
+    failure, retryable = _classify(outcome)
+    if outcome.status == 503 and outcome.error_code() == "shed":
+        return "shed_not_retried"
+    if failure:
+        return "server_error"
+    if retryable:
+        return "backpressure_retryable"
+    return "ok" if outcome.status < 400 else "relayed"
+
+
 class Router:
     """Transport-free routing core (the HTTP shim below is ~50 lines):
     ``forward()`` runs the pick → attempt → classify → retry/hedge loop
@@ -473,9 +489,34 @@ class Router:
 
     # ------------------------------------------------------------ forwarding
     def forward(
-        self, path: str, body: bytes
+        self, path: str, body: bytes, traceparent: Optional[str] = None
     ) -> Tuple[int, Dict[str, str], bytes]:
-        """Route one inference request; returns (status, headers, body)."""
+        """Route one inference request; returns (status, headers, body).
+
+        ``traceparent`` continues the client's distributed trace (the
+        router mints one when the client didn't — it is the fleet edge):
+        every attempt becomes a span in the router's trace ring
+        (replica, breaker state, classification), retries/hedges flag
+        the trace for tail retention, and the response carries the
+        router's ``Server-Timing`` total plus the ``traceparent`` echo."""
+        rt = obs_trace.RequestTrace(
+            traceparent, name=f"router:{path}", process="router"
+        )
+        status, headers, payload = self._forward_routed(path, body, rt)
+        total_ms = rt.finish(status)
+        headers = dict(headers)
+        upstream_timing = headers.pop("Server-Timing", None)
+        timing = f"router;dur={total_ms:.1f}"
+        headers["Server-Timing"] = (
+            f"{timing}, {upstream_timing}" if upstream_timing else timing
+        )
+        headers[obs_trace.TRACEPARENT_HEADER] = rt.traceparent
+        return status, headers, payload
+
+    def _forward_routed(
+        self, path: str, body: bytes, rt: obs_trace.RequestTrace
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        """The pick -> attempt -> classify -> retry/hedge loop."""
         self._bus.counter("router_requests", path=path.lstrip("/")).inc()
         deadline = time.monotonic() + self._budget_s(body)
         tried: Set[str] = set()
@@ -493,15 +534,18 @@ class Router:
             attempts_left -= 1
             if tried:  # anything after the first attempt is a retry
                 self._bus.counter("router_retries").inc()
+                rt.flag("retried")
             tried.add(replica.url)
             if self.config.hedge_ms > 0:
                 outcome, replica, attempts_left, pre_settled = (
                     self._attempt_hedged(
-                        replica, path, body, deadline, tried, attempts_left
+                        replica, path, body, deadline, tried,
+                        attempts_left, rt,
                     )
                 )
             else:
-                outcome = self._attempt(replica, path, body, deadline)
+                outcome = self._attempt(replica, path, body, deadline,
+                                        rt=rt)
                 pre_settled = False
             if pre_settled:
                 # The hedged path already fed this outcome to its
@@ -510,11 +554,19 @@ class Router:
             else:
                 _, retryable = self._settle(replica, outcome)
             if not retryable:
+                if (
+                    outcome.status == 503
+                    and outcome.error_code() == "shed"
+                ):
+                    # A relayed shed verdict is deliberate policy, not a
+                    # router failure — its own retention flag.
+                    rt.flag("shed")
                 return self._relay(outcome)
             last = outcome
         if last is not None:
             return self._relay(last)
         self._bus.counter("router_no_replica").inc()
+        rt.annotate(no_replica=True)
         return (
             503,
             {},
@@ -551,32 +603,70 @@ class Router:
         return outcome.status, outcome.headers, outcome.body
 
     def _attempt(
-        self, replica: Replica, path: str, body: bytes, deadline: float
+        self,
+        replica: Replica,
+        path: str,
+        body: bytes,
+        deadline: float,
+        rt: Optional[obs_trace.RequestTrace] = None,
+        hedge: bool = False,
     ) -> _Outcome:
         timeout_s = min(
             self.config.request_timeout_s,
             max(0.05, deadline - time.monotonic()),
         )
+        # The attempt's span id is minted BEFORE the request so the
+        # downstream replica's server span can parent to it — the header
+        # carries (trace_id, attempt_span_id); the span itself is
+        # recorded once the outcome is known.
+        span_id: Optional[str] = None
+        headers: Optional[Dict[str, str]] = None
+        breaker_state = replica.breaker.state
+        if rt is not None:
+            span_id = obs_trace._new_span_id()
+            headers = {
+                obs_trace.TRACEPARENT_HEADER: obs_trace.format_traceparent(
+                    rt.trace_id, span_id
+                )
+            }
         t0 = time.monotonic()
         try:
-            status, headers, payload = _http_request(
-                replica.url, "POST", path, body=body, timeout_s=timeout_s
+            status, resp_headers, payload = _http_request(
+                replica.url, "POST", path, body=body, timeout_s=timeout_s,
+                headers=headers,
             )
-            return _Outcome(
+            outcome = _Outcome(
                 status,
-                headers,
+                resp_headers,
                 payload,
                 latency_ms=(time.monotonic() - t0) * 1e3,
             )
         except socket.timeout:
-            return _Outcome(0, {}, b"", error="timeout")
+            outcome = _Outcome(0, {}, b"", error="timeout")
         except (OSError, http.client.HTTPException) as e:
             # RemoteDisconnected/BadStatusLine are HTTPException (a
             # SIGKILLed replica's half-written response), the rest OSError.
             msg = f"{type(e).__name__}: {e}"
             if "timed out" in str(e):
                 msg = f"timeout ({msg})"
-            return _Outcome(0, {}, b"", error=msg)
+            outcome = _Outcome(0, {}, b"", error=msg)
+        if rt is not None:
+            ann: Dict[str, Any] = {
+                "replica": replica.url,
+                "breaker": breaker_state,
+                "class": _classify_label(outcome),
+            }
+            if hedge:
+                ann["hedge"] = True
+            if outcome.is_net_error:
+                ann["error"] = outcome.error
+            else:
+                ann["status"] = outcome.status
+            rt.add_child(
+                "attempt", (time.monotonic() - t0) * 1e3,
+                span_id=span_id, **ann,
+            )
+        return outcome
 
     def _attempt_hedged(
         self,
@@ -586,6 +676,7 @@ class Router:
         deadline: float,
         tried: Set[str],
         attempts_left: int,
+        rt: Optional[obs_trace.RequestTrace] = None,
     ) -> Tuple[_Outcome, Replica, int, bool]:
         """Race the primary against a late-started hedge on another
         replica; first non-retryable outcome wins. The hedge consumes one
@@ -597,14 +688,16 @@ class Router:
         to its breaker here and the caller must not settle it again."""
         results: "Queue[Tuple[_Outcome, Replica]]" = Queue()
 
-        def run(replica: Replica) -> None:
+        def run(replica: Replica, hedge: bool = False) -> None:
             # The waiter blocks on `results`: an attempt thread dying
             # without putting would stall the race to the full deadline,
             # so any surprise becomes a poisoned net-error outcome
             # (threadlint thread-target-raises).
             try:
                 results.put((
-                    self._attempt(replica, path, body, deadline), replica
+                    self._attempt(replica, path, body, deadline, rt=rt,
+                                  hedge=hedge),
+                    replica,
                 ))
             except BaseException as e:  # noqa: BLE001
                 results.put((
@@ -631,8 +724,10 @@ class Router:
             attempts_left -= 1
             tried.add(hedge.url)
             self._bus.counter("router_hedges").inc()
+            if rt is not None:
+                rt.flag("hedged")
             threading.Thread(
-                target=run, args=(hedge,), daemon=True,
+                target=run, args=(hedge, True), daemon=True,
                 name="router-hedge",
             ).start()
             launched.append(hedge)
@@ -751,19 +846,25 @@ def _http_request(
     path: str,
     body: Optional[bytes] = None,
     timeout_s: float = 10.0,
+    headers: Optional[Dict[str, str]] = None,
 ) -> Tuple[int, Dict[str, str], bytes]:
     """One HTTP exchange against ``base_url`` (``host:port`` or
     ``http://host:port``); returns (status, headers, body). Raises
-    OSError subclasses (incl. socket.timeout) on network failure."""
+    OSError subclasses (incl. socket.timeout) on network failure.
+    ``headers`` adds request headers (trace propagation)."""
     hostport = base_url.split("://", 1)[-1].rstrip("/")
     conn = http.client.HTTPConnection(hostport, timeout=timeout_s)
     try:
-        headers = {"Content-Type": "application/json"} if body else {}
-        conn.request(method, path, body=body, headers=headers)
+        send_headers = {"Content-Type": "application/json"} if body else {}
+        send_headers.update(headers or {})
+        conn.request(method, path, body=body, headers=send_headers)
         resp = conn.getresponse()
         payload = resp.read()
         keep = {}
-        for k in ("Content-Type", "Retry-After"):
+        # Server-Timing/traceparent relay the replica's breakdown + trace
+        # identity through the router to the client.
+        for k in ("Content-Type", "Retry-After", "Server-Timing",
+                  "traceparent"):
             v = resp.getheader(k)
             if v is not None:
                 keep[k] = v
@@ -831,6 +932,34 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     render_prometheus(self.router._bus).encode(),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif path == "/metrics.json":
+                self._reply_json(200, self.router._bus.snapshot())
+            elif path.startswith("/traces"):
+                routed = obs_trace.handle_traces_path(self.path)
+                if routed is None:
+                    self._reply_json(404, {"error": "not_found",
+                                           "message": self.path})
+                else:
+                    self._reply_json(*routed)
+            elif path in ("/fleet/metrics", "/fleet/metrics.json"):
+                # Fleet aggregation pane (obs/fleet.py), attached by the
+                # fleet supervisor; a bare router has no fleet view.
+                fleet = getattr(self.server, "fleet", None)
+                if fleet is None:
+                    self._reply_json(
+                        404,
+                        {"error": "no_fleet",
+                         "message": "no fleet aggregator attached "
+                         "(run under tools/supervise_fleet.py)"},
+                    )
+                elif path == "/fleet/metrics.json":
+                    self._reply_json(200, fleet.merged())
+                else:
+                    self._reply(
+                        200,
+                        fleet.render_prometheus().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
             else:
                 self._reply_json(404, {"error": "not_found",
                                        "message": self.path})
@@ -852,7 +981,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
             body = self.rfile.read(length)
             path = self.path.split("?", 1)[0]
             if path in ("/predict", "/annotate"):
-                status, headers, payload = self.router.forward(path, body)
+                status, headers, payload = self.router.forward(
+                    path, body,
+                    traceparent=self.headers.get(
+                        obs_trace.TRACEPARENT_HEADER
+                    ),
+                )
                 self._reply(status, payload, headers=headers)
             elif path == "/router/register":
                 url = self._admin_url(body)
@@ -896,6 +1030,10 @@ class RouterHTTPServer(ThreadingHTTPServer):
     # idle. A front tier must absorb accept bursts; overload policy
     # belongs to the shed/429 tiers, not the kernel's SYN queue.
     request_queue_size = 1024
+
+    #: obs/fleet.FleetAggregator when running under the fleet supervisor
+    #: (serves /fleet/metrics); None on a bare router.
+    fleet = None
 
     def __init__(self, addr: Tuple[str, int], router: Router):
         super().__init__(addr, _RouterHandler)
@@ -959,6 +1097,7 @@ def router_from_args(args: argparse.Namespace) -> Router:
 def main(argv: Optional[List[str]] = None) -> None:
     args = get_router_args(argv)
     router = router_from_args(args)
+    obs_trace.register_trace_collector()
     server = start_router_server(router, args.host, args.port)
     host, port = server.server_address[:2]
     logger.info(

@@ -36,12 +36,15 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from seist_tpu.obs import flight as obs_flight
+from seist_tpu.obs import trace as obs_trace
 from seist_tpu.serve.batcher import BatcherConfig, MicroBatcher
 from seist_tpu.serve.pool import ModelPool, decode_outputs
 from seist_tpu.serve.protocol import (
     PRIORITIES,
     BadRequest,
     DeadlineExceeded,
+    Overloaded,
     PredictOptions,
     ServeError,
     ShuttingDown,
@@ -275,6 +278,7 @@ class ServeService:
         model: Optional[str] = None,
         options: Optional[Dict[str, Any]] = None,
         tasks: Optional[Any] = None,
+        trace: Optional[obs_trace.RequestTrace] = None,
     ) -> Dict[str, Any]:
         """One fixed-window trace through the micro-batcher.
 
@@ -282,13 +286,22 @@ class ServeService:
         the shared trunk runs ONCE and fans out to all of them
         (serve/pool.MultiTaskEntry); default is every task the group
         serves. Single-task models keep the PR 1 request/response shape
-        byte-for-byte."""
+        byte-for-byte.
+
+        ``trace`` (obs/trace.RequestTrace, minted by the HTTP handler
+        from the request's ``traceparent``): every stage of this method
+        becomes a child span — admission (with the shed verdict), parse,
+        normalize, the batcher's queue wait + device forward, decode —
+        so a slow request decomposes instead of being one opaque number."""
         if self._draining:
             raise ShuttingDown("service is draining")
+        t = obs_trace.ensure(trace)
         entry = self.pool.get(model)
         opts = PredictOptions.from_dict(options)
         req_tasks = entry.resolve_tasks(parse_tasks(tasks))
         self._check_variant(entry, opts.variant, req_tasks)
+        t.annotate(model=entry.name, variant=opts.variant,
+                   tier=opts.priority)
         # Request arrival: count, fire any scheduled serving fault
         # (SIGKILL at request k / black-hole window), then the admission
         # gate — shedding happens BEFORE the expensive waveform parse, so
@@ -298,34 +311,50 @@ class ServeService:
             self._requests["predict"] += 1
             n_request = self._requests["predict"]
         self._faults.on_request(n_request)
-        self._shedders[entry.name].admit(opts.priority)
-        x = parse_waveform(data, entry.in_channels)
+        with t.span("admission", tier=opts.priority) as sp:
+            try:
+                self._shedders[entry.name].admit(opts.priority)
+            except Overloaded as e:
+                # The shed verdict rides the trace (and the tail
+                # retention always keeps shed traces).
+                sp.annotate(verdict="shed",
+                            retry_after_s=round(e.retry_after_s, 3))
+                t.flag("shed")
+                raise
+            sp.annotate(verdict="admitted")
+        with t.span("parse"):
+            x = parse_waveform(data, entry.in_channels)
         if x.shape[0] > entry.window:
             raise BadRequest(
                 f"trace length {x.shape[0]} > window {entry.window}; "
                 "use POST /annotate for long records"
             )
-        x = _normalize_trace(x, opts.norm_mode)
-        n_real = x.shape[0]
-        if n_real < entry.window:  # pad AFTER normalize: zeros stay zero
-            pad = np.zeros((entry.window - n_real, x.shape[1]), dtype=x.dtype)
-            x = np.concatenate([x, pad], axis=0)
+        with t.span("normalize"):
+            x = _normalize_trace(x, opts.norm_mode)
+            n_real = x.shape[0]
+            if n_real < entry.window:  # pad AFTER normalize: zeros stay 0
+                pad = np.zeros(
+                    (entry.window - n_real, x.shape[1]), dtype=x.dtype
+                )
+                x = np.concatenate([x, pad], axis=0)
         raw = self._batcher_for(entry.name, opts.variant).submit(
             x,
             timeout_ms=opts.timeout_ms,
             rank=PRIORITIES[opts.priority],
             tasks=frozenset(req_tasks) if req_tasks is not None else None,
+            trace=trace,
         )
         fs = float(opts.sampling_rate)
         if req_tasks is not None:  # multi-task group: one entry per head
             per_task: Dict[str, Any] = {}
-            for t in req_tasks:
-                # The flush may have computed the UNION of coalesced
-                # requests' tasks; decode only what THIS caller asked.
-                r = decode_outputs(entry.heads[t], raw[t], opts)
-                if n_real < entry.window:
-                    _clip_picks(r, n_real, fs)
-                per_task[t] = r
+            with t.span("decode", heads=",".join(req_tasks)):
+                for tk in req_tasks:
+                    # The flush may have computed the UNION of coalesced
+                    # requests' tasks; decode only what THIS caller asked.
+                    r = decode_outputs(entry.heads[tk], raw[tk], opts)
+                    if n_real < entry.window:
+                        _clip_picks(r, n_real, fs)
+                    per_task[tk] = r
             return {
                 "model": entry.name,
                 "tasks": per_task,
@@ -334,7 +363,8 @@ class ServeService:
                 "trunk_runs": 1,
                 "variant": opts.variant,
             }
-        result = decode_outputs(entry, raw, opts)
+        with t.span("decode"):
+            result = decode_outputs(entry, raw, opts)
         if n_real < entry.window:
             # The signal->zeros step at the padding boundary can fabricate
             # picks/detections inside samples the client never sent.
@@ -348,11 +378,13 @@ class ServeService:
         data: Any,
         model: Optional[str] = None,
         options: Optional[Dict[str, Any]] = None,
+        trace: Optional[obs_trace.RequestTrace] = None,
     ) -> Dict[str, Any]:
         """A long (L >= window) record via sliding windows + stitching,
         reusing the pool's warm largest-bucket forward."""
         if self._draining:
             raise ShuttingDown("service is draining")
+        t = obs_trace.ensure(trace)
         entry = self.pool.get(model)
         if not entry.is_picker:
             raise BadRequest(
@@ -370,8 +402,17 @@ class ServeService:
             )
         # Same tiered gate as /predict: an overloaded replica sheds
         # low-tier record backfill before paying the (large) record parse.
-        self._shedders[entry.name].admit(opts.priority)
-        record = parse_waveform(data, entry.in_channels)
+        with t.span("admission", tier=opts.priority) as sp:
+            try:
+                self._shedders[entry.name].admit(opts.priority)
+            except Overloaded as e:
+                sp.annotate(verdict="shed",
+                            retry_after_s=round(e.retry_after_s, 3))
+                t.flag("shed")
+                raise
+            sp.annotate(verdict="admitted")
+        with t.span("parse"):
+            record = parse_waveform(data, entry.in_channels)
         if record.shape[0] < entry.window:
             raise BadRequest(
                 f"record length {record.shape[0]} < window {entry.window}; "
@@ -399,22 +440,24 @@ class ServeService:
         try:
             with self._lock:
                 self._requests["annotate"] += 1
-            picks = stream_annotate(
-                forward,
-                record,
-                window=entry.window,
-                stride=opts.stride or None,
-                batch_size=self.buckets[-1],
-                sampling_rate=opts.sampling_rate,
-                ppk_threshold=opts.ppk_threshold,
-                spk_threshold=opts.spk_threshold,
-                det_threshold=opts.det_threshold,
-                min_peak_dist=opts.min_peak_dist,
-                combine=opts.combine,
-                max_events=opts.record_max_events or None,
-                channel0=entry.channel0,
-                jitted=True,
-            )
+            with t.span("stream", model=entry.name,
+                        record_samples=int(record.shape[0])):
+                picks = stream_annotate(
+                    forward,
+                    record,
+                    window=entry.window,
+                    stride=opts.stride or None,
+                    batch_size=self.buckets[-1],
+                    sampling_rate=opts.sampling_rate,
+                    ppk_threshold=opts.ppk_threshold,
+                    spk_threshold=opts.spk_threshold,
+                    det_threshold=opts.det_threshold,
+                    min_peak_dist=opts.min_peak_dist,
+                    combine=opts.combine,
+                    max_events=opts.record_max_events or None,
+                    channel0=entry.channel0,
+                    jitted=True,
+                )
         finally:
             lock.release()
         self.annotate_latency_ms.observe((time.monotonic() - t0) * 1000.0)
@@ -643,6 +686,20 @@ class _Handler(BaseHTTPRequestHandler):
                     200 if ready else 503,
                     {"status": self.service._state_str(), "ready": ready},
                 )
+            elif self.path == "/metrics.json":
+                # Raw bus snapshot — the payload the fleet aggregator
+                # scrapes and merges (obs/fleet.py); bucket counts ride
+                # along for bucket-wise histogram merging.
+                from seist_tpu.obs.bus import BUS
+
+                self._reply(200, BUS.snapshot())
+            elif self.path.split("?", 1)[0].startswith("/traces"):
+                routed = obs_trace.handle_traces_path(self.path)
+                if routed is None:
+                    self._reply(404, {"error": "not_found",
+                                      "message": self.path})
+                else:
+                    self._reply(*routed)
             elif self.path.split("?", 1)[0] == "/metrics":
                 # ?format=prometheus selects text exposition regardless
                 # of other params/ordering (real scrapers append job
@@ -662,9 +719,32 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._reply(404, {"error": "not_found", "message": self.path})
         except Exception as e:  # noqa: BLE001
+            # An unexpected handler bug is a death-path-shaped event even
+            # though the process survives: leave the forensic flight
+            # record (non-fatal — must never suppress a later crash dump).
+            obs_flight.dump_on_death(
+                "serve_handler_exception", arm_dedup=False,
+                request_path=self.path, error=repr(e),
+            )
             self._reply(500, {"error": "internal", "message": repr(e)})
 
+    def _trace_headers(
+        self, rt: Optional[obs_trace.RequestTrace], status: int
+    ) -> Dict[str, str]:
+        """Finish the request trace and render its response headers: a
+        ``Server-Timing``-style breakdown plus the ``traceparent`` echo
+        (so a client that did not mint the id can still fetch
+        ``/traces/<id>``)."""
+        if rt is None:
+            return {}
+        rt.finish(status)
+        return {
+            "Server-Timing": rt.server_timing(),
+            obs_trace.TRACEPARENT_HEADER: rt.traceparent,
+        }
+
     def do_POST(self) -> None:  # noqa: N802
+        rt: Optional[obs_trace.RequestTrace] = None
         try:
             length = int(self.headers.get("Content-Length") or 0)
             if length > MAX_BODY_BYTES:
@@ -677,30 +757,48 @@ class _Handler(BaseHTTPRequestHandler):
                      "message": f"body {length} > {MAX_BODY_BYTES} bytes"},
                 )
                 return
-            body = parse_body(self.rfile.read(length))
+            raw = self.rfile.read(length)
+            if self.path in ("/predict", "/annotate"):
+                # Continue the upstream trace (bench client / router) or
+                # mint here — the replica is the last possible edge.
+                rt = obs_trace.RequestTrace(
+                    self.headers.get(obs_trace.TRACEPARENT_HEADER),
+                    name=f"server:{self.path}",
+                )
+            body = parse_body(raw)
             if self.path == "/predict":
                 result = self.service.predict(
                     body.get("data"),
                     model=body.get("model"),
                     options=body.get("options"),
                     tasks=body.get("tasks"),
+                    trace=rt,
                 )
             elif self.path == "/annotate":
                 result = self.service.annotate(
                     body.get("data"),
                     model=body.get("model"),
                     options=body.get("options"),
+                    trace=rt,
                 )
             else:
                 self._reply(404, {"error": "not_found", "message": self.path})
                 return
-            self._reply(200, result)
+            self._reply(200, result,
+                        extra_headers=self._trace_headers(rt, 200))
         except ServeError as e:
             # e.headers() carries e.g. the shed path's Retry-After.
-            self._reply(e.status, e.payload(), extra_headers=e.headers())
+            headers = e.headers()
+            headers.update(self._trace_headers(rt, e.status))
+            self._reply(e.status, e.payload(), extra_headers=headers)
         except Exception as e:  # noqa: BLE001
             logger.warning(f"[serve] unhandled error: {e!r}")
-            self._reply(500, {"error": "internal", "message": repr(e)})
+            obs_flight.dump_on_death(
+                "serve_handler_exception", arm_dedup=False,
+                request_path=self.path, error=repr(e),
+            )
+            self._reply(500, {"error": "internal", "message": repr(e)},
+                        extra_headers=self._trace_headers(rt, 500))
 
 
 class ServeHTTPServer(ThreadingHTTPServer):
@@ -843,6 +941,10 @@ def watch_until_shutdown(
             publish = getattr(service, "publish_state", None)
             if publish is not None:  # tests pass bare namespaces
                 publish(reason)
+            # The batcher's own death path already dumped with the rich
+            # reason; dedup keeps this exit-side record from shadowing it.
+            obs_flight.dump_on_death("serve_unhealthy", dedup_s=5.0,
+                                     detail=reason)
             logger.warning(f"[serve] {reason}; exiting 1")
             return 1
         stop.wait(poll_s)
@@ -880,9 +982,18 @@ def main(argv: Optional[List[str]] = None) -> None:
         alert_delay_ms=args.shed_alert_delay_ms,
     )
     # Replica lifecycle events (warming/ok/draining + shed decisions) go
-    # to the same events.jsonl the train worker writes — one forensic
-    # stream per logdir regardless of plane.
-    events = EventLog(_os.path.join(logger.logdir(), "events.jsonl"))
+    # to the same events stream the train worker writes — suffixed with
+    # the fleet ordinal (events_r0.jsonl, ...) so N replicas sharing one
+    # --logdir never interleave/clobber one file (obs/trace.replica_suffix).
+    events = EventLog(_os.path.join(
+        logger.logdir(), f"events{obs_trace.replica_suffix()}.jsonl"
+    ))
+    # Serve-plane flight recorder: request spans land in the ring via the
+    # bus sink, and the serve death paths (batcher flush death, handler
+    # exception, unhealthy exit) dump it exactly like the train worker's.
+    obs_flight.install(obs_flight.FlightRecorder())
+    # Trace-plane retention counters on the scrape surface.
+    obs_trace.register_trace_collector()
     pool = ModelPool(
         entries,
         window=args.window,

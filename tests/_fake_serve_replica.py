@@ -24,6 +24,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 PREEMPT_EXIT_CODE = 75
 
+COUNTS = {"predict": 0}
+COUNTS_LOCK = threading.Lock()
+
 
 class Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -42,6 +45,30 @@ class Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path in ("/healthz", "/healthz/live", "/healthz/ready"):
             self._reply(200, {"status": "ok"})
+        elif self.path == "/metrics.json":
+            # The bus-snapshot shape the fleet aggregator scrapes
+            # (obs/fleet.py): counters sum, histograms merge bucket-wise.
+            with COUNTS_LOCK:
+                n = COUNTS["predict"]
+            self._reply(200, {
+                "counters": {
+                    "fake_requests{path=predict}": n,
+                },
+                "gauges": {
+                    "fake_replica_ordinal": float(
+                        os.environ.get("SEIST_SERVE_REPLICA", "0") or 0
+                    ),
+                },
+                "histograms": {
+                    "fake_latency_ms": {
+                        "count": float(n), "mean": 1.0, "max": 2.0,
+                        "sum": float(n),
+                        "bounds": [1.0, 10.0],
+                        "bucket_counts": [n, 0, 0],
+                    },
+                },
+                "collectors": {},
+            })
         else:
             self._reply(404, {"error": "not_found"})
 
@@ -49,6 +76,8 @@ class Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length") or 0)
         self.rfile.read(n)
         if self.path == "/predict":
+            with COUNTS_LOCK:
+                COUNTS["predict"] += 1
             self._reply(
                 200,
                 {"ok": True,

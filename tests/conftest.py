@@ -154,6 +154,7 @@ _SMOKE_FILES = {
     "test_jaxlint.py",
     "test_io_guard.py",
     "test_obs.py",
+    "test_trace.py",
     "test_meters.py",
     "test_router.py",
     "test_threadlint.py",

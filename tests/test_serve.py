@@ -914,3 +914,225 @@ def test_lifecycle_states_published_to_events_and_gauge(service, tmp_path):
     ]
     assert transitions[0] == (None, "warming")
     assert transitions[1] == ("warming", "ok")
+
+
+# ------------------------------------------- distributed tracing (ISSUE 11)
+class TestDistributedTracing:
+    """The serve half of obs/trace.py: a /predict over real HTTP carries a
+    Server-Timing breakdown + traceparent echo, and GET /traces/<id>
+    exposes the admission/parse/queue-wait/forward/decode decomposition
+    with the AOT program key."""
+
+    def test_http_predict_traced_end_to_end(self, service):
+        import http.client
+
+        from seist_tpu.obs import trace as obs_trace
+        from seist_tpu.serve import start_http_server
+
+        obs_trace.BUFFER.reset()
+        server = start_http_server(service, port=0)
+        host, port = server.server_address[:2]
+        try:
+            header = obs_trace.mint_traceparent()
+            tid, client_span = obs_trace.parse_traceparent(header)
+            rng = np.random.default_rng(7)
+            body = json.dumps({
+                "data": rng.standard_normal((WINDOW, 3)).tolist(),
+                "options": {"ppk_threshold": 0.05},
+            }).encode()
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            try:
+                conn.request("POST", "/predict", body,
+                             {"Content-Type": "application/json",
+                              "traceparent": header})
+                resp = conn.getresponse()
+                payload = json.loads(resp.read())
+                timing = resp.getheader("Server-Timing", "")
+                echoed = resp.getheader("traceparent", "")
+            finally:
+                conn.close()
+            assert resp.status == 200 and payload["model"] == "phasenet"
+            # Same trace id back; the replica's root span id, not ours.
+            e_tid, e_span = obs_trace.parse_traceparent(echoed)
+            assert e_tid == tid and e_span != client_span
+            assert timing.startswith("total;dur=")
+            for seg in ("admission", "parse", "queue_wait", "forward",
+                        "decode"):
+                assert f"{seg};dur=" in timing, timing
+
+            # The span segments are fetchable by the client-minted id.
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            try:
+                conn.request("GET", f"/traces/{tid}")
+                trace = json.loads(conn.getresponse().read())
+            finally:
+                conn.close()
+            spans = {s["name"]: s for s in trace["spans"]}
+            root = spans["server:/predict"]
+            assert root["parent_id"] == client_span
+            assert root["annotations"]["status"] == 200
+            assert root["annotations"]["model"] == "phasenet"
+            admission = spans["admission"]
+            assert admission["annotations"]["verdict"] == "admitted"
+            qw = spans["queue_wait"]
+            assert qw["annotations"]["bucket"] >= 1
+            fwd = spans["forward"]
+            # The device program that served it, AOT by construction.
+            assert "phasenet/full/b" in fwd["annotations"]["program"]
+            assert fwd["annotations"]["aot"] is True
+            # /metrics.json (the fleet aggregator's scrape payload) is
+            # servable and carries bucketed histograms.
+            from seist_tpu.obs.bus import BUS
+
+            BUS.histogram("trace_probe_ms").observe(1.0)
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            try:
+                conn.request("GET", "/metrics.json")
+                snap = json.loads(conn.getresponse().read())
+            finally:
+                conn.close()
+            assert "counters" in snap and "histograms" in snap
+            # Raw buckets ride along for the fleet aggregator's
+            # bucket-wise merge.
+            assert "bucket_counts" in snap["histograms"]["trace_probe_ms"]
+        finally:
+            server.shutdown()
+            obs_trace.BUFFER.reset()
+
+    def test_shed_request_flagged_with_verdict_span(self, service):
+        """A shed 503 rides the trace: admission span carries the
+        verdict, the trace is flagged 'shed' (always retained), and the
+        error flag is NOT set (policy, not failure)."""
+        from seist_tpu.obs import trace as obs_trace
+        from seist_tpu.serve.protocol import Overloaded
+
+        obs_trace.BUFFER.reset()
+        shedder = service._shedders["phasenet"]
+        orig = shedder._delay_ms
+        shedder._delay_ms = lambda: 1e9  # force overload
+        rt = obs_trace.RequestTrace(None, name="server:/predict")
+        try:
+            with pytest.raises(Overloaded):
+                service.predict(
+                    np.zeros((WINDOW, 3)).tolist(),
+                    options={"priority": "batch"},
+                    trace=rt,
+                )
+            rt.flag("shed")  # the HTTP handler's part
+            rt.finish(503)
+            payload = obs_trace.BUFFER.get(rt.trace_id)
+            assert payload["flags"] == ["shed"]
+            spans = {s["name"]: s for s in payload["spans"]}
+            assert spans["admission"]["annotations"]["verdict"] == "shed"
+            assert "retry_after_s" in spans["admission"]["annotations"]
+        finally:
+            shedder._delay_ms = orig
+            # un-stick the shed hysteresis for later fixture users
+            for state in shedder._tiers.values():
+                state.shedding = False
+            obs_trace.BUFFER.reset()
+
+
+# ------------------------------------------- serve-plane flight dumps
+class TestServeFlightDumps:
+    """ISSUE 11 satellite: the serve plane's remaining death paths leave
+    flight-recorder dumps like the train worker's (PR 6)."""
+
+    @pytest.fixture
+    def recorder(self, tmp_path, monkeypatch):
+        from seist_tpu.obs import flight
+        from seist_tpu.utils.logger import logger
+
+        monkeypatch.setattr(logger, "_logdir", str(tmp_path),
+                            raising=False)
+        # The cross-test dedup window is module state; a previous test's
+        # dump must not swallow this test's.
+        monkeypatch.setattr(flight, "_LAST_DUMP_MONO", None)
+        rec = flight.FlightRecorder(capacity=16)
+        prev = flight.install(rec)
+        yield tmp_path
+        flight.install(prev)
+
+    def _dumps(self, tmp_path, reason):
+        import glob
+        import os
+
+        return glob.glob(
+            os.path.join(str(tmp_path), "flight", f"flight_{reason}_*")
+        )
+
+    def test_batcher_flush_death_dumps_flight(self, recorder):
+        from seist_tpu.serve.batcher import BatcherConfig, MicroBatcher
+        from seist_tpu.serve.protocol import ServeError
+
+        b = MicroBatcher(lambda x: x,
+                         BatcherConfig(max_batch=2, max_delay_ms=5.0),
+                         name="doomed")
+
+        def boom(pending):
+            raise RuntimeError("flush machinery broke")
+
+        b._run_batch = boom
+        with pytest.raises(ServeError, match="flush thread died"):
+            b.submit(np.zeros((2,), np.float32), timeout_ms=2000)
+        deadline = time.monotonic() + 5
+        while not self._dumps(recorder, "batcher_flush_death"):
+            if time.monotonic() > deadline:
+                raise AssertionError("no batcher_flush_death flight dump")
+            time.sleep(0.01)
+        dump = json.loads(
+            open(self._dumps(recorder, "batcher_flush_death")[0]).read()
+        )
+        assert dump["reason"] == "batcher_flush_death"
+        assert dump["batcher"] == "doomed"
+        assert "RuntimeError" in dump["error"]
+
+    def test_handler_exception_dumps_flight(self, recorder, service):
+        """An uncaught HTTP-handler exception (a handler BUG, not a
+        ServeError) must 500 the request AND leave a flight record."""
+        import http.client
+
+        from seist_tpu.serve import start_http_server
+
+        server = start_http_server(service, port=0)
+        host, port = server.server_address[:2]
+        orig = service.predict
+        service.predict = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("handler bug")
+        )
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            try:
+                conn.request("POST", "/predict",
+                             json.dumps({"data": [[0.0] * 3]}).encode(),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                out = json.loads(resp.read())
+            finally:
+                conn.close()
+            assert resp.status == 500 and out["error"] == "internal"
+            dumps = self._dumps(recorder, "serve_handler_exception")
+            assert dumps, "no serve_handler_exception flight dump"
+            dump = json.loads(open(dumps[0]).read())
+            assert dump["request_path"] == "/predict"
+            assert "RuntimeError" in dump["error"]
+        finally:
+            service.predict = orig
+            server.shutdown()
+
+    def test_unhealthy_watchdog_exit_dumps_flight(self, recorder):
+        from types import SimpleNamespace
+
+        from seist_tpu.serve.server import watch_until_shutdown
+
+        dead = SimpleNamespace(healthy=False)
+        svc = SimpleNamespace(
+            alive=lambda: False,
+            _batchers={"m": dead},
+            _warmup_error=None,
+        )
+        rc = watch_until_shutdown(svc, threading.Event(), poll_s=0.01)
+        assert rc == 1
+        dumps = self._dumps(recorder, "serve_unhealthy")
+        assert dumps, "no serve_unhealthy flight dump"
+        assert "flush thread" in json.loads(open(dumps[0]).read())["detail"]

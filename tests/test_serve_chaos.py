@@ -179,6 +179,14 @@ def _bench(url, tmp_path, tag, *extra):
         return rc, json.load(f)
 
 
+def _read_trace_log(path):
+    with open(path) as f:
+        return {
+            rec["trace_id"]: rec
+            for rec in (json.loads(line) for line in f if line.strip())
+        }
+
+
 def test_sigkill_mid_load_zero_failed_requests(tmp_path):
     """Acceptance: 2 replicas under closed-loop load, one SIGKILLed by the
     fault injector at its 8th request. supervise_fleet restarts it, the
@@ -196,11 +204,13 @@ def test_sigkill_mid_load_zero_failed_requests(tmp_path):
     )
     try:
         _wait_probed_ready(host, port, 2)
+        tlog = str(tmp_path / "kill_traces.jsonl")
         rc, result = _bench(
             f"http://{host}:{port}", tmp_path, "kill",
             "--requests", "48",
             "--concurrency", "6",
             "--timeout-ms", "60000",
+            "--trace-log", tlog,
         )
         assert os.path.exists(stamp), (
             "kill fault never fired — the run proved nothing"
@@ -211,6 +221,86 @@ def test_sigkill_mid_load_zero_failed_requests(tmp_path):
         # The rescue is visible on the router's own metrics plane.
         _, text = _get(host, port, "/metrics")
         assert "seist_router_retries" in text
+
+        # --- ISSUE 11 acceptance: the rescue is visible on the TRACE
+        # plane too. A request that survived the SIGKILL via router
+        # retry must stitch (tools/trace_report.py) into one tree
+        # showing both attempts (failed + succeeded), the surviving
+        # replica's queue wait and device program span — and the span
+        # tree's total must be within 10% of what the CLIENT measured
+        # for that same request.
+        import trace_report
+
+        client_lat = _read_trace_log(tlog)
+        assert len(client_lat) == 48
+        _, idx = _get(host, port, "/traces")
+        retried = [
+            t for t in idx["traces"]
+            if "retried" in t["flags"] and t["trace_id"] in client_lat
+            and client_lat[t["trace_id"]]["status"] == 200
+        ]
+        assert retried, (
+            f"no retried trace on the router: {idx['traces'][:5]}"
+        )
+        _, reg = _get(host, port, "/router/replicas")
+        endpoints = [f"http://{host}:{port}"] + [
+            r["url"] for r in reg["replicas"]
+        ]
+        # ANY surviving retried request must satisfy the acceptance —
+        # walk them slowest-first (relative client-side overhead is
+        # smallest there) and keep the verdicts for the failure report.
+        verdicts = []
+        passed = None
+        for cand in sorted(
+            retried,
+            key=lambda t: client_lat[t["trace_id"]]["latency_ms"],
+            reverse=True,
+        ):
+            st = trace_report.stitch_from_endpoints(
+                cand["trace_id"], endpoints
+            )
+            attempts = st.find("attempt")
+            classes = [
+                (s.get("annotations") or {}).get("class")
+                for s in attempts
+            ]
+            fwd = st.find("forward")
+            client_ms = client_lat[cand["trace_id"]]["latency_ms"]
+            rel = (
+                abs(st.total_ms - client_ms) / client_ms
+                if client_ms else 1.0
+            )
+            ok = (
+                len(attempts) >= 2
+                and any(c in ("net_error", "server_error")
+                        for c in classes)
+                and "ok" in classes
+                and bool(st.find("queue_wait"))
+                and any(
+                    "phasenet" in str(
+                        (s.get("annotations") or {}).get("program"))
+                    for s in fwd
+                )
+                and "replica" in ",".join(st.processes())
+                and rel <= 0.10
+            )
+            verdicts.append({
+                "trace_id": cand["trace_id"],
+                "attempts": len(attempts), "classes": classes,
+                "client_ms": client_ms,
+                "total_ms": round(st.total_ms, 1),
+                "rel": round(rel, 3), "ok": ok,
+            })
+            if ok:
+                passed = st
+                break
+        assert passed is not None, (
+            "no retried trace satisfied the stitched-trace acceptance "
+            f"(both attempts + queue wait + device program span + total "
+            f"within 10% of client latency): {verdicts}"
+        )
+        print(passed.format(), file=sys.stderr, flush=True)
+
         # The killed replica comes back (stamped: the relaunch stays up).
         _wait_probed_ready(host, port, 2, timeout_s=120.0)
     finally:

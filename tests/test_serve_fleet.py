@@ -404,3 +404,93 @@ class TestBenchServeAccounting:
         # overrun the client.
         assert stats.by_code.get("client_overrun", 0) > 0
         assert calls["n"] + stats.by_code["client_overrun"] == 30
+
+
+# -------------------------------------------------- fleet metrics plane
+class TestFleetMetricsPlane:
+    """ISSUE 11: GET /fleet/metrics[.json] on the supervisor aggregates
+    >= 2 replicas + the router — counters summed, histograms merged
+    bucket-wise, per-replica breakdown retained."""
+
+    def test_fleet_metrics_aggregates_replicas_and_router(self):
+        proc, host, port = _start_fleet(
+            extra_args=("--fleet-scrape-interval-s", "0.3"),
+        )
+        try:
+            _wait_ready(host, port, 2)
+            for _ in range(5):
+                status, _ = _predict(host, port)
+                assert status == 200
+            deadline = time.monotonic() + 15.0
+            view = None
+            while time.monotonic() < deadline:
+                status, view = _router_get(
+                    host, port, "/fleet/metrics.json", timeout=10.0
+                )
+                assert status == 200
+                agg = view["aggregate"]
+                if (
+                    view.get("up", 0) >= 3
+                    and agg["counters"].get(
+                        "fake_requests{path=predict}", 0) >= 5
+                ):
+                    break
+                time.sleep(0.2)
+            assert view is not None and view["up"] >= 3, view
+            agg = view["aggregate"]
+            # Counters summed across the fleet...
+            assert agg["counters"]["fake_requests{path=predict}"] == 5
+            # ...histograms merged bucket-wise (one merged distribution,
+            # not averaged percentiles)...
+            h = agg["histograms"]["fake_latency_ms"]
+            assert h["count"] == 5 and h["bucket_counts"][0] == 5
+            assert h["mean"] == pytest.approx(1.0)
+            # ...per-replica breakdown retained verbatim...
+            per = {
+                name: (snap or {}).get("counters", {}).get(
+                    "fake_requests{path=predict}", 0)
+                for name, snap in view["replicas"].items()
+                if name.startswith("replica-")
+            }
+            assert len(per) == 2 and sum(per.values()) == 5, per
+            # ...and the router's own bus is a source too.
+            assert view["sources"]["router"]["up"]
+            assert any(
+                k.startswith("router_requests")
+                for k in view["replicas"]["router"]["counters"]
+            )
+
+            # Prometheus exposition: aggregate under replica="fleet",
+            # breakdown under the source name.
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            try:
+                conn.request("GET", "/fleet/metrics")
+                resp = conn.getresponse()
+                text = resp.read().decode()
+                assert resp.status == 200
+                assert resp.getheader("Content-Type", "").startswith(
+                    "text/plain")
+            finally:
+                conn.close()
+            assert ('seist_fake_requests_total{path="predict",'
+                    'replica="fleet"} 5') in text
+            assert 'replica="replica-0"' in text
+            assert 'seist_fleet_source_up{source="replica-1"} 1' in text
+            assert "seist_fake_latency_ms_bucket" in text
+        finally:
+            _stop(proc, expect_rc=0)
+
+    def test_bare_router_reports_no_fleet(self):
+        """/fleet/metrics without a supervisor-attached aggregator is an
+        explicit 404, not a crash."""
+        from seist_tpu.serve.router import Router, start_router_server
+
+        router = Router()
+        server = start_router_server(router, port=0)
+        try:
+            host, port = server.server_address[:2]
+            status, payload = _router_get(host, port, "/fleet/metrics.json")
+            assert status == 404 and payload["error"] == "no_fleet"
+        finally:
+            server.shutdown()
+            router.stop()

@@ -95,51 +95,93 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     help="gate companion: tolerated error_rate before the "
                     "SLO gate trips (default 0 = any error trips it when "
                     "--slo-p99-ms is set)")
+    ap.add_argument("--trace-log", default="",
+                    help="also write one JSONL line per request "
+                    "({trace_id, status, latency_ms}) — the lookup table "
+                    "for stitching ANY request with tools/trace_report.py "
+                    "(the output JSON always carries the slowest-N and "
+                    "failed exemplars)")
     return ap.parse_args(argv)
 
 
 class _Stats:
     """Thread-safe per-request accounting: latencies of successes, error
-    counts by HTTP status and by serve error code."""
+    counts by HTTP status and by serve error code, and the per-request
+    trace ids so a bench run hands you the exact traces to pull from
+    ``GET /traces/<id>`` (p99 exemplars + every failure)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.latencies_ms: List[float] = []
+        self.successes: List[Dict[str, Any]] = []  # {trace_id, latency_ms}
+        self.failed: List[Dict[str, Any]] = []  # {trace_id, status, code}
         self.by_status: Dict[str, int] = {}
         self.by_code: Dict[str, int] = {}
         self.ok = 0
         self.errors = 0
 
-    def success(self, latency_ms: float) -> None:
+    def success(self, latency_ms: float, trace_id: str = "") -> None:
         with self._lock:
             self.ok += 1
             self.by_status["200"] = self.by_status.get("200", 0) + 1
             self.latencies_ms.append(latency_ms)
+            if trace_id:
+                self.successes.append({
+                    "trace_id": trace_id,
+                    "latency_ms": round(latency_ms, 3),
+                })
 
-    def error(self, status: int, code: str) -> None:
+    def error(self, status: int, code: str, trace_id: str = "",
+              latency_ms: float = 0.0) -> None:
         with self._lock:
             self.errors += 1
             key = str(status)
             self.by_status[key] = self.by_status.get(key, 0) + 1
             if code:
                 self.by_code[code] = self.by_code.get(code, 0) + 1
+            if trace_id:
+                self.failed.append({
+                    "trace_id": trace_id,
+                    "status": status,
+                    "code": code,
+                    "latency_ms": round(latency_ms, 3),
+                })
+
+    def exemplars(self, slowest_n: int = 5,
+                  failed_cap: int = 32) -> Dict[str, Any]:
+        """The JSON block: trace ids of the slowest-N successes (the p99
+        suspects) and every failed request (capped, count reported)."""
+        with self._lock:
+            successes = list(self.successes)
+            failed = list(self.failed)
+        slowest = sorted(
+            successes, key=lambda e: e["latency_ms"], reverse=True
+        )[:slowest_n]
+        return {
+            "slowest": slowest,
+            "failed": failed[:failed_cap],
+            "failed_total": len(failed),
+        }
 
 
 def _http_client(url: str, timeout_ms: float):
-    """-> fn(payload_dict) that POSTs /predict and returns (status, body
-    dict); network failures surface as status 0. Transport is the
-    router's own jax-free helper so the bench client and the front tier
-    can't drift on HTTP semantics."""
+    """-> fn(payload_dict, traceparent) that POSTs /predict and returns
+    (status, body dict); network failures surface as status 0. Transport
+    is the router's own jax-free helper so the bench client and the
+    front tier can't drift on HTTP semantics. The client IS the trace
+    edge: the minted ``traceparent`` rides the request header."""
     import http.client
 
     from seist_tpu.serve.router import _http_request
 
-    def call(payload: Dict[str, Any]):
+    def call(payload: Dict[str, Any], traceparent: str = ""):
         body = json.dumps(payload).encode()
+        headers = {"traceparent": traceparent} if traceparent else None
         try:
             status, _, raw = _http_request(
                 url, "POST", "/predict", body,
                 timeout_s=timeout_ms / 1000.0 + 5.0,
+                headers=headers,
             )
         except (OSError, http.client.HTTPException) as e:
             return 0, {"error": "unreachable", "message": str(e)}
@@ -166,6 +208,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     import numpy as np
 
+    # jax-free (obs/trace.py is stdlib + the bus): the bench client is
+    # the trace edge — it mints every request's traceparent, so the ids
+    # in its JSON are the exact handles for GET /traces/<id>.
+    from seist_tpu.obs import trace as obs_trace
     from seist_tpu.utils.profiling import stopwatch
 
     options: Dict[str, Any] = {"timeout_ms": args.timeout_ms}
@@ -180,13 +226,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         in_channels = args.in_channels
         call = _http_client(args.url, args.timeout_ms)
 
-        def one_request(trace) -> Any:
-            payload = {"data": trace, "options": options}
+        def one_request(waveform, traceparent: str) -> Any:
+            payload = {"data": waveform, "options": options}
             if args.model_name:
                 payload["model"] = args.model_name
             if tasks:
                 payload["tasks"] = tasks
-            return call(payload)
+            return call(payload, traceparent)
 
     else:
         from seist_tpu.serve import BatcherConfig, ModelPool, ServeService
@@ -218,13 +264,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         if entry.is_picker and not tasks:
             options.update(ppk_threshold=0.05, spk_threshold=0.05)
 
-        def one_request(trace) -> Any:
+        def one_request(waveform, traceparent: str) -> Any:
+            # In-process mode: this process IS the server, so the trace
+            # plays the HTTP handler's part (mint -> spans -> finish).
+            rt = obs_trace.RequestTrace(traceparent,
+                                        name="server:/predict")
             try:
-                return 200, service.predict(
-                    trace, options=options, tasks=tasks
+                result = service.predict(
+                    waveform, options=options, tasks=tasks, trace=rt
                 )
+                rt.finish(200)
+                return 200, result
             except ServeError as e:
+                if e.code == "shed":
+                    rt.flag("shed")
+                rt.finish(e.status)
                 return e.status, e.payload()
+            except BaseException:
+                rt.finish(0)
+                raise
 
     rng = np.random.default_rng(args.seed)
     traces = [
@@ -236,9 +294,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     stats = _Stats()
 
     def one(i: int) -> None:
+        traceparent = obs_trace.mint_traceparent()
+        trace_id = traceparent.split("-")[1]
         with stopwatch() as elapsed:
             try:
-                status, body = one_request(traces[i % len(traces)])
+                status, body = one_request(
+                    traces[i % len(traces)], traceparent
+                )
             except Exception as e:  # noqa: BLE001
                 # The docstring contract: every request error is counted,
                 # never aborts the bench. A raise here would abort the
@@ -257,10 +319,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 body = {"error": "missing_head",
                         "message": f"answered {sorted(answered)} of "
                                    f"{sorted(tasks)}"}
+        latency_ms = elapsed() * 1000.0
         if status == 200:
-            stats.success(elapsed() * 1000.0)
+            stats.success(latency_ms, trace_id=trace_id)
         else:
-            stats.error(status, str(body.get("error", "")))
+            stats.error(status, str(body.get("error", "")),
+                        trace_id=trace_id, latency_ms=latency_ms)
 
     with stopwatch() as wall:
         if args.arrival_rps > 0:
@@ -323,10 +387,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         "by_status": dict(sorted(stats.by_status.items())),
         "by_error_code": dict(sorted(stats.by_code.items())),
         "device": device,
+        # The handles for `python tools/trace_report.py --from-bench`:
+        # p99 suspects + every failure, by trace id. Failed exemplars are
+        # flagged on the servers and evicted last; slowest-N SUCCESSES
+        # are unflagged, so on a bench larger than the servers' trace
+        # ring they may already be evicted by the time you pull them.
+        "trace_exemplars": stats.exemplars(),
         "measured_at": datetime.now(timezone.utc).strftime(
             "%Y-%m-%dT%H:%M:%SZ"
         ),
     }
+    if args.trace_log:
+        with open(args.trace_log, "w") as f:
+            for e in stats.successes:
+                f.write(json.dumps({**e, "status": 200}) + "\n")
+            for e in stats.failed:
+                f.write(json.dumps(e) + "\n")
+    trace_capacity = int(
+        float(os.environ.get("SEIST_TRACE_CAPACITY", "") or 256)
+    )
+    if args.requests > trace_capacity:
+        # Tail retention evicts unflagged (successful) traces first, so
+        # the slowest-N exemplars of a big bench likely 404 on
+        # GET /traces/<id> unless the serving processes keep more.
+        print(
+            f"[bench_serve] note: {args.requests} requests > trace ring "
+            f"capacity (~{trace_capacity}); slowest-N exemplars may be "
+            "evicted on the servers — raise SEIST_TRACE_CAPACITY on the "
+            "fleet or set SEIST_TRACE_SLO_MS to flag slow requests for "
+            "retention",
+            file=sys.stderr, flush=True,
+        )
     if batcher_stats:
         result["batch_fill_ratio"] = round(
             batcher_stats["batch_fill_ratio"], 4

@@ -28,7 +28,16 @@ The supervisor exits 0 on SIGTERM/SIGINT (after draining the replicas)
 and 1 once every replica slot has been retired. Each replica gets
 ``SEIST_SERVE_REPLICA=<index>`` in its environment — the handle
 ``SEIST_FAULT_SERVE_REPLICA`` uses to aim a chaos fault at exactly one
-member of the fleet (utils/faults.py).
+member of the fleet (utils/faults.py), and the ordinal that suffixes
+the replica's ``events_r<N>.jsonl`` / flight-dump artifacts under a
+shared ``--logdir``.
+
+The supervisor is also the fleet's metrics pane: a
+:class:`seist_tpu.obs.fleet.FleetAggregator` periodically pulls every
+replica's ``/metrics.json`` plus the in-process router's bus and serves
+the merged view (counters summed, histograms merged bucket-wise,
+per-replica breakdown retained) at ``GET /fleet/metrics[.json]`` on the
+router port (docs/SERVING.md "Fleet metrics").
 """
 
 from __future__ import annotations
@@ -108,6 +117,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--probe-interval-s", type=float, default=0.5)
     ap.add_argument("--breaker-failures", type=int, default=3)
     ap.add_argument("--breaker-cooldown-s", type=float, default=2.0)
+    ap.add_argument("--fleet-scrape-interval-s", type=float, default=5.0,
+                    help="how often the fleet aggregator pulls every "
+                    "replica's /metrics.json (served merged on the "
+                    "router port at GET /fleet/metrics[.json])")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="the replica command, after `--` (without "
                     "--host/--port, which the supervisor assigns)")
@@ -122,6 +135,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.replicas < 1:
         ap.error("--replicas must be >= 1")
 
+    from seist_tpu.obs import trace as obs_trace
+    from seist_tpu.obs.bus import BUS
+    from seist_tpu.obs.fleet import FleetAggregator
     from seist_tpu.serve.router import (
         Router,
         RouterConfig,
@@ -142,10 +158,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         ReplicaSlot(i, args.base_port + i, cmd)
         for i in range(args.replicas)
     ]
+    # Fleet metrics pane: periodically pull every replica's /metrics.json
+    # plus the (in-process) router's bus, merge counters/gauges and
+    # bucket-wise histograms, serve the single aggregated view at
+    # GET /fleet/metrics[.json] on the router port (docs/SERVING.md) —
+    # the signal source the autoscaler and canary rollback will read.
+    obs_trace.register_trace_collector()
+    fleet = FleetAggregator(interval_s=args.fleet_scrape_interval_s)
+    fleet.add_source("router", BUS.snapshot)
     for slot in slots:
         slot.spawn()
         router.registry.add(slot.url)
+        fleet.add_source(f"replica-{slot.index}", slot.url)
     server = start_router_server(router, args.router_host, args.router_port)
+    server.fleet = fleet
+    fleet.start()
     host, port = server.server_address[:2]
     # Machine-greppable for harnesses driving an ephemeral-port fleet.
     print(f"[fleet] ROUTER=http://{host}:{port}", flush=True)
@@ -162,6 +189,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         _monitor(slots, router, args, stop)
     finally:
+        fleet.stop()
         _drain(slots, args.drain_timeout_s)
         server.shutdown()
         router.stop()

@@ -1,0 +1,247 @@
+"""Distributed-tracing smoke (`make trace-smoke`): a real 2-replica
+fleet + router under bench_serve, then prove the trace plane end to end:
+
+1. every replica runs with an injected 50 ms forward delay and the
+   router hedges at 15 ms, so every request's trace is *hedged* (two
+   racing attempts) — the hardest shape to account for;
+2. bench_serve records every request's trace id (``--trace-log``);
+3. a hedged trace is stitched across router + both replicas
+   (tools/trace_report.py) and its span-tree total must land within
+   10% of the latency the CLIENT measured for that same request — the
+   acceptance bar that the decomposition actually adds up;
+4. the stitched tree must contain the queue-wait and device-forward
+   spans (with the AOT program key) from the serving replica;
+5. the supervisor's ``GET /fleet/metrics.json`` must aggregate router +
+   both replicas (the fleet pane rides the same scrape machinery).
+
+Prints one JSON verdict line; exit 0 = pass, 1 = fail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(_TOOLS)
+sys.path.insert(0, REPO)
+sys.path.insert(0, _TOOLS)
+
+WINDOW = 256
+HEDGE_MS = 15.0
+SLOW_MS = 50  # injected per-forward delay: every request out-waits the hedge
+TOLERANCE = 0.10
+WARM_TIMEOUT_S = 300.0
+
+
+def _log(msg: str) -> None:
+    print(f"[trace-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _drain(pipe, buf):
+    # A dead drain thread would let the 64 KB pipe buffer wedge the whole
+    # fleet on its next write (threadlint thread-target-raises).
+    try:
+        for line in pipe:
+            buf.append(line)
+    except Exception as e:  # noqa: BLE001 — log-and-die is the contract
+        _log(f"pipe drain died: {e!r}")
+
+
+def _get_json(url: str, path: str):
+    from seist_tpu.serve.router import _http_request
+
+    status, _, body = _http_request(url, "GET", path, timeout_s=10.0)
+    return status, json.loads(body.decode())
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SEIST_FAULT_SERVE_SLOW_MS"] = str(SLOW_MS)
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.join(_TOOLS, "supervise_fleet.py"),
+            "--replicas", "2",
+            "--base-port", str(_free_port()),
+            "--router-port", "0",
+            "--probe-interval-s", "0.3",
+            "--hedge-ms", str(HEDGE_MS),
+            "--request-timeout-s", "30",
+            "--fleet-scrape-interval-s", "1.0",
+            "--drain-timeout-s", "20",
+            "--",
+            sys.executable, os.path.join(REPO, "main.py"), "serve",
+            "--model", "phasenet=",
+            "--window", str(WINDOW),
+            "--max-batch", "4",
+            "--max-delay-ms", "5",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
+    )
+    err_buf: list = []
+    threading.Thread(target=_drain, args=(proc.stderr, err_buf),
+                     daemon=True).start()
+    router_url = None
+    for _ in range(50):
+        line = proc.stdout.readline()
+        if not line:
+            break
+        m = re.search(r"ROUTER=(http://[\d.]+:\d+)", line)
+        if m:
+            router_url = m.group(1)
+            break
+    if router_url is None:
+        proc.kill()
+        _log("FAIL: no ROUTER line from supervise_fleet\n"
+             + "".join(err_buf[-50:]))
+        return 1
+    threading.Thread(target=_drain, args=(proc.stdout, []),
+                     daemon=True).start()
+    _log(f"router at {router_url}")
+
+    verdict = {"ok": False}
+    try:
+        # ---- wait for both replicas probed-ready (first run compiles)
+        deadline = time.monotonic() + WARM_TIMEOUT_S
+        while time.monotonic() < deadline:
+            try:
+                _, payload = _get_json(router_url, "/router/replicas")
+                states = [r["probe_state"]
+                          for r in payload.get("replicas", [])]
+                if states.count("ok") >= 2:
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.25)
+        else:
+            raise AssertionError("fleet never reached 2 ready replicas")
+        replica_urls = [r["url"] for r in payload["replicas"]]
+        _log(f"replicas ready: {replica_urls}")
+
+        # ---- drive load, recording every request's trace id
+        import tempfile
+
+        import bench_serve
+
+        with tempfile.TemporaryDirectory() as tmp:
+            out = os.path.join(tmp, "bench.json")
+            tlog = os.path.join(tmp, "traces.jsonl")
+            rc = bench_serve.main([
+                "--url", router_url,
+                "--model-name", "phasenet",
+                "--window", str(WINDOW),
+                "--requests", "24",
+                "--concurrency", "4",
+                "--timeout-ms", "60000",
+                "--output", out,
+                "--trace-log", tlog,
+            ])
+            with open(out) as f:
+                bench = json.load(f)
+            client_lat = {}
+            with open(tlog) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    client_lat[rec["trace_id"]] = rec
+        assert rc == 0 and bench["errors"] == 0, (
+            f"bench failed rc={rc}: {bench}"
+        )
+        assert bench["trace_exemplars"]["slowest"], "no exemplars recorded"
+
+        # ---- find a hedged trace the client also measured
+        _, idx = _get_json(router_url, "/traces")
+        hedged = [
+            t for t in idx["traces"]
+            if "hedged" in t["flags"] and t["trace_id"] in client_lat
+            and client_lat[t["trace_id"]]["status"] == 200
+        ]
+        assert hedged, (
+            f"no hedged traces on the router "
+            f"(hedge_ms={HEDGE_MS}, slow_ms={SLOW_MS}): {idx['traces'][:5]}"
+        )
+        # The slowest hedged request: relative overheads are smallest.
+        pick = max(
+            hedged, key=lambda t: client_lat[t["trace_id"]]["latency_ms"]
+        )
+        trace_id = pick["trace_id"]
+        client_ms = client_lat[trace_id]["latency_ms"]
+
+        # ---- stitch across the fleet and check the acceptance bar
+        import trace_report
+
+        st = trace_report.stitch_from_endpoints(
+            trace_id, [router_url] + replica_urls
+        )
+        print(st.format(), file=sys.stderr, flush=True)
+        assert st.spans, "stitched trace is empty"
+        assert len(st.processes()) >= 2, (
+            f"trace did not cross processes: {st.processes()}"
+        )
+        assert st.find("queue_wait"), "no queue_wait span in the tree"
+        forwards = st.find("forward")
+        assert forwards, "no device-forward span in the tree"
+        assert any(
+            (s.get("annotations") or {}).get("program")
+            for s in forwards
+        ), f"forward span lacks the program key: {forwards}"
+        assert "hedged" in st.flags, st.flags
+        total = st.total_ms
+        rel = abs(total - client_ms) / client_ms
+        assert rel <= TOLERANCE, (
+            f"span tree total {total:.1f} ms vs client {client_ms:.1f} ms "
+            f"({rel:.1%} > {TOLERANCE:.0%})"
+        )
+
+        # ---- the fleet pane aggregates router + both replicas
+        _, fleet = _get_json(router_url, "/fleet/metrics.json")
+        assert fleet["up"] >= 3, fleet["sources"]
+        agg = fleet["aggregate"]
+        assert any(
+            k.startswith("serve_batcher_submitted")
+            for k in agg["collectors"]
+        ), sorted(agg["collectors"])[:10]
+
+        verdict = {
+            "ok": True,
+            "trace_id": trace_id,
+            "client_ms": client_ms,
+            "span_tree_total_ms": round(total, 3),
+            "rel_err": round(rel, 4),
+            "processes": st.processes(),
+            "flags": st.flags,
+            "fleet_sources_up": fleet["up"],
+        }
+        return 0
+    except AssertionError as e:
+        verdict = {"ok": False, "error": str(e)}
+        _log(f"FAIL: {e}")
+        return 1
+    finally:
+        print(json.dumps(verdict), flush=True)
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
