@@ -4,8 +4,8 @@ NATIVE_DIR := seist_tpu/native
 CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
-.PHONY: native test t1 lint lint-baseline lockgraph serve-smoke \
-	serve-chaos obs-smoke trace-smoke chaos clean
+.PHONY: native test t1 lint lint-baseline irlint-report lockgraph \
+	serve-smoke serve-chaos obs-smoke trace-smoke chaos clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -15,23 +15,36 @@ $(NATIVE_DIR)/libwavekit.so: $(NATIVE_DIR)/wavekit.cpp
 test:
 	python -m pytest tests/ -x -q
 
-# Static-analysis gate, BOTH analyzers (docs/STATIC_ANALYSIS.md):
-# jaxlint — JAX hot-path hazards (host syncs, PRNG key reuse, missing
-# donate_argnums, retraces, wall-clock intervals, broad excepts);
-# threadlint — concurrency/lifecycle hazards (unguarded shared attrs,
-# unsafe signal handlers, silent thread death, untimed waits, SYN-drop
-# backlogs, exit-code contract). Each fails only on findings not
-# grandfathered in its tools/<tool>_baseline.json.
+# Static-analysis gate, ALL THREE analyzers through one shared frontend
+# invocation (docs/STATIC_ANALYSIS.md; single interpreter startup, one
+# file walk feeding both AST passes, one manifest walk, combined exit
+# code): jaxlint — JAX hot-path hazards (host syncs, PRNG key reuse,
+# missing donate_argnums, retraces, wall-clock intervals, broad
+# excepts); threadlint — concurrency/lifecycle hazards (unguarded
+# shared attrs, unsafe signal handlers, silent thread death, untimed
+# waits, SYN-drop backlogs, exit-code contract); irlint — IR-level
+# properties of the LOWERED programs the repo ships (fp32 matmuls under
+# the bf16 policy, donation aliasing, in-program host transfers, bucket
+# padding waste, replicated data args on meshes). Each fails only on
+# findings not grandfathered in its tools/<tool>_baseline.json.
 lint:
-	python -m tools.jaxlint seist_tpu
-	python -m tools.threadlint seist_tpu tools
+	python -m tools.lint
 
 # Re-accept the current jaxlint findings (review the diff before
-# committing!). Deliberately does NOT touch tools/threadlint_baseline.json:
-# that baseline is empty by construction — fix the code or add a
-# rationale'd `# threadlint: disable` instead of grandfathering.
+# committing!). Deliberately does NOT touch tools/threadlint_baseline.json
+# or tools/irlint_baseline.json: both are empty by construction — fix the
+# code or add a rationale'd `# threadlint: disable` / `# irlint: disable`
+# instead of grandfathering (`python -m tools.irlint --update-baseline`
+# additionally REFUSES to write while its baseline is empty).
 lint-baseline:
 	python -m tools.jaxlint seist_tpu --update-baseline
+
+# Machine-readable IR audit (docs/STATIC_ANALYSIS.md "IR-level
+# analysis"): per-program bf16 matmul-FLOPs coverage, donation-aliasing
+# table, bucket padding waste, host-transfer counts — the numbers bench
+# and CI trend across commits.
+irlint-report:
+	python -m tools.irlint --report irlint_report.json
 
 # threadlint runtime audit lane (docs/STATIC_ANALYSIS.md): the smoke
 # lane with every in-test lock instrumented — fails on lock-order

@@ -612,7 +612,21 @@ class LSTM(nn.Module):
 
     @nn.compact
     def __call__(self, x: Array) -> Tuple[Array, Array]:
-        cell = nn.OptimizedLSTMCell(features=self.hidden)
+        from seist_tpu.train.precision import policy_dtype, policy_param_dtype
+
+        # Mixed-precision coverage (irlint f32-matmul-under-bf16-policy):
+        # OptimizedLSTMCell initializes its (c, h) carry via param_dtype —
+        # fp32 by default — and the fp32 h then PROMOTES every recurrent
+        # matmul (and the whole decoder downstream) back to fp32 under the
+        # bf16 policy. Pinning cell dtype + carry dtype to the trace-time
+        # policy keeps the recurrence in the compute dtype; params are
+        # already cast by the step-level policy (train/precision.py), and
+        # at init time the policy is inactive so params still init fp32.
+        cell = nn.OptimizedLSTMCell(
+            features=self.hidden,
+            dtype=policy_dtype(),
+            param_dtype=policy_param_dtype(),
+        )
         carry, outputs = nn.RNN(
             cell, return_carry=True, unroll=_lstm_unroll()
         )(x)

@@ -215,6 +215,16 @@ def _merge(acc: Dict[str, Dict[str, Any]], other: Dict[str, Dict[str, Any]]) -> 
             dst["example"] = rec.get("example")
 
 
+# Public aliases for the IR analyzer (tools/irlint): the lint coverage
+# fractions and the BENCH ``step_breakdown`` must agree about what a
+# matmul costs, so there is exactly ONE dot/conv FLOP accounting and one
+# call-graph walk — this one.
+dot_flops = _dot_flops
+conv_flops = _conv_flops
+sub_jaxprs = _sub_jaxprs
+inner_jaxpr = _inner
+
+
 def jaxpr_op_costs(closed_jaxpr) -> List[Dict[str, Any]]:
     """Per-primitive analytic cost records for a (Closed)Jaxpr, summed
     over every call site (scan bodies multiplied by trip count)."""

@@ -190,6 +190,97 @@ def outputs_to_f32(out: Any) -> Any:
     )
 
 
+def variant_compute(
+    apply_fn: Callable[[Any, Any], Any],
+    variant: str,
+    *,
+    cast_outputs: bool = True,
+) -> Callable[[Any, Any], Any]:
+    """-> ``fn(variables, x)``: THE in-trace definition of a variant's
+    compute convention, assuming ``variables`` already hold the
+    variant's weights-at-rest (``cast_variables``/``quantize_int8`` for
+    the runtime path; aval-level mirrors for tools/irlint's manifest —
+    sharing this one builder is what keeps the audited program and the
+    shipped executable from drifting apart):
+
+    * ``fp32`` — plain apply;
+    * ``bf16`` — input cast to bfloat16 and the whole trace run under
+      ``precision_policy(bf16)`` so trace-time-dtype modules (make_norm,
+      common.LSTM's carry) follow the variant — without the policy an
+      fp32 LSTM carry silently promotes the recurrence (and everything
+      downstream) back to fp32, forfeiting the bandwidth win (irlint
+      f32-matmul-under-bf16-policy);
+    * ``int8`` — weights dequantized INSIDE the trace (weight-only
+      quant: int8 at rest, fp32 compute).
+
+    ``cast_outputs=False`` for INTERIOR programs — a bf16 trunk hands
+    bf16 features to bf16 heads; casting in between would forfeit the
+    bandwidth win."""
+    import jax.numpy as jnp
+
+    out = outputs_to_f32 if cast_outputs else (lambda o: o)
+    if variant == "fp32":
+        return lambda v, x: apply_fn(v, x)
+    if variant == "bf16":
+        from seist_tpu.train.precision import precision_policy
+
+        def bf16_fn(v, x):
+            with precision_policy(jnp.bfloat16):
+                return out(apply_fn(v, x.astype(jnp.bfloat16)))
+
+        return bf16_fn
+    if variant == "int8":
+        return lambda v, x: out(apply_fn(dequantize(v), x))
+    raise ValueError(f"unknown variant {variant!r} (use one of {VARIANTS})")
+
+
+def head_variant_compute(model: Any, variant: str) -> Callable[..., Any]:
+    """-> ``fn(variables, feats, x)``: the in-trace head-program variant
+    convention of a task group (``models/seist.head_apply`` on the
+    trunk's features), shared by serve/pool.py's fallbacks/warm-up and
+    tools/irlint's manifest. bf16 heads consume the bf16 trunk features
+    as-is and cast only the raw input; int8 heads run fp32 compute, so
+    bf16-variant features widen at the boundary."""
+    import jax.numpy as jnp
+
+    from seist_tpu.models.seist import head_apply
+
+    if variant == "fp32":
+        return lambda v, feats, x: head_apply(model, v, feats, x)
+    if variant == "bf16":
+        from seist_tpu.train.precision import precision_policy
+
+        def bf16_fn(v, feats, x):
+            with precision_policy(jnp.bfloat16):
+                return outputs_to_f32(
+                    head_apply(model, v, feats, x.astype(jnp.bfloat16))
+                )
+
+        return bf16_fn
+    if variant == "int8":
+        return lambda v, feats, x: outputs_to_f32(
+            head_apply(
+                model, dequantize(v), feats.astype(jnp.float32), x
+            )
+        )
+    raise ValueError(f"unknown variant {variant!r} (use one of {VARIANTS})")
+
+
+def transform_variables(variables: Any, variant: str) -> Any:
+    """The eager (load-time) weight transform matching
+    :func:`variant_compute`'s conventions — the traced program holds
+    bf16/int8 weights at rest, it does not re-derive them per call."""
+    import jax.numpy as jnp
+
+    if variant == "fp32":
+        return variables
+    if variant == "bf16":
+        return cast_variables(variables, jnp.bfloat16)
+    if variant == "int8":
+        return quantize_int8(variables)
+    raise ValueError(f"unknown variant {variant!r} (use one of {VARIANTS})")
+
+
 def make_variant_apply(
     apply_fn: Callable[[Any, Any], Any],
     variables: Any,
@@ -197,35 +288,13 @@ def make_variant_apply(
     *,
     cast_outputs: bool = True,
 ) -> Callable[[Any], Any]:
-    """-> ``fn(x) -> outputs`` computing ``apply_fn(variables', x)`` under
-    the variant's weight/compute dtype, with float outputs cast back to
-    float32 so decode paths are variant-blind (``cast_outputs=False``
-    for INTERIOR programs — a bf16 trunk hands bf16 features to bf16
-    heads, casting in between would forfeit the bandwidth win). Weight
-    transforms run HERE, eagerly — the traced program holds bf16/int8
-    weights at rest, it does not re-derive them per call.
+    """-> ``fn(x) -> outputs``: :func:`transform_variables` (eager, at
+    load) closed over :func:`variant_compute` (the in-trace convention).
 
     ``apply_fn(variables, x)`` is the raw two-arg model apply."""
-    import jax.numpy as jnp
-
-    out = outputs_to_f32 if cast_outputs else (lambda o: o)
-    if variant == "fp32":
-        return lambda x: apply_fn(variables, x)
-    if variant == "bf16":
-        vb = cast_variables(variables, jnp.bfloat16)
-
-        def bf16_fn(x):
-            return out(apply_fn(vb, x.astype(jnp.bfloat16)))
-
-        return bf16_fn
-    if variant == "int8":
-        packed = quantize_int8(variables)
-
-        def int8_fn(x):
-            return out(apply_fn(dequantize(packed), x))
-
-        return int8_fn
-    raise ValueError(f"unknown variant {variant!r} (use one of {VARIANTS})")
+    compute = variant_compute(apply_fn, variant, cast_outputs=cast_outputs)
+    transformed = transform_variables(variables, variant)
+    return lambda x: compute(transformed, x)
 
 
 # -------------------------------------------------------------- parity gate

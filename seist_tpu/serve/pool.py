@@ -345,13 +345,15 @@ class MultiTaskEntry:
     def _make_fn(self, kind: str, variant: str) -> Callable:
         """Raw (unjitted) trunk or head program for ``variant``.
 
-        The trunk keeps its features in the variant's compute dtype
-        (casting back to fp32 between trunk and head would forfeit the
-        bandwidth win); heads cast their outputs to fp32 so decode is
-        variant-blind. Weight transforms (bf16 cast / int8 pack) happen
-        HERE, eagerly — never inside the traced program, so executables
-        really do hold bf16/int8 weights at rest."""
-        from seist_tpu.models.seist import backbone_apply, head_apply
+        The in-trace variant conventions live in ONE place —
+        ``aot.variant_compute`` / ``aot.head_variant_compute`` (shared
+        with tools/irlint's manifest, so the audited program cannot
+        drift from the shipped one); weight transforms (bf16 cast / int8
+        pack) happen HERE, eagerly, so executables really do hold
+        bf16/int8 weights at rest. The trunk keeps its features in the
+        variant's compute dtype (``cast_outputs=False``); heads cast
+        their outputs to fp32 so decode is variant-blind."""
+        from seist_tpu.models.seist import backbone_apply
 
         if kind == "trunk":
             return aot.make_variant_apply(
@@ -361,40 +363,9 @@ class MultiTaskEntry:
                 cast_outputs=False,  # bf16 features flow to bf16 heads
             )
         head = self.heads[kind]
-        if variant == "fp32":
-            hv = head.variables
-
-            def head_fn(feats, x):
-                return head_apply(head.model, hv, feats, x)
-
-        elif variant == "bf16":
-            import jax.numpy as jnp
-
-            hv = aot.cast_variables(head.variables, jnp.bfloat16)
-
-            def head_fn(feats, x):
-                return aot.outputs_to_f32(
-                    head_apply(head.model, hv, feats, x.astype(jnp.bfloat16))
-                )
-
-        elif variant == "int8":
-            import jax.numpy as jnp
-
-            packed = aot.quantize_int8(head.variables)
-
-            def head_fn(feats, x):
-                return aot.outputs_to_f32(
-                    head_apply(
-                        head.model,
-                        aot.dequantize(packed),
-                        feats.astype(jnp.float32),
-                        x,
-                    )
-                )
-
-        else:
-            raise ValueError(f"unknown variant {variant!r}")
-        return head_fn
+        compute = aot.head_variant_compute(head.model, variant)
+        hv = aot.transform_variables(head.variables, variant)
+        return lambda feats, x: compute(hv, feats, x)
 
     def fanout(
         self,

@@ -20,13 +20,27 @@ the trace-time policy below for that.
 
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
-_POLICY: dict = {"dtype": None}
+
+class _Policy(threading.local):
+    """Per-THREAD active policy. The train worker is single-threaded,
+    but the serve plane traces programs under ``precision_policy`` from
+    several threads at once (async warm-up, per-variant batcher flush
+    threads building live-jit fallbacks): with a process-global policy,
+    an fp32 program traced while another thread holds the bf16 policy
+    would silently compile bf16 norms/LSTM carries into the fp32
+    (parity-reference) executable."""
+
+    dtype = None  # class attr = the per-thread default
+
+
+_POLICY = _Policy()
 
 
 def resolve_dtype(name: Optional[str]):
@@ -43,18 +57,36 @@ def resolve_dtype(name: Optional[str]):
 
 def policy_dtype():
     """The active compute dtype (None outside a ``precision_policy`` block)."""
-    return _POLICY["dtype"]
+    return _POLICY.dtype
+
+
+def policy_param_dtype():
+    """Dtype for trace-time-created carries/params of policy-aware modules
+    (``models/common.py::make_norm``'s norm dtype, ``common.LSTM``'s cell
+    carry): the active compute dtype, fp32 outside a policy block.
+
+    This is the contract the irlint ``f32-matmul-under-bf16-policy`` rule
+    audits: any module that materializes a NEW floating array at trace
+    time (an RNN carry, a norm's internal stats) must draw its dtype from
+    the policy — one fp32 trace-time array silently promotes every matmul
+    downstream of it back to fp32 (the eqtransformer/magnet LSTM-carry
+    gap: bf16 coverage 0.44/0.41 until the carry followed the policy).
+    Step-level casting (``cast_floating`` on params/inputs) cannot reach
+    these arrays because they never exist outside the trace.
+    """
+    return _POLICY.dtype or jnp.float32
 
 
 @contextmanager
 def precision_policy(dtype):
-    """Activate a compute dtype for the duration of a model trace."""
-    old = _POLICY["dtype"]
-    _POLICY["dtype"] = dtype
+    """Activate a compute dtype for the duration of a model trace
+    (thread-scoped — see :class:`_Policy`)."""
+    old = _POLICY.dtype
+    _POLICY.dtype = dtype
     try:
         yield
     finally:
-        _POLICY["dtype"] = old
+        _POLICY.dtype = old
 
 
 def cast_floating(tree: Any, dtype) -> Any:

@@ -1,0 +1,11 @@
+"""irlint — IR-level static analysis of the repo's *lowered* programs.
+
+Third analyzer of the jaxlint/threadlint family (shared engine frontend,
+rationale-required suppressions, line-shift-proof baseline, ``make lint``
+gate) whose unit of analysis is a lowered XLA program, not a source file:
+a program manifest (tools/irlint/manifest.py) enumerates every jit
+boundary the repo ships, lowers each from ``eval_shape``-derived avals
+(no weights, no device execution) and walks the jaxpr/StableHLO with the
+rule catalog in tools/irlint/rules.py. See docs/STATIC_ANALYSIS.md
+"IR-level analysis".
+"""

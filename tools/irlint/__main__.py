@@ -1,0 +1,286 @@
+"""irlint CLI — the shared analyzer frontend over the program manifest.
+
+    python -m tools.irlint                          # full manifest, gate
+    python -m tools.irlint 'serve/*'                # program-key subset
+    python -m tools.irlint --report irlint_report.json
+    python -m tools.irlint --list-rules
+    python -m tools.irlint --list-programs
+
+Exit codes mirror the sibling analyzers: 0 clean (vs baseline), 1 new
+findings, 2 usage / program-lowering error. Suppressions are ordinary
+``# irlint: disable=<rule> -- rationale`` comments at a program's
+REGISTRATION SITE (the ``def`` line findings anchor to); the baseline
+(tools/irlint_baseline.json) is empty by construction and
+--update-baseline refuses to touch it while it stays that way.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import sys
+import traceback
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.irlint.manifest import ensure_cpu_backend
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_BASELINE = os.path.join(_REPO_ROOT, "tools", "irlint_baseline.json")
+
+
+def _add_args(ap) -> None:
+    ap.add_argument(
+        "--report",
+        default="",
+        help="write the per-program machine-readable report JSON here",
+    )
+    ap.add_argument(
+        "--list-programs",
+        action="store_true",
+        help="print the manifest's program keys and exit",
+    )
+    ap.add_argument(
+        "--window", type=int, default=512,
+        help="analysis window length (trace-time only; default 512)",
+    )
+    ap.add_argument(
+        "--buckets", default="4",
+        help="comma-separated serve buckets to lower (default 4)",
+    )
+    ap.add_argument(
+        "--ladder", default="1,2,4",
+        help="declared serve bucket ladder for the padding audit",
+    )
+    ap.add_argument(
+        "--variants", default="fp32,bf16",
+        help="serve variants to lower (fp32,bf16,int8)",
+    )
+    ap.add_argument(
+        "--group", default="seist_s",
+        help="task group for the shared-trunk serve table",
+    )
+    ap.add_argument(
+        "--group-tasks", default="dpk,emg,dis",
+        help="tasks of the analyzed group",
+    )
+
+
+def _csv_ints(s: str) -> Tuple[int, ...]:
+    return tuple(int(x) for x in s.split(",") if x.strip())
+
+
+def _csv(s: str) -> Tuple[str, ...]:
+    return tuple(x.strip() for x in s.split(",") if x.strip())
+
+
+def apply_site_suppressions(
+    findings: List,
+    site_files: Sequence[str],
+    *,
+    root: str,
+    full_catalog: bool,
+) -> List:
+    """Honor ``# irlint: disable=<rule> -- rationale`` comments at the
+    registration sites findings anchor to — the engine's suppression
+    grammar and semantics (rationale required, comment-above idiom,
+    tag-scoped so a jaxlint/threadlint comment can never silence an
+    irlint finding), applied to manifest findings instead of AST ones.
+    ``full_catalog`` enables unused-suppression reporting (mirroring the
+    engine: a --select subset would make every un-run rule's suppression
+    look stale)."""
+    from tools.jaxlint.engine import Finding, ModuleInfo, parse_suppressions
+
+    mod_cache: Dict[str, ModuleInfo] = {}
+    sups_by_file: Dict[str, Dict] = {}
+    problems: List[Finding] = []
+    for rel in site_files:
+        path = os.path.join(root, rel)
+        with open(path, encoding="utf-8") as f:
+            mod_cache[rel] = ModuleInfo(rel, f.read())
+        sups_by_file[rel], probs = parse_suppressions(
+            mod_cache[rel], tag="irlint"
+        )
+        problems.extend(probs)
+    kept: List[Finding] = []
+    for f in findings:
+        sup = sups_by_file.get(f.file, {}).get(f.line)
+        if sup is not None and f.rule != "parse-error" and sup.covers(f.rule):
+            sup.used = True
+            continue
+        kept.append(f)
+    out = kept + problems
+    if full_catalog:
+        seen_ids = set()
+        for rel, sups in sups_by_file.items():
+            for sup in sups.values():
+                if id(sup) in seen_ids or sup.used:
+                    continue
+                seen_ids.add(id(sup))
+                out.append(
+                    Finding(
+                        file=rel,
+                        line=sup.line,
+                        col=0,
+                        rule="unused-suppression",
+                        message=(
+                            "suppression matches no finding (rules: "
+                            f"{', '.join(sup.rules)}) — the program it "
+                            "excused is clean or the rule name is wrong"
+                        ),
+                        hint="delete the stale `# irlint: disable` comment",
+                        text=mod_cache[rel].line_text(sup.line),
+                    )
+                )
+    return out
+
+
+def collect(args, rules) -> Tuple[List, set]:
+    """The manifest collector the shared frontend plugs in where the AST
+    analyzers walk files: build + filter the manifest, lower + lint every
+    program, apply site-file suppressions, write the report."""
+    from tools.irlint import rules as irrules
+    from tools.irlint.manifest import default_manifest
+    from tools.jaxlint.engine import Finding
+
+    match = None
+    if args.paths:
+        match = lambda key: any(  # noqa: E731
+            fnmatch.fnmatch(key, g) for g in args.paths
+        )
+    programs = default_manifest(
+        window=args.window,
+        buckets=_csv_ints(args.buckets),
+        ladder=_csv_ints(args.ladder),
+        variants=_csv(args.variants),
+        serve_group=args.group,
+        group_tasks=_csv(args.group_tasks),
+        match=match,
+    )
+    if not programs:
+        raise FileNotFoundError(
+            f"no manifest program matches {args.paths}"
+        )
+    if args.list_programs:
+        for p in programs:
+            print(f"{p.key}  ({p.site.file}:{p.site.line}, {p.policy})")
+        raise SystemExit(0)
+
+    findings: List[Finding] = []
+    report: Dict[str, Dict] = {}
+    linted: set = set()
+    for spec in programs:
+        linted.add(spec.site.file)
+        try:
+            info_list = irrules.lint_programs([spec], rules)
+        except Exception as e:  # noqa: BLE001 - a program that fails to
+            # lower must fail the gate loudly (exit 2 via parse-error),
+            # never silently shrink the manifest to green.
+            traceback.print_exc(file=sys.stderr)
+            findings.append(
+                Finding(
+                    file=spec.site.file,
+                    line=spec.site.line,
+                    col=0,
+                    rule="parse-error",
+                    message=(
+                        f"[{spec.key}] program failed to lower/lint: "
+                        f"{e!r}"
+                    ),
+                    text=spec.site.text,
+                )
+            )
+            continue
+        for info in info_list:
+            findings.extend(info.findings)
+            report[spec.key] = info.report
+
+    findings = apply_site_suppressions(
+        findings,
+        sorted(linted),
+        root=args.root,
+        full_catalog=rules is None,
+    )
+
+    if args.report:
+        payload = {
+            "schema_version": 1,
+            "tool": "irlint",
+            "programs": report,
+            "summary": _summarize(report),
+        }
+        with open(args.report, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(
+            f"irlint: report for {len(report)} program(s) -> {args.report}",
+            file=sys.stderr,
+        )
+    findings.sort(key=lambda f: (f.file, f.line, f.col))
+    return findings, linted
+
+
+def _summarize(report: Dict[str, Dict]) -> Dict:
+    """Trendable roll-up: the numbers bench/CI watch across commits."""
+    cov = {
+        k: r["matmul"]["coverage"]
+        for k, r in report.items()
+        if r.get("matmul", {}).get("coverage") is not None
+    }
+    pad = {
+        k: r["padding"]["waste_frac_worst"]
+        for k, r in report.items()
+        if "padding" in r
+    }
+    transfers = sum(
+        t["count"]
+        for r in report.values()
+        for t in r.get("host_transfers", ())
+    )
+    donated = sum(
+        r.get("donation", {}).get("donated_leaves", 0)
+        for r in report.values()
+    )
+    aliased = sum(
+        r.get("donation", {}).get("aliased_leaves", 0)
+        for r in report.values()
+    )
+    deferred = sum(
+        r.get("donation", {}).get("deferred_leaves", 0)
+        for r in report.values()
+    )
+    return {
+        "programs": len(report),
+        "bf16_coverage_min": min(cov.values()) if cov else None,
+        "bf16_coverage_by_program": cov,
+        "padding_waste_worst": max(pad.values()) if pad else None,
+        "host_transfers_total": transfers,
+        "donated_leaves": donated,
+        "aliased_leaves": aliased,
+        "deferred_alias_leaves": deferred,
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ensure_cpu_backend()
+    from tools.irlint.rules import RULES, RULES_BY_NAME
+    from tools.jaxlint.__main__ import run
+
+    return run(
+        argv,
+        tag="irlint",
+        catalog=RULES,
+        rules_by_name=RULES_BY_NAME,
+        default_baseline=_BASELINE,
+        docs="docs/STATIC_ANALYSIS.md",
+        example_paths="",
+        collect=collect,
+        add_args=_add_args,
+        refuse_empty_baseline_update=True,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,7 +15,7 @@ import argparse
 import json
 import os
 import sys
-from typing import Dict, Optional, Sequence
+from typing import Callable, Dict, Optional, Sequence
 
 from tools.jaxlint.engine import (
     META_RULES,
@@ -38,14 +38,34 @@ def run(
     default_baseline: str,
     docs: str,
     example_paths: str = "seist_tpu",
+    collect: Optional[Callable] = None,
+    add_args: Optional[Callable] = None,
+    refuse_empty_baseline_update: bool = False,
+    source_cache: Optional[Dict[str, str]] = None,
 ) -> int:
     """The shared gate frontend. ``tag`` is both the suppression-comment
-    tag and the ``python -m tools.<tag>`` program name."""
+    tag and the ``python -m tools.<tag>`` program name.
+
+    The AST analyzers (jaxlint, threadlint) use the default file walk;
+    irlint swaps in ``collect(args, rules) -> (findings, linted_keys)``,
+    which lowers its program manifest instead of walking files —
+    baseline/suppression/staleness semantics are identical either way.
+    ``add_args`` extends the argparse surface (irlint's --report/--window
+    ...); ``refuse_empty_baseline_update`` hard-errors --update-baseline
+    against an existing EMPTY baseline (empty-by-construction invariant);
+    ``source_cache`` ({abspath: source}) lets a combined runner
+    (tools/lint.py) walk + read every file exactly once for all
+    analyzers."""
     ap = argparse.ArgumentParser(
         prog=f"python -m tools.{tag}",
         description=f"{tag} static analysis (see {docs})",
     )
-    ap.add_argument("paths", nargs="*", default=[], help="files/dirs to lint")
+    paths_help = (
+        "program-key globs to lint (default: the full manifest)"
+        if collect is not None
+        else "files/dirs to lint"
+    )
+    ap.add_argument("paths", nargs="*", default=[], help=paths_help)
     ap.add_argument(
         "--baseline",
         default=default_baseline,
@@ -78,6 +98,8 @@ def run(
         default=_REPO_ROOT,
         help="path findings are reported relative to (baseline keys)",
     )
+    if add_args is not None:
+        add_args(ap)
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -85,7 +107,7 @@ def run(
             print(f"{rule.name}\n    {rule.summary}\n    fix: {rule.hint}")
         return 0
 
-    if not args.paths:
+    if not args.paths and collect is None:
         ap.error(
             f"no paths given (try: python -m tools.{tag} {example_paths})"
         )
@@ -106,10 +128,35 @@ def run(
             )
         rules = [rules_by_name[n] for n in names]
 
+    if args.update_baseline and refuse_empty_baseline_update:
+        existing = Baseline.load(args.baseline)
+        if os.path.exists(args.baseline) and not existing.counts:
+            print(
+                f"{tag}: refusing --update-baseline: "
+                f"{os.path.relpath(args.baseline, args.root)} is EMPTY BY "
+                "CONSTRUCTION — fix the finding or add a rationale'd "
+                f"`# {tag}: disable` at the program's registration site "
+                "instead of grandfathering",
+                file=sys.stderr,
+            )
+            return 2
+
     try:
-        findings = lint_paths(
-            args.paths, root=args.root, rules=rules, tag=tag, catalog=catalog
-        )
+        if collect is not None:
+            findings, linted = collect(args, rules)
+        else:
+            findings = lint_paths(
+                args.paths, root=args.root, rules=rules, tag=tag,
+                catalog=catalog, source_cache=source_cache,
+            )
+            linted = {
+                os.path.relpath(
+                    os.path.abspath(p), os.path.abspath(args.root)
+                ).replace(os.sep, "/")
+                for p in iter_python_files(
+                    args.paths, os.path.abspath(args.root)
+                )
+            }
     except FileNotFoundError as e:
         print(f"{tag}: {e}", file=sys.stderr)
         return 2
@@ -118,12 +165,6 @@ def run(
             if f.rule == "parse-error":
                 print(f.render(), file=sys.stderr)
         return 2
-
-    linted = {
-        os.path.relpath(os.path.abspath(p), os.path.abspath(args.root))
-        .replace(os.sep, "/")
-        for p in iter_python_files(args.paths, os.path.abspath(args.root))
-    }
 
     if args.update_baseline:
         # Merge, don't overwrite: accepted entries for files OUTSIDE this

@@ -450,14 +450,21 @@ def lint_paths(
     rules: Optional[Sequence] = None,
     tag: str = "jaxlint",
     catalog: Optional[Sequence] = None,
+    source_cache: Optional[Dict[str, str]] = None,
 ) -> List[Finding]:
+    """``source_cache`` ({abspath: source}) short-circuits the file read —
+    the combined ``tools/lint.py`` runner walks and reads every file ONCE
+    and feeds both AST analyzers from the same cache."""
     root = os.path.abspath(root or os.getcwd())
     findings: List[Finding] = []
     for fpath in iter_python_files(paths, root):
-        rel = os.path.relpath(os.path.abspath(fpath), root).replace(
-            os.sep, "/"
-        )
-        with open(fpath, encoding="utf-8") as f:
-            source = f.read()
+        ap = os.path.abspath(fpath)
+        rel = os.path.relpath(ap, root).replace(os.sep, "/")
+        source = None if source_cache is None else source_cache.get(ap)
+        if source is None:
+            with open(fpath, encoding="utf-8") as f:
+                source = f.read()
+            if source_cache is not None:
+                source_cache[ap] = source
         findings.extend(lint_source(source, rel, rules, tag, catalog))
     return findings

@@ -1,0 +1,114 @@
+"""Combined static-analysis gate: jaxlint + threadlint + irlint in ONE
+interpreter invocation (``make lint``).
+
+The three analyzers share the engine frontend (tools/jaxlint/__main__.py
+``run``); this runner additionally shares the FILE WALK — every source
+file under the AST analyzers' paths is read exactly once into a source
+cache both consume — and combines the exit codes (worst wins, usage
+errors beat findings). irlint's manifest walk happens once as well; its
+extra flags keep their defaults here (use ``python -m tools.irlint`` to
+vary them).
+
+    python -m tools.lint              # the full gate
+    python -m tools.lint --skip-ir    # AST analyzers only (fast loop)
+
+Exit codes: 0 all clean, 1 new findings in any analyzer, 2 usage/parse/
+lowering error in any analyzer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+# irlint lowers real programs: the backend must be pinned BEFORE the
+# first jax import (a lint gate must never touch the TPU tunnel).
+from tools.irlint.manifest import ensure_cpu_backend
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: (analyzer, lint paths) — the same path sets the standalone gates use.
+AST_ANALYZERS = (
+    ("jaxlint", ("seist_tpu",)),
+    ("threadlint", ("seist_tpu", "tools")),
+)
+
+
+def _prewalk(paths: Sequence[str]) -> Dict[str, str]:
+    """ONE os.walk + read over the union of all analyzers' paths."""
+    from tools.jaxlint.engine import iter_python_files
+
+    cache: Dict[str, str] = {}
+    for p in iter_python_files(sorted(set(paths)), _REPO_ROOT):
+        ap = os.path.abspath(p)
+        with open(ap, encoding="utf-8") as f:
+            cache[ap] = f.read()
+    return cache
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ensure_cpu_backend()
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument(
+        "--skip-ir",
+        action="store_true",
+        help="run only the AST analyzers (no program lowering)",
+    )
+    args = ap.parse_args(argv)
+
+    from tools.jaxlint.__main__ import run
+    from tools.jaxlint.rules import RULES as JAX_RULES
+    from tools.jaxlint.rules import RULES_BY_NAME as JAX_BY_NAME
+    from tools.threadlint.rules import RULES as THREAD_RULES
+    from tools.threadlint.rules import RULES_BY_NAME as THREAD_BY_NAME
+
+    all_paths: List[str] = []
+    for _tag, paths in AST_ANALYZERS:
+        all_paths.extend(paths)
+    cache = _prewalk(all_paths)
+
+    rcs: Dict[str, int] = {}
+    print("== jaxlint ==")
+    rcs["jaxlint"] = run(
+        list(AST_ANALYZERS[0][1]),
+        tag="jaxlint",
+        catalog=JAX_RULES,
+        rules_by_name=JAX_BY_NAME,
+        default_baseline=os.path.join(
+            _REPO_ROOT, "tools", "jaxlint_baseline.json"
+        ),
+        docs="docs/STATIC_ANALYSIS.md",
+        source_cache=cache,
+    )
+    print("== threadlint ==")
+    rcs["threadlint"] = run(
+        list(AST_ANALYZERS[1][1]),
+        tag="threadlint",
+        catalog=THREAD_RULES,
+        rules_by_name=THREAD_BY_NAME,
+        default_baseline=os.path.join(
+            _REPO_ROOT, "tools", "threadlint_baseline.json"
+        ),
+        docs="docs/STATIC_ANALYSIS.md",
+        source_cache=cache,
+    )
+    if not args.skip_ir:
+        print("== irlint ==")
+        from tools.irlint.__main__ import main as irlint_main
+
+        rcs["irlint"] = irlint_main([])
+
+    # Usage/lowering errors (2) dominate findings (1) dominate clean (0).
+    worst = max(rcs.values())
+    summary = ", ".join(f"{tag}={rc}" for tag, rc in rcs.items())
+    print(f"lint: {summary} -> exit {worst}")
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())
