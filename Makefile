@@ -5,7 +5,7 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
 .PHONY: native test t1 lint lint-baseline irlint-report lockgraph \
-	serve-smoke serve-chaos obs-smoke trace-smoke chaos clean
+	serve-smoke serve-chaos obs-smoke trace-smoke rollout-smoke chaos clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -101,12 +101,24 @@ serve-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu python tools/trace_smoke.py
 
+# Live-rollout smoke (docs/SERVING.md "Live rollout"): a real 2-replica
+# phasenet fleet rolled to a new model version (SIGHUP + --rollout-file)
+# under sustained open-loop load — asserts zero failed requests, fleet
+# convergence on the target version, and zero stale-version responses
+# after convergence (bench_serve --expect-version gate). One JSON
+# verdict line; the 3-replica variant is the serve-chaos flywheel test.
+rollout-smoke:
+	JAX_PLATFORMS=cpu python tools/rollout_smoke.py
+
 # Serving chaos lane (docs/FAULT_TOLERANCE.md "Serving faults"): real
 # replica subprocesses under SEIST_FAULT_SERVE_* — SIGKILL-mid-load with
-# zero client-visible failures, black-hole circuit open/close, and
-# overload shedding that protects the alert tier's SLO. The fleet
-# supervisor + router units (model-free) ride along. Subset of `make
-# chaos`, runnable alone when iterating on serve/.
+# zero client-visible failures, black-hole circuit open/close, overload
+# shedding that protects the alert tier's SLO, the live-rollout
+# flywheel (3-replica roll under sustained load: zero failures, zero
+# stale versions after convergence), and canary auto-rollback of an
+# injected bad candidate. The fleet supervisor + router + rollout units
+# (model-free) ride along. Subset of `make chaos`, runnable alone when
+# iterating on serve/.
 serve-chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serve_chaos.py \
 	  tests/test_serve_fleet.py tests/test_router.py -q \

@@ -63,7 +63,10 @@ TRACEPARENT_HEADER = "traceparent"
 
 #: Tail-retention flags: a trace carrying any of these is always kept
 #: and evicted last (docs/OBSERVABILITY.md "Distributed tracing").
-FLAGS = ("error", "shed", "retried", "hedged", "slo_breach")
+#: ``canary_rollback`` marks the request whose settle tripped a canary
+#: auto-rollback (serve/router.py) — the rollout post-mortem handle.
+FLAGS = ("error", "shed", "retried", "hedged", "slo_breach",
+         "canary_rollback")
 
 _TRACEPARENT_RE = re.compile(
     r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$"

@@ -12,9 +12,17 @@ tier. See docs/SERVING.md.
     seist_tpu.serve.server     ServeService core + HTTP shim + `serve` CLI
     seist_tpu.serve.router     front-tier router: health-checked replica
                                registry, circuit breaking, retries, hedging
+    seist_tpu.serve.canary     live-rollout traffic shifting: canary with
+                               auto-rollback + shadow-mode decision diffs
 """
 
 from seist_tpu.serve.batcher import BatcherConfig, MicroBatcher  # noqa: F401
+from seist_tpu.serve.canary import (  # noqa: F401
+    CanaryBudget,
+    CanaryController,
+    ShadowMirror,
+    decision_diff,
+)
 from seist_tpu.serve.pool import ModelPool, load_model_entry  # noqa: F401
 from seist_tpu.serve.protocol import PredictOptions, ServeError  # noqa: F401
 from seist_tpu.serve.router import (  # noqa: F401

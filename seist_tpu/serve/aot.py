@@ -298,6 +298,22 @@ def make_variant_apply(
 
 
 # -------------------------------------------------------------- parity gate
+def outputs_finite(out: Any) -> bool:
+    """True iff every floating leaf of a program's outputs is finite —
+    the reload gate's last rung: a checkpoint full of NaNs lowers,
+    compiles and parity-gates against itself just fine, and must still
+    never earn traffic (serve/pool.ModelPool.reload)."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not bool(
+            np.all(np.isfinite(arr))
+        ):
+            return False
+    return True
+
+
 def variant_parity(
     fp32_out: Any, variant_out: Any, variant: str, *, kind: str,
     scale: float = 1.0,

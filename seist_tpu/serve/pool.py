@@ -27,7 +27,16 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import numpy as np
 
@@ -36,7 +45,10 @@ from seist_tpu.serve import aot
 from seist_tpu.serve.batcher import _slice_outputs
 from seist_tpu.serve.protocol import (
     BadRequest,
+    IncompatibleCheckpoint,
+    ParityGateFailed,
     PredictOptions,
+    ReloadFailed,
     ServeError,
     UnknownModel,
 )
@@ -52,7 +64,13 @@ def _load_parts(
     model_name: str, checkpoint: str, *, window: int, seed: int
 ) -> Tuple[Any, Dict[str, Any], Any, int, Optional[str]]:
     """Create + restore one model: (model, variables, spec, in_channels,
-    channel0). The shared loader behind single entries AND group heads."""
+    channel0). The shared loader behind single entries AND group heads.
+
+    Restored checkpoints are structurally validated against the model
+    config BEFORE anything serves (or swaps) them: a wrong-architecture
+    checkpoint raises :class:`IncompatibleCheckpoint` naming the first
+    mismatching tree path instead of surfacing as a deep flax apply
+    traceback on the first request."""
     import seist_tpu
     from seist_tpu import taskspec
     from seist_tpu.models import api
@@ -70,6 +88,12 @@ def _load_parts(
         variables = {"params": restored["params"]}
         if restored.get("batch_stats"):  # omit entirely for BN-less models
             variables["batch_stats"] = restored["batch_stats"]
+        expected = api.param_shapes(
+            model, in_samples=window, in_channels=in_channels
+        )
+        validate_checkpoint_tree(
+            expected, variables, model_name=model_name, checkpoint=checkpoint
+        )
     else:
         variables = api.init_variables(
             model, seed=seed, in_samples=window, in_channels=in_channels
@@ -84,6 +108,72 @@ def _load_parts(
         else None
     )
     return model, variables, spec, in_channels, channel0
+
+
+def validate_checkpoint_tree(
+    expected: Any, restored: Any, *, model_name: str, checkpoint: str
+) -> None:
+    """Structurally diff a restored checkpoint against the model config's
+    expected variable tree (``api.param_shapes`` — shape-only, no
+    compute) and raise :class:`IncompatibleCheckpoint` naming the FIRST
+    mismatching path. Checked before any serving/swap: the reload path's
+    "disable, don't serve wrong" ladder starts here.
+
+    An expected collection that is empty (no BN -> no batch_stats) is
+    optional; everything else must match key-for-key in structure, shape
+    and dtype."""
+
+    def fail(kind: str, path: str, detail: str = "") -> None:
+        raise IncompatibleCheckpoint(
+            f"checkpoint '{checkpoint}' does not fit model "
+            f"'{model_name}': {kind} at '{path}'"
+            + (f" ({detail})" if detail else "")
+        )
+
+    def walk(exp: Any, got: Any, path: str) -> None:
+        exp_map = isinstance(exp, Mapping)
+        got_map = isinstance(got, Mapping)
+        if exp_map != got_map:
+            fail(
+                "subtree/leaf mismatch", path,
+                f"expected {'subtree' if exp_map else 'array'}, "
+                f"checkpoint has {'subtree' if got_map else 'array'}",
+            )
+        if exp_map:
+            for k in sorted(exp):
+                if k not in got:
+                    fail("missing key", f"{path}/{k}" if path else str(k))
+            for k in sorted(got):
+                if k not in exp:
+                    fail("unexpected key", f"{path}/{k}" if path else str(k))
+            for k in sorted(exp):
+                walk(exp[k], got[k], f"{path}/{k}" if path else str(k))
+            return
+        exp_shape = tuple(getattr(exp, "shape", ()))
+        got_shape = tuple(getattr(got, "shape", ()))
+        if exp_shape != got_shape:
+            fail("shape mismatch", path,
+                 f"model wants {exp_shape}, checkpoint has {got_shape}")
+        exp_dt = np.dtype(getattr(exp, "dtype", np.float32))
+        got_dt = np.dtype(getattr(got, "dtype", np.float32))
+        if exp_dt != got_dt:
+            fail("dtype mismatch", path,
+                 f"model wants {exp_dt}, checkpoint has {got_dt}")
+
+    exp_cols = {
+        k: v for k, v in dict(expected).items() if not (
+            isinstance(v, Mapping) and not v  # empty col = optional
+        )
+    }
+    got_cols = dict(restored)
+    for col in sorted(exp_cols):
+        if col not in got_cols:
+            fail("missing collection", col)
+    for col in sorted(got_cols):
+        if col not in exp_cols:
+            fail("unexpected collection", col)
+    for col in sorted(exp_cols):
+        walk(exp_cols[col], got_cols[col], col)
 
 
 @dataclass
@@ -102,6 +192,12 @@ class ModelEntry:
     channel0: Optional[str]  # 'non'/'det' for picking heads, else None
     forward: Callable[[Any], Any]  # jitted, (B, window, C) -> outputs
     apply: Callable[[Any], Any]  # same, unjitted (for jax.jit composition)
+    #: monotonic model version (stamped into every response + /healthz);
+    #: a hot reload (ModelPool.reload) installs a higher one.
+    version: int = 1
+    #: checkpoint path this entry was restored from ("" = fresh init) —
+    #: the reload default when the caller only bumps the version.
+    checkpoint: str = ""
     variants: Tuple[str, ...] = ("fp32",)
     # variant -> bucket -> AotProgram (filled by build_programs)
     programs: Dict[str, Dict[int, aot.AotProgram]] = field(
@@ -267,6 +363,11 @@ class MultiTaskEntry:
     heads: Dict[str, TaskHead]
     trunk_model: Any
     trunk_variables: Dict[str, Any]
+    #: monotonic model version (see ModelEntry.version)
+    version: int = 1
+    #: per-task checkpoint paths this group was restored from — the
+    #: reload defaults for tasks the caller doesn't re-point.
+    task_checkpoints: Dict[str, str] = field(default_factory=dict)
     variants: Tuple[str, ...] = ("fp32",)
     # (variant, 'trunk'|task, bucket) -> AotProgram
     programs: Dict[Tuple[str, str, int], aot.AotProgram] = field(
@@ -574,6 +675,7 @@ def load_model_entry(
         channel0=channel0,
         forward=jax.jit(apply_fn),
         apply=apply_fn,
+        checkpoint=checkpoint,
         variants=_check_variants(variants),
     )
 
@@ -657,6 +759,7 @@ def load_group_entry(
         heads=heads,
         trunk_model=trunk_model,
         trunk_variables=trunk_vars,
+        task_checkpoints={task: ckpt for task, ckpt in task_entries},
         variants=_check_variants(variants),
     )
 
@@ -673,7 +776,9 @@ def _check_variants(variants: Sequence[str]) -> Tuple[str, ...]:
 
 class ModelPool:
     """Loaded entries keyed by model/group name + the warm-up that
-    AOT-compiles all serving programs up front."""
+    AOT-compiles all serving programs up front. :meth:`reload` hot-swaps
+    one entry for a new checkpoint after the candidate passes the same
+    load-time gates."""
 
     def __init__(
         self,
@@ -685,12 +790,22 @@ class ModelPool:
             Sequence[Tuple[str, Sequence[Tuple[str, str]]]]
         ] = None,
         variants: Sequence[str] = ("fp32",),
+        version: int = 1,
     ):
         if not entries and not groups:
             raise ValueError(
                 "ModelPool needs at least one (name, checkpoint) entry "
                 "or one task group"
             )
+        self._window = window
+        self._seed = seed
+        self._variants = tuple(variants)
+        self._reload_lock = threading.Lock()  # one candidate at a time
+        # Guards the entry dict + warmup_report only (microseconds): the
+        # request path reads under it on every lookup, so the minutes of
+        # candidate compiles in reload() must happen OUTSIDE it — the
+        # swap itself is the only write it covers.
+        self._entries_lock = threading.Lock()
         self._entries: Dict[str, Any] = {}
         for name, ckpt in entries:
             if name in self._entries:
@@ -705,68 +820,277 @@ class ModelPool:
                 group_name, task_entries, window=window, seed=seed,
                 variants=variants,
             )
+        version = int(version)
+        for entry in self._entries.values():
+            entry.version = version
         self.warmup_report: List[Dict[str, Any]] = []
+        self._publish_versions()
+
+    def _publish_versions(self) -> None:
+        """The served version per entry as a scrapeable gauge — the fleet
+        aggregator (and anyone watching a roll converge) reads
+        ``serve_model_version{model=}`` instead of grepping logs."""
+        from seist_tpu.obs.bus import BUS
+
+        for name, version in self.versions().items():
+            BUS.gauge("serve_model_version", model=name).set(version)
 
     def names(self) -> List[str]:
-        return list(self._entries)
+        with self._entries_lock:
+            return list(self._entries)
 
     def get(self, name: Optional[str]) -> Any:
+        with self._entries_lock:
+            if name is None:
+                if len(self._entries) == 1:
+                    return next(iter(self._entries.values()))
+                names = list(self._entries)
+            else:
+                entry = self._entries.get(name)
+                if entry is not None:
+                    return entry
+                names = list(self._entries)
         if name is None:
-            if len(self._entries) == 1:
-                return next(iter(self._entries.values()))
             raise BadRequest(
-                f"'model' is required when several are loaded: {self.names()}"
+                f"'model' is required when several are loaded: {names}"
             )
-        try:
-            return self._entries[name]
-        except KeyError:
-            raise UnknownModel(
-                f"model '{name}' not loaded; available: {self.names()}"
-            ) from None
+        raise UnknownModel(
+            f"model '{name}' not loaded; available: {names}"
+        )
+
+    def versions(self) -> Dict[str, int]:
+        """{entry name: served model version} — the /healthz/ready
+        payload the router's prober reads for canary cohorts and the
+        fleet supervisor polls during a rolling restart."""
+        with self._entries_lock:
+            entries = dict(self._entries)
+        return {name: entry.version for name, entry in entries.items()}
+
+    def warm_entry(
+        self, entry: Any, buckets: Sequence[int]
+    ) -> List[Dict[str, Any]]:
+        """AOT-compile one entry's (bucket x program x variant) table +
+        warm its decode programs; returns the per-program compile report.
+        Shared by start-up :meth:`warmup` and :meth:`reload` (a candidate
+        passes the SAME gates the boot path does)."""
+        from seist_tpu.utils.profiling import stopwatch
+
+        report: List[Dict[str, Any]] = []
+        buckets = sorted(set(int(b) for b in buckets))
+        entry.build_programs(buckets, report)
+        # Warm the postprocess programs too (pick_peaks/detect_events
+        # jit on static topk/min_peak_dist — defaults compiled here),
+        # and prove every executable answers end to end.
+        x = np.zeros(
+            (buckets[-1], entry.window, entry.in_channels), np.float32
+        )
+        if entry.is_group:
+            outs = entry.fanout(x, entry.tasks, "fp32", account=False)
+            _block(list(outs.values()))
+            for t in entry.tasks:
+                with stopwatch() as elapsed:
+                    decode_outputs(
+                        entry.heads[t],
+                        _slice_outputs(outs[t], 0),
+                        PredictOptions(),
+                    )
+                report.append({
+                    "model": entry.name, "batch": f"decode:{t}",
+                    "seconds": elapsed(),
+                })
+        else:
+            out = entry.run(x, "fp32")
+            _block(out)
+            with stopwatch() as elapsed:
+                decode_outputs(
+                    entry, _slice_outputs(out, 0), PredictOptions()
+                )
+            report.append({
+                "model": entry.name, "batch": "decode",
+                "seconds": elapsed(),
+            })
+        return report
 
     def warmup(self, buckets: Sequence[int]) -> List[Dict[str, Any]]:
         """AOT-compile every (bucket, program, variant) for every entry +
         warm the default decode programs; returns per-program compile
         timings (also kept on ``self.warmup_report`` for /healthz)."""
-        from seist_tpu.utils.profiling import stopwatch
-
+        with self._entries_lock:
+            entries = list(self._entries.values())
         report: List[Dict[str, Any]] = []
-        buckets = sorted(set(int(b) for b in buckets))
-        for entry in self._entries.values():
-            entry.build_programs(buckets, report)
-            # Warm the postprocess programs too (pick_peaks/detect_events
-            # jit on static topk/min_peak_dist — defaults compiled here),
-            # and prove every executable answers end to end.
-            x = np.zeros(
-                (buckets[-1], entry.window, entry.in_channels), np.float32
-            )
-            if entry.is_group:
-                outs = entry.fanout(x, entry.tasks, "fp32", account=False)
-                _block(list(outs.values()))
-                for t in entry.tasks:
-                    with stopwatch() as elapsed:
-                        decode_outputs(
-                            entry.heads[t],
-                            _slice_outputs(outs[t], 0),
-                            PredictOptions(),
-                        )
-                    report.append({
-                        "model": entry.name, "batch": f"decode:{t}",
-                        "seconds": elapsed(),
-                    })
-            else:
-                out = entry.run(x, "fp32")
-                _block(out)
-                with stopwatch() as elapsed:
-                    decode_outputs(
-                        entry, _slice_outputs(out, 0), PredictOptions()
-                    )
-                report.append({
-                    "model": entry.name, "batch": "decode",
-                    "seconds": elapsed(),
-                })
-        self.warmup_report = report
+        for entry in entries:
+            report.extend(self.warm_entry(entry, buckets))
+        with self._entries_lock:
+            self.warmup_report = report
         return report
+
+    # ------------------------------------------------------------- reload
+    def reload(
+        self,
+        name: Optional[str],
+        *,
+        buckets: Sequence[int],
+        checkpoint: Optional[str] = None,
+        checkpoints: Optional[Mapping[str, str]] = None,
+        version: Optional[int] = None,
+        force_gate_failure: bool = False,
+    ) -> Tuple[Any, List[Dict[str, Any]]]:
+        """Hot-swap one entry for a new checkpoint, zero downtime.
+
+        The candidate is loaded BESIDE the incumbent, then must clear the
+        full gate ladder before any traffic shifts:
+
+        1. checkpoint structural compatibility (``_load_parts`` →
+           :class:`IncompatibleCheckpoint` naming the first bad path);
+        2. the PR 10 AOT compile of every (bucket x program x variant)
+           plus decode warm-up — any build/compile crash is a
+           :class:`ReloadFailed`, never a half-swapped pool;
+        3. variant parity gates re-run against the NEW weights; every
+           variant (and, for groups, every task x variant) the incumbent
+           currently serves must pass — a reload must not silently shrink
+           the served surface (:class:`ParityGateFailed`);
+        4. an fp32 finite-output probe (a checkpoint of NaNs compiles
+           fine; it must still not serve).
+
+        Only full success swaps the pool entry — atomically, under the
+        entry dict's single-assignment semantics, so requests in flight
+        keep the incumbent and the next batcher flush picks up the
+        candidate. Any failure leaves the incumbent serving, unchanged,
+        and raises the structured error (the PR 10 "disable, don't serve
+        wrong" contract extended to reload).
+
+        ``force_gate_failure`` is the SEIST_FAULT_SERVE_BAD_CANDIDATE
+        chaos hook: the fully-built candidate is rejected at step 4, so
+        rollback paths are exercisable on demand.
+        """
+        from seist_tpu.obs.bus import BUS
+
+        with self._reload_lock:
+            incumbent = self.get(name)
+            name = incumbent.name
+            target = int(version) if version is not None else (
+                incumbent.version + 1
+            )
+            if target <= incumbent.version:
+                raise BadRequest(
+                    f"version must be > the served version "
+                    f"{incumbent.version}, got {target} (versions are "
+                    "monotonic)"
+                )
+            try:
+                candidate = self._build_candidate(
+                    incumbent, checkpoint, checkpoints
+                )
+                report = self.warm_entry(candidate, buckets)
+            except ServeError:
+                raise
+            except Exception as e:  # noqa: BLE001 — incumbent must survive
+                # Anything the candidate build throws (compile OOM, XLA
+                # error, bad file) dies HERE, beside the incumbent — the
+                # request path never saw the candidate.
+                raise ReloadFailed(
+                    f"candidate build failed for '{name}': {e!r}"
+                ) from e
+            self._gate_candidate(incumbent, candidate, force_gate_failure)
+            candidate.version = target
+            with self._entries_lock:  # the atomic swap
+                self._entries[name] = candidate
+                # The swapped-out generation's rows leave with it: a
+                # replica hot-reloading for weeks must not grow its
+                # /healthz payload (or mix long-gone versions into it).
+                self.warmup_report = [
+                    r for r in self.warmup_report
+                    if r.get("model") != name
+                ] + [dict(r, reload_version=target) for r in report]
+            BUS.gauge("serve_model_version", model=name).set(target)
+            logger.info(
+                f"[serve] reload '{name}': version {incumbent.version} -> "
+                f"{target} ({len(report)} programs rebuilt)"
+            )
+            return candidate, report
+
+    def _build_candidate(
+        self,
+        incumbent: Any,
+        checkpoint: Optional[str],
+        checkpoints: Optional[Mapping[str, str]],
+    ) -> Any:
+        if incumbent.is_group:
+            if checkpoint is not None:
+                raise BadRequest(
+                    f"'{incumbent.name}' is a task group; use "
+                    "'checkpoints': {task: ckpt} instead of 'checkpoint'"
+                )
+            ckpts = dict(incumbent.task_checkpoints)
+            for task, ckpt in (checkpoints or {}).items():
+                if task not in ckpts:
+                    raise BadRequest(
+                        f"group '{incumbent.name}' does not serve task "
+                        f"'{task}'; serves {list(incumbent.tasks)}"
+                    )
+                ckpts[task] = ckpt
+            return load_group_entry(
+                incumbent.name,
+                [(t, ckpts[t]) for t in incumbent.tasks],
+                window=self._window, seed=self._seed,
+                variants=self._variants,
+            )
+        if checkpoints is not None:
+            raise BadRequest(
+                f"'{incumbent.name}' is single-task; use 'checkpoint', "
+                "not 'checkpoints'"
+            )
+        ckpt = checkpoint if checkpoint is not None else incumbent.checkpoint
+        return load_model_entry(
+            incumbent.name, ckpt, window=self._window, seed=self._seed,
+            variants=self._variants,
+        )
+
+    def _gate_candidate(
+        self, incumbent: Any, candidate: Any, force_gate_failure: bool
+    ) -> None:
+        """Reload acceptance: the candidate must serve at least the
+        incumbent's variant surface and answer finite fp32 outputs."""
+        if candidate.is_group:
+            for variant in incumbent.variants:
+                served = set(incumbent.variant_tasks.get(variant, ()))
+                cand = set(candidate.variant_tasks.get(variant, ()))
+                missing = sorted(served - cand)
+                if missing:
+                    raise ParityGateFailed(
+                        f"candidate for group '{incumbent.name}' failed "
+                        f"the '{variant}' parity gate for task(s) "
+                        f"{missing} the incumbent serves"
+                    )
+        else:
+            served = set(incumbent.supported_variants())
+            cand = set(candidate.supported_variants())
+            missing = sorted(served - cand)
+            if missing:
+                raise ParityGateFailed(
+                    f"candidate for '{incumbent.name}' failed the parity "
+                    f"gate for variant(s) {missing} the incumbent serves"
+                )
+        probe = _probe_input(1, candidate.window, candidate.in_channels)
+        if candidate.is_group:
+            outs = candidate.fanout(
+                probe, candidate.tasks, "fp32", account=False
+            )
+            finite = all(
+                aot.outputs_finite(outs[t]) for t in candidate.tasks
+            )
+        else:
+            finite = aot.outputs_finite(candidate.run(probe, "fp32"))
+        if not finite:
+            raise ParityGateFailed(
+                f"candidate for '{incumbent.name}' produced non-finite "
+                "fp32 probe outputs — refusing to serve it"
+            )
+        if force_gate_failure:
+            raise ParityGateFailed(
+                f"candidate for '{incumbent.name}' rejected by injected "
+                "fault (SEIST_FAULT_SERVE_BAD_CANDIDATE)"
+            )
 
 
 def decode_outputs(

@@ -86,6 +86,36 @@ class ShuttingDown(ServeError):
     code = "shutting_down"
 
 
+class IncompatibleCheckpoint(ServeError):
+    """The checkpoint's param tree does not fit the target model config
+    (missing/extra keys, shape or dtype mismatch). Raised by the loader
+    BEFORE any swap/serving, naming the first mismatching path — without
+    this, a wrong-architecture checkpoint surfaces as a deep flax apply
+    traceback mid-request."""
+
+    status = 400
+    code = "incompatible_checkpoint"
+
+
+class ReloadFailed(ServeError):
+    """A hot reload (``POST /admin/reload``) was rejected or died before
+    the atomic swap: the incumbent entry keeps serving, unchanged. 409:
+    the request was well-formed, the candidate just didn't earn the
+    traffic (the "disable, don't serve wrong" contract applied to
+    reload)."""
+
+    status = 409
+    code = "reload_failed"
+
+
+class ParityGateFailed(ReloadFailed):
+    """A reload candidate failed the load-time acceptance gates (variant
+    parity vs fp32, or the fp32 finite-output probe). Same 409 contract
+    as :class:`ReloadFailed` with the gate verdict in the message."""
+
+    code = "parity_gate_failed"
+
+
 #: Priority tiers, highest first. Order IS the shed order reversed:
 #: ``batch`` (backfill) is dropped first under overload, ``alert``
 #: (streaming early-warning picks — a missed one is a missed event) last.

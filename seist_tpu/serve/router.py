@@ -67,6 +67,13 @@ from queue import Empty, Queue
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from seist_tpu.obs import trace as obs_trace
+from seist_tpu.serve.canary import (
+    CanaryBudget,
+    CanaryController,
+    ShadowMirror,
+    decision_diff,
+    serves_version,
+)
 from seist_tpu.utils.logger import logger
 
 # Breaker states (also the value of the router_breaker_state gauge).
@@ -238,6 +245,9 @@ class Replica:
         self.probe_ready = True
         self.probe_state = "unprobed"
         self.probe_fails = 0
+        #: {model: served version}, learned from /healthz/ready payloads
+        #: — the canary/rollout cohort discriminator. {} until probed.
+        self.versions: Dict[str, Any] = {}
         self._lock = threading.Lock()
         self.routed = 0
         self.failures = 0
@@ -249,6 +259,7 @@ class Replica:
             "url": self.url,
             "ready": self.probe_ready,
             "probe_state": self.probe_state,
+            "versions": dict(self.versions),
             "breaker": self.breaker.stats(),
             "routed": routed,
             "failures": failures,
@@ -303,16 +314,24 @@ class ReplicaRegistry:
         with self._lock:
             return list(self._replicas.values())
 
-    def pick(self, exclude: Set[str] = frozenset()) -> Optional[Replica]:
+    def pick(
+        self,
+        exclude: Set[str] = frozenset(),
+        versions_pred=None,
+    ) -> Optional[Replica]:
         """Round-robin over ready replicas not in ``exclude`` whose
         breaker admits the request (``allow`` may consume the single
         half-open probe slot, so it is asked last, only for the
-        candidate actually about to be used)."""
+        candidate actually about to be used). ``versions_pred`` (a
+        predicate over the replica's probed ``{model: version}``)
+        restricts the pick to one rollout cohort — the canary/shadow
+        routing hook."""
         with self._lock:
             candidates = [
                 r
                 for r in self._replicas.values()
                 if r.probe_ready and r.url not in exclude
+                and (versions_pred is None or versions_pred(r.versions))
             ]
             if not candidates:
                 return None
@@ -415,6 +434,19 @@ class Router:
         if bus is None:
             from seist_tpu.obs.bus import BUS as bus
         self._bus = bus
+        # Live-rollout traffic shifting (serve/canary.py): weighted
+        # version-aware canary with auto-rollback, and shadow mirroring
+        # of sampled requests to the candidate cohort.
+        self.canary = CanaryController()
+        self.shadow = ShadowMirror()
+        # One-shot handoff: set by the (possibly drain-thread) settle
+        # that trips the rollback, consumed by the next forward() so the
+        # event always lands on a trace. GIL-atomic bool store.
+        self._rollback_to_flag = False
+        # Bounds concurrent shadow-mirror threads: a slow/black-holed
+        # candidate must not accumulate one blocked thread per mirrored
+        # request (overflow is dropped and counted skipped_busy).
+        self._mirror_slots = threading.Semaphore(8)
         self._prober: Optional[threading.Thread] = None
         self._stop = threading.Event()
         bus.register_collector("router", self._collect)
@@ -467,17 +499,26 @@ class Router:
                 timeout_s=self.config.probe_timeout_s,
             )
             replica.probe_fails = 0
+            try:
+                payload = json.loads(body.decode())
+            except (ValueError, UnicodeDecodeError):
+                payload = {}
+            if not isinstance(payload, dict):
+                payload = {}
+            versions = payload.get("versions")
+            if isinstance(versions, dict):
+                # Served model versions ride the ready probe (serve
+                # handler) — the canary cohort + rolling-restart
+                # convergence signal, refreshed every probe interval.
+                replica.versions = versions
             if status == 200:
                 replica.probe_ready = True
                 replica.probe_state = "ok"
             else:
                 replica.probe_ready = False
-                try:
-                    replica.probe_state = str(
-                        json.loads(body.decode()).get("status", "not_ready")
-                    )
-                except (ValueError, UnicodeDecodeError):
-                    replica.probe_state = "not_ready"
+                replica.probe_state = str(
+                    payload.get("status", "not_ready")
+                )
         except (OSError, http.client.HTTPException) as e:
             # Connection refused/reset/timeout/half-closed: the process
             # is likely gone. Two strikes before leaving rotation — one
@@ -503,6 +544,13 @@ class Router:
             traceparent, name=f"router:{path}", process="router"
         )
         status, headers, payload = self._forward_routed(path, body, rt)
+        if self._rollback_to_flag:
+            # The canary auto-rollback fired during this request's
+            # routing: flag its trace (tail-retained) so the event is
+            # findable from /traces, not just the bus counter.
+            self._rollback_to_flag = False
+            rt.flag("canary_rollback")
+        self._maybe_mirror(path, body, status, payload, rt.trace_id)
         total_ms = rt.finish(status)
         headers = dict(headers)
         upstream_timing = headers.pop("Server-Timing", None)
@@ -523,12 +571,12 @@ class Router:
         attempts_left = 1 + max(0, int(self.config.retries))
         last: Optional[_Outcome] = None
         while attempts_left > 0 and time.monotonic() < deadline:
-            replica = self.registry.pick(exclude=tried)
+            replica = self._pick(tried, first_attempt=not tried)
             if replica is None and tried:
                 # Every replica tried once; a retry may reuse one (the
                 # failure could have been transient) as long as its
                 # breaker still admits traffic.
-                replica = self.registry.pick()
+                replica = self._pick(frozenset(), first_attempt=False)
             if replica is None:
                 break
             attempts_left -= 1
@@ -579,14 +627,172 @@ class Router:
     def _settle(
         self, replica: Replica, outcome: _Outcome
     ) -> Tuple[bool, bool]:
-        """Feed breaker + counters; -> (breaker_failure, retryable)."""
+        """Feed breaker + counters + canary cohort stats; ->
+        (breaker_failure, retryable). Every launched attempt settles
+        exactly once (winners here, hedge losers via the drain thread),
+        so the canary's cohort accounting can't double-count either."""
         failure, retryable = _classify(outcome)
         if failure:
             replica.breaker.record_failure()
         else:
             replica.breaker.record_success(outcome.latency_ms)
         replica.count(failure)
+        self._observe_canary(replica, outcome, failure)
         return failure, retryable
+
+    # ---------------------------------------------------- canary + shadow
+    def _cohort_pred(
+        self, cohort: str, version: int, model: Optional[str] = None
+    ):
+        """Registry pick predicate selecting one rollout cohort by the
+        replicas' probed ``{model: version}`` maps — scoped to one model
+        when the canary/shadow named one (multi-model pools: a bare
+        version number would otherwise match any entry's version)."""
+
+        def pred(versions: Dict[str, Any]) -> bool:
+            is_candidate = serves_version(versions, version, model)
+            return is_candidate if cohort == "candidate" else not is_candidate
+
+        return pred
+
+    def _pick(
+        self, tried: Set[str], first_attempt: bool
+    ) -> Optional[Replica]:
+        """Cohort-aware replica pick: under an active canary, ``k%`` of
+        first attempts go to the candidate-version cohort and ALL
+        retries/hedges stay incumbent; after a rollback (and under
+        shadow mode) the candidate cohort gets exactly 0% of primary
+        traffic. If the selected cohort has no routable replica,
+        availability beats canary fidelity: fall back to a version-blind
+        pick (counted)."""
+        version: Optional[int] = None
+        model: Optional[str] = None
+        cohort = self.canary.routing_cohort(first_attempt)
+        if cohort is not None:
+            version, model = self.canary.version, self.canary.model
+        elif self.shadow.active:
+            # Shadow serves every client request from the incumbent; the
+            # candidate only ever sees mirrored copies.
+            cohort, version = "incumbent", self.shadow.version
+            model = self.shadow.model
+        if cohort is None or version is None:
+            return self.registry.pick(exclude=tried)
+        replica = self.registry.pick(
+            exclude=tried,
+            versions_pred=self._cohort_pred(cohort, version, model),
+        )
+        if replica is None:
+            self._bus.counter("router_canary_fallback", cohort=cohort).inc()
+            replica = self.registry.pick(exclude=tried)
+        return replica
+
+    def _observe_canary(
+        self, replica: Replica, outcome: _Outcome, failure: bool
+    ) -> None:
+        """Feed one settled attempt to the canary's cohort stats; on a
+        tripped budget, drain the canary (0%) and publish the rollback
+        everywhere: log, bus counter, and (via the one-shot flag) the
+        next forwarded request's trace."""
+        if self.canary.state != "active":
+            return
+        cohort = self.canary.cohort_of(replica.versions)
+        self._bus.counter("router_canary_requests", cohort=cohort).inc()
+        if failure:
+            self._bus.counter("router_canary_errors", cohort=cohort).inc()
+        latency = None if failure else outcome.latency_ms
+        reason = self.canary.observe(cohort, failure, latency)
+        if reason:
+            self._bus.counter(
+                "router_canary_rollback",
+                version=str(self.canary.version),
+            ).inc()
+            self._rollback_to_flag = True
+            logger.warning(f"[router] CANARY ROLLBACK: {reason}")
+
+    def _maybe_mirror(
+        self, path: str, body: bytes, status: int, payload: bytes,
+        trace_id: str,
+    ) -> None:
+        """Shadow mode: mirror this (sampled, successful, /predict)
+        request to a candidate-cohort replica on a background thread and
+        diff the decisions into the JSONL report. The client's response
+        is already on the wire — mirroring costs it nothing."""
+        if (
+            path != "/predict"
+            or status != 200
+            or not self.shadow.active
+            or not self.shadow.should_mirror(trace_id)
+        ):
+            return
+        version = self.shadow.version
+        if version is None:
+            return
+        replica = self.registry.pick(
+            versions_pred=self._cohort_pred(
+                "candidate", version, self.shadow.model
+            )
+        )
+        if replica is None:
+            self.shadow.record(
+                trace_id, "no_candidate",
+                {"reason": "no routable candidate replica"},
+            )
+            return
+        if not self._mirror_slots.acquire(blocking=False):
+            # All mirror slots busy (slow candidate): drop this mirror
+            # rather than grow an unbounded thread pile — shadow is
+            # sampling, a dropped sample is accounted, not a failure.
+            self.shadow.record(trace_id, "skipped_busy")
+            return
+        threading.Thread(
+            target=self._mirror_one,
+            args=(replica, path, body, payload, trace_id),
+            daemon=True,
+            name="router-shadow",
+        ).start()
+
+    def _mirror_one(
+        self, replica: Replica, path: str, body: bytes,
+        primary_payload: bytes, trace_id: str,
+    ) -> None:
+        # Mirrors are breaker-neutral: shadow is observation, and a sick
+        # candidate must surface in the report, not destabilize routing.
+        # The try covers everything — a mirror thread must never die
+        # loudly into a client-visible path (threadlint
+        # thread-target-raises) and must always return its mirror slot.
+        try:
+            status, _, mirrored = _http_request(
+                replica.url, "POST", path, body=body,
+                timeout_s=self.config.request_timeout_s,
+            )
+            if status != 200:
+                self.shadow.record(
+                    trace_id, "mirror_errors",
+                    {"replica": replica.url, "candidate_status": status},
+                )
+                self._bus.counter(
+                    "router_shadow_mirrors", verdict="error"
+                ).inc()
+                return
+            diff = decision_diff(
+                json.loads(primary_payload.decode()),
+                json.loads(mirrored.decode()),
+            )
+            verdict = "match" if diff["match"] else "mismatch"
+            self.shadow.record(
+                trace_id, verdict, {"replica": replica.url, "diff": diff}
+            )
+            self._bus.counter(
+                "router_shadow_mirrors", verdict=verdict
+            ).inc()
+        except Exception as e:  # noqa: BLE001 — observation-only thread
+            self.shadow.record(trace_id, "mirror_errors",
+                               {"error": repr(e)})
+            self._bus.counter(
+                "router_shadow_mirrors", verdict="error"
+            ).inc()
+        finally:
+            self._mirror_slots.release()
 
     def _relay(self, outcome: _Outcome) -> Tuple[int, Dict[str, str], bytes]:
         if outcome.is_net_error:
@@ -717,8 +923,11 @@ class Router:
             return outcome, winner, attempts_left, False
         except Empty:
             pass
+        # A hedge is a speculative retry: under a canary it stays on the
+        # incumbent cohort like every other retry (first_attempt=False).
         hedge = (
-            self.registry.pick(exclude=tried) if attempts_left > 0 else None
+            self._pick(tried, first_attempt=False)
+            if attempts_left > 0 else None
         )
         if hedge is not None:
             attempts_left -= 1
@@ -817,6 +1026,8 @@ class Router:
         return timeout_ms / 1000.0 + 0.5
 
     # ------------------------------------------------------------- metrics
+    _CANARY_STATE_CODES = {"inactive": 0, "active": 1, "rolled_back": 2}
+
     def _collect(self) -> Dict[str, Any]:
         replicas = self.registry.snapshot()
         return {
@@ -825,12 +1036,18 @@ class Router:
             "breakers_open": sum(
                 1 for r in replicas if r["breaker"]["state"] != CLOSED
             ),
+            "canary_percent": self.canary.percent,
+            "canary_state_code": self._CANARY_STATE_CODES.get(
+                self.canary.state, 0
+            ),
         }
 
     def status(self) -> Dict[str, Any]:
         return {
             "replicas": self.registry.snapshot(),
             "ready": self.registry.ready_count(),
+            "canary": self.canary.status(),
+            "shadow": self.shadow.status(),
             "config": {
                 "retries": self.config.retries,
                 "hedge_ms": self.config.hedge_ms,
@@ -924,6 +1141,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 )
             elif path == "/router/replicas":
                 self._reply_json(200, self.router.status())
+            elif path == "/router/canary":
+                self._reply_json(200, self.router.canary.status())
+            elif path == "/router/shadow":
+                self._reply_json(200, self.router.shadow.status())
             elif path == "/metrics":
                 from seist_tpu.obs.bus import render_prometheus
 
@@ -1000,12 +1221,85 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     self._reply_json(
                         200 if removed else 404, {"deregistered": removed}
                     )
+            elif path == "/router/canary":
+                # {"version": V, "percent": k, "max_error_delta"?,
+                #  "max_latency_delta_ms"?, "min_requests"?};
+                # percent 0 (or missing version) clears the canary.
+                self._admin_canary(body)
+            elif path == "/router/shadow":
+                # {"version": V, "sample": 0.1, "report"?: path};
+                # sample 0 (or missing version) clears shadow mode.
+                self._admin_shadow(body)
             else:
                 self._reply_json(404, {"error": "not_found",
                                        "message": self.path})
         except Exception as e:  # noqa: BLE001 — same contract as do_GET
             logger.warning(f"[router] unhandled error: {e!r}")
             self._reply_json(500, {"error": "internal", "message": repr(e)})
+
+    def _admin_canary(self, body: bytes) -> None:
+        try:
+            spec = json.loads(body.decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            spec = None
+        if not isinstance(spec, dict):
+            self._reply_json(400, {"error": "bad_request",
+                                   "message": "body must be a JSON object"})
+            return
+        try:
+            percent = float(spec.get("percent", 0) or 0)
+            if percent <= 0 or spec.get("version") is None:
+                self._reply_json(200, self.router.canary.stop())
+                return
+            budget = CanaryBudget(
+                max_error_delta=float(
+                    spec.get("max_error_delta",
+                             CanaryBudget.max_error_delta)
+                ),
+                max_latency_delta_ms=float(
+                    spec.get("max_latency_delta_ms",
+                             CanaryBudget.max_latency_delta_ms)
+                ),
+                min_requests=int(
+                    spec.get("min_requests", CanaryBudget.min_requests)
+                ),
+            )
+            self._reply_json(
+                200,
+                self.router.canary.start(
+                    int(spec["version"]), percent, budget,
+                    model=str(spec["model"]) if spec.get("model") else None,
+                ),
+            )
+        except (TypeError, ValueError) as e:
+            self._reply_json(400, {"error": "bad_request",
+                                   "message": str(e)})
+
+    def _admin_shadow(self, body: bytes) -> None:
+        try:
+            spec = json.loads(body.decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            spec = None
+        if not isinstance(spec, dict):
+            self._reply_json(400, {"error": "bad_request",
+                                   "message": "body must be a JSON object"})
+            return
+        try:
+            sample = float(spec.get("sample", 0) or 0)
+            if sample <= 0 or spec.get("version") is None:
+                self._reply_json(200, self.router.shadow.stop())
+                return
+            self._reply_json(
+                200,
+                self.router.shadow.start(
+                    int(spec["version"]), sample,
+                    str(spec.get("report", "") or ""),
+                    model=str(spec["model"]) if spec.get("model") else None,
+                ),
+            )
+        except (TypeError, ValueError) as e:
+            self._reply_json(400, {"error": "bad_request",
+                                   "message": str(e)})
 
     def _admin_url(self, body: bytes) -> Optional[str]:
         try:

@@ -17,10 +17,12 @@ Layering:
 
 Endpoints::
 
-    POST /predict   one (window, C) trace   -> picks / regression / class
-    POST /annotate  one (L >= window, C) record -> picks over the record
-    GET  /healthz   liveness + model list + warm-up state
-    GET  /metrics   queue depth, batch-fill ratio, latency histograms
+    POST /predict       one (window, C) trace -> picks / regression / class
+    POST /annotate      one (L >= window, C) record -> picks over the record
+    POST /admin/reload  hot-swap a new checkpoint behind the full gate
+                        ladder (docs/SERVING.md "Live rollout")
+    GET  /healthz       liveness + model list + per-entry version/variants
+    GET  /metrics       queue depth, batch-fill ratio, latency histograms
 
 CLI: ``python main.py serve --model seist_s_dpk=CKPT --port 8080 ...``
 (see ``main()``); ``make serve-smoke`` runs the no-checkpoint smoke.
@@ -29,6 +31,7 @@ CLI: ``python main.py serve --model seist_s_dpk=CKPT --port 8080 ...``
 from __future__ import annotations
 
 import argparse
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -46,6 +49,7 @@ from seist_tpu.serve.protocol import (
     DeadlineExceeded,
     Overloaded,
     PredictOptions,
+    ReloadFailed,
     ServeError,
     ShuttingDown,
     json_bytes,
@@ -76,6 +80,17 @@ PREEMPT_EXIT_CODE = 75
 STATE_CODES = {"dead": 0, "warming": 1, "ok": 2, "draining": 3}
 
 
+class _BadCandidate(ServeError):
+    """SEIST_FAULT_SERVE_BAD_CANDIDATE chaos verdict: this replica is
+    deliberately serving a "bad" model version, so its /predict errors —
+    the elevated-error-rate signal the router's canary auto-rollback
+    must catch. 500: the router classifies it retryable + breaker
+    failure, exactly like a genuine candidate regression."""
+
+    status = 500
+    code = "bad_candidate"
+
+
 class ServeService:
     """Transport-free serving core; every public method raises ServeError
     subclasses on failure and returns JSON-able dicts on success."""
@@ -104,51 +119,23 @@ class ServeService:
         # an fp32 request), task-blind — a group's dpk+emg+dis traffic
         # coalesces into the same flushes. The fp32 batcher keeps the
         # bare model name (wire/metrics back-compat); other variants are
-        # keyed "<model>@<variant>".
+        # keyed "<model>@<variant>". The forward closes over the entry
+        # NAME, not the entry object: each flush resolves the entry from
+        # the pool, so a hot reload (/admin/reload swapping the pool
+        # slot) takes effect at the very next flush with no batcher
+        # restart — the hot-swap seam.
         self._batchers: Dict[str, MicroBatcher] = {}
         self._shedders: Dict[str, AdmissionController] = {}
+        self._reload_lock = threading.Lock()
         for name in pool.names():
             entry = pool.get(name)
-            injector = self._faults
             entry_batchers = []
             # getattr defaults keep bare-namespace test pools (see
             # watch_until_shutdown) and pre-variant entries working.
             for variant in getattr(entry, "variants", ("fp32",)):
                 key = name if variant == "fp32" else f"{name}@{variant}"
-                if getattr(entry, "is_group", False):
-
-                    def batched_forward(
-                        batch, tasks=None, _e=entry, _v=variant,
-                        _inj=injector,
-                    ):
-                        # Injected model slowness runs IN the flush
-                        # thread, so queued requests age exactly as
-                        # behind a slow device.
-                        _inj.forward_delay()
-                        return _e.fanout(
-                            batch, sorted(tasks or _e.tasks), _v
-                        )
-
-                elif hasattr(entry, "run"):
-
-                    def batched_forward(
-                        batch, _e=entry, _v=variant, _inj=injector
-                    ):
-                        _inj.forward_delay()
-                        return _e.run(batch, _v)
-
-                else:  # bare forward-only entry (test doubles)
-
-                    def batched_forward(
-                        batch, _e=entry, _inj=injector
-                    ):
-                        import jax.numpy as jnp
-
-                        _inj.forward_delay()
-                        return _e.forward(jnp.asarray(batch))
-
                 self._batchers[key] = MicroBatcher(
-                    batched_forward, self.config, name=key
+                    self._make_forward(name, variant), self.config, name=key
                 )
                 entry_batchers.append(self._batchers[key])
             # Tiered admission gate per model, fed by the worst
@@ -194,6 +181,30 @@ class ServeService:
             self._run_warmup()
             if self._warmup_error is not None:
                 raise self._warmup_error  # sync path keeps crashing loudly
+
+    def _make_forward(self, name: str, variant: str):
+        """Flush-time forward for one (entry, variant) batcher. Resolves
+        the entry from the pool PER FLUSH (hot reload swaps the pool
+        slot; in-flight flushes keep the entry they already grabbed) and
+        dispatches by its capabilities."""
+        injector = self._faults
+
+        def batched_forward(batch, tasks=None, _n=name, _v=variant,
+                            _inj=injector):
+            entry = self.pool.get(_n)
+            # Injected model slowness runs IN the flush thread, so
+            # queued requests age exactly as behind a slow device.
+            _inj.forward_delay()
+            if getattr(entry, "is_group", False):
+                return entry.fanout(batch, sorted(tasks or entry.tasks), _v)
+            if hasattr(entry, "run"):
+                return entry.run(batch, _v)
+            # bare forward-only entry (test doubles)
+            import jax.numpy as jnp
+
+            return entry.forward(jnp.asarray(batch))
+
+        return batched_forward
 
     def _run_warmup(self) -> None:
         try:
@@ -297,11 +308,17 @@ class ServeService:
             raise ShuttingDown("service is draining")
         t = obs_trace.ensure(trace)
         entry = self.pool.get(model)
+        version = int(getattr(entry, "version", 0) or 0)
         opts = PredictOptions.from_dict(options)
         req_tasks = entry.resolve_tasks(parse_tasks(tasks))
         self._check_variant(entry, opts.variant, req_tasks)
         t.annotate(model=entry.name, variant=opts.variant,
-                   tier=opts.priority)
+                   tier=opts.priority, version=version)
+        if self._faults.is_bad_candidate(version):
+            raise _BadCandidate(
+                f"model '{entry.name}' version {version} is the injected "
+                "bad candidate (SEIST_FAULT_SERVE_BAD_CANDIDATE)"
+            )
         # Request arrival: count, fire any scheduled serving fault
         # (SIGKILL at request k / black-hole window), then the admission
         # gate — shedding happens BEFORE the expensive waveform parse, so
@@ -357,6 +374,9 @@ class ServeService:
                     per_task[tk] = r
             return {
                 "model": entry.name,
+                # Which checkpoint generation answered — the rollout
+                # acceptance signal (bench_serve by_version accounting).
+                "model_version": version,
                 "tasks": per_task,
                 # The fan-out contract, observable per response: all
                 # heads above came from ONE trunk execution.
@@ -370,6 +390,7 @@ class ServeService:
             # picks/detections inside samples the client never sent.
             _clip_picks(result, n_real, fs)
         result["model"] = entry.name
+        result["model_version"] = version
         return result
 
     # ---------------------------------------------------------- annotate
@@ -473,6 +494,7 @@ class ServeService:
             self._annotate_windows += n_windows
         return {
             "model": entry.name,
+            "model_version": int(getattr(entry, "version", 0) or 0),
             "task": "picking",
             "record_samples": int(record.shape[0]),
             "windows": int(n_windows),
@@ -491,6 +513,91 @@ class ServeService:
                 for a, b in picks["det"]
             ],
         }
+
+    # ------------------------------------------------------------- reload
+    def reload(
+        self,
+        model: Optional[str] = None,
+        checkpoint: Optional[str] = None,
+        checkpoints: Optional[Dict[str, str]] = None,
+        version: Optional[Any] = None,
+    ) -> Dict[str, Any]:
+        """Hot-swap one pool entry for a new checkpoint (``POST
+        /admin/reload``). The candidate loads beside the incumbent,
+        re-runs the full load-time gate ladder (AOT compile + variant
+        parity + finite probe — serve/pool.ModelPool.reload), and only
+        full success swaps; a failure leaves the incumbent serving and
+        raises the structured error. The incumbent serves throughout —
+        reload is invisible to in-flight traffic except as the
+        ``model_version`` flip in responses."""
+        if self._draining:
+            raise ShuttingDown("service is draining; not accepting reloads")
+        if self._warming:
+            raise ReloadFailed(
+                "initial warm-up still running; retry once /healthz/ready "
+                "reports ready"
+            )
+        entry = self.pool.get(model)
+        if checkpoint is not None and not isinstance(checkpoint, str):
+            raise BadRequest("'checkpoint' must be a string path")
+        if checkpoints is not None and not (
+            isinstance(checkpoints, dict)
+            and all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in checkpoints.items()
+            )
+        ):
+            raise BadRequest("'checkpoints' must be {task: ckpt} strings")
+        if version is not None:
+            try:
+                version = int(version)
+            except (TypeError, ValueError):
+                raise BadRequest(
+                    f"'version' must be an integer, got {version!r}"
+                ) from None
+        with self._reload_lock:  # one reload at a time per replica
+            previous = int(getattr(entry, "version", 0) or 0)
+            target = version if version is not None else previous + 1
+            from seist_tpu.obs.bus import BUS
+
+            t0 = time.monotonic()
+            try:
+                new_entry, report = self.pool.reload(
+                    entry.name,
+                    buckets=self.buckets,
+                    checkpoint=checkpoint,
+                    checkpoints=checkpoints,
+                    version=target,
+                    force_gate_failure=self._faults.is_bad_candidate(target),
+                )
+            except ServeError as e:
+                BUS.counter(
+                    "serve_reload_total", model=entry.name, outcome=e.code
+                ).inc()
+                if self._event_log is not None:
+                    self._event_log.emit(
+                        "serve_reload", model=entry.name, outcome=e.code,
+                        version=target, error=str(e),
+                    )
+                raise
+            reload_s = time.monotonic() - t0
+            BUS.counter(
+                "serve_reload_total", model=entry.name, outcome="ok"
+            ).inc()
+            if self._event_log is not None:
+                self._event_log.emit(
+                    "serve_reload", model=entry.name, outcome="ok",
+                    version=target, previous_version=previous,
+                    reload_s=round(reload_s, 3),
+                )
+            return {
+                "model": entry.name,
+                "version": target,
+                "previous_version": previous,
+                "variants": new_entry.supported_variants(),
+                "programs": len(report),
+                "reload_s": round(reload_s, 3),
+            }
 
     # ------------------------------------------------------ health/metrics
     def alive(self) -> bool:
@@ -515,12 +622,39 @@ class ServeService:
             return "warming"
         return "ok"
 
+    def model_versions(self) -> Dict[str, int]:
+        """{model: served version} — rides /healthz AND /healthz/ready so
+        the router's prober (canary cohorts) and the fleet supervisor's
+        rolling restart can tell a converged fleet from a mid-roll one
+        without scraping logs."""
+        return {
+            name: int(getattr(self.pool.get(name), "version", 0) or 0)
+            for name in self.pool.names()
+        }
+
     def healthz(self) -> Dict[str, Any]:
+        entries: Dict[str, Any] = {}
+        for name in self.pool.names():
+            e = self.pool.get(name)
+            info: Dict[str, Any] = {
+                "version": int(getattr(e, "version", 0) or 0),
+                "variants": (
+                    e.supported_variants()
+                    if hasattr(e, "supported_variants")
+                    else ["fp32"]
+                ),
+            }
+            if getattr(e, "is_group", False):
+                info["tasks"] = list(e.tasks)
+            entries[name] = info
         return {
             "status": self._state_str(),
             "live": self.alive(),
             "ready": self.ready(),
             "models": self.pool.names(),
+            # Per-entry served version + variant surface: the converged-
+            # vs-mid-roll discriminator (docs/SERVING.md "Live rollout").
+            "entries": entries,
             "buckets": list(self.buckets),
             "uptime_s": round(time.monotonic() - self._started_at, 3),
             "warmup": self.pool.warmup_report,
@@ -684,7 +818,14 @@ class _Handler(BaseHTTPRequestHandler):
                 ready = self.service.ready()
                 self._reply(
                     200 if ready else 503,
-                    {"status": self.service._state_str(), "ready": ready},
+                    {
+                        "status": self.service._state_str(),
+                        "ready": ready,
+                        # The router's prober reads versions from here
+                        # (one probe, no extra round trip) to keep canary
+                        # cohorts and /router/replicas current.
+                        "versions": self.service.model_versions(),
+                    },
                 )
             elif self.path == "/metrics.json":
                 # Raw bus snapshot — the payload the fleet aggregator
@@ -781,6 +922,16 @@ class _Handler(BaseHTTPRequestHandler):
                     options=body.get("options"),
                     trace=rt,
                 )
+            elif self.path == "/admin/reload":
+                # Hot checkpoint rollout (docs/SERVING.md "Live
+                # rollout"): load-gate-swap, incumbent serves throughout;
+                # structured 4xx on an unfit candidate.
+                result = self.service.reload(
+                    model=body.get("model"),
+                    checkpoint=body.get("checkpoint"),
+                    checkpoints=body.get("checkpoints"),
+                    version=body.get("version"),
+                )
             else:
                 self._reply(404, {"error": "not_found", "message": self.path})
                 return
@@ -854,6 +1005,14 @@ def get_serve_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     )
     ap.add_argument("--model-name", default="", help="single-model shorthand")
     ap.add_argument("--checkpoint", default="", help="with --model-name")
+    ap.add_argument(
+        "--model-version", type=int,
+        default=int(os.environ.get("SEIST_MODEL_VERSION", "") or 1),
+        help="monotonic version stamp for the loaded checkpoints "
+        "(default: $SEIST_MODEL_VERSION or 1) — reported in every "
+        "response and /healthz; the rolling-restart handle "
+        "(docs/SERVING.md 'Live rollout')",
+    )
     ap.add_argument("--window", type=int, default=8192)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8080)
@@ -1002,6 +1161,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         variants=tuple(
             v.strip() for v in args.variants.split(",") if v.strip()
         ),
+        version=args.model_version,
     )
     # Async warm-up: the socket (and /healthz/ready, reporting 503
     # "warming") comes up immediately; orchestrators gate traffic on

@@ -72,6 +72,13 @@ k" is deterministic under any client concurrency)::
                                       finite count lets the breaker's
                                       half-open probes find the recovery
                                       and close the circuit.
+    SEIST_FAULT_SERVE_BAD_CANDIDATE   model VERSION that is deliberately
+                                      bad: /admin/reload to it fails its
+                                      parity gate, and a replica serving
+                                      it 500s every /predict — the knob
+                                      that makes reload-rollback and
+                                      canary auto-rollback exercisable
+                                      in chaos runs
     SEIST_FAULT_SERVE_REPLICA         only fire in the replica whose
                                       SEIST_SERVE_REPLICA index (set by
                                       tools/supervise_fleet.py) matches;
@@ -359,6 +366,7 @@ class ServeFaultPlan:
     blackhole_after: int = -1
     blackhole_count: int = 1 << 30  # default: never recovers
     blackhole_hold_s: float = 3600.0
+    bad_candidate_version: int = -1  # model version that serves "wrong"
     replica: int = -1  # only fire in this SEIST_SERVE_REPLICA; -1 = any
     stamp_path: str = ""
 
@@ -379,6 +387,9 @@ class ServeFaultPlan:
             blackhole_hold_s=_env_float(
                 env, "SEIST_FAULT_SERVE_BLACKHOLE_HOLD_S", 3600.0
             ),
+            bad_candidate_version=_env_int(
+                env, "SEIST_FAULT_SERVE_BAD_CANDIDATE", -1
+            ),
             replica=_env_int(env, "SEIST_FAULT_SERVE_REPLICA", -1),
             stamp_path=env.get("SEIST_FAULT_STAMP", ""),
         )
@@ -389,6 +400,7 @@ class ServeFaultPlan:
             self.kill_req >= 0
             or self.slow_ms > 0
             or self.blackhole_after >= 0
+            or self.bad_candidate_version >= 0
         )
 
 
@@ -464,3 +476,17 @@ class ServeFaultInjector:
         """Sleep inside the model forward (batcher flush thread)."""
         if self.enabled and self.plan.slow_ms > 0:
             time.sleep(self.plan.slow_ms / 1000.0)
+
+    # ------------------------------------------------------- rollout faults
+    def is_bad_candidate(self, version: int) -> bool:
+        """SEIST_FAULT_SERVE_BAD_CANDIDATE=<version>: that model version
+        is deliberately "bad" — (a) a /admin/reload TO it fails its
+        parity gate (the replica-local rollback path), and (b) a replica
+        SERVING it errors every /predict (the elevated-error-rate signal
+        the router's canary auto-rollback drains on). Scoped by
+        SEIST_FAULT_SERVE_REPLICA like every serve fault."""
+        return (
+            self.enabled
+            and self.plan.bad_candidate_version >= 0
+            and int(version) == self.plan.bad_candidate_version
+        )

@@ -26,6 +26,9 @@ PREEMPT_EXIT_CODE = 75
 
 COUNTS = {"predict": 0}
 COUNTS_LOCK = threading.Lock()
+#: set from --model-version in main(); reported like a real replica so
+#: rolling-restart tests can watch the fleet converge.
+MODEL_VERSION = 1
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -44,7 +47,13 @@ class Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path in ("/healthz", "/healthz/live", "/healthz/ready"):
-            self._reply(200, {"status": "ok"})
+            # versions rides the ready payload exactly like the real
+            # replica (serve/server.py): the router's prober and the
+            # fleet supervisor's rollout read it from here.
+            self._reply(200, {
+                "status": "ok", "ready": True,
+                "versions": {"fake": MODEL_VERSION},
+            })
         elif self.path == "/metrics.json":
             # The bus-snapshot shape the fleet aggregator scrapes
             # (obs/fleet.py): counters sum, histograms merge bucket-wise.
@@ -81,6 +90,7 @@ class Handler(BaseHTTPRequestHandler):
             self._reply(
                 200,
                 {"ok": True,
+                 "model_version": MODEL_VERSION,
                  "replica": os.environ.get("SEIST_SERVE_REPLICA", "?")},
             )
         else:
@@ -88,10 +98,16 @@ class Handler(BaseHTTPRequestHandler):
 
 
 def main() -> int:
+    global MODEL_VERSION
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, required=True)
+    ap.add_argument(
+        "--model-version", type=int,
+        default=int(os.environ.get("SEIST_MODEL_VERSION", "") or 1),
+    )
     args, _ = ap.parse_known_args()
+    MODEL_VERSION = args.model_version
 
     server = ThreadingHTTPServer((args.host, args.port), Handler)
     server.daemon_threads = True

@@ -1,0 +1,996 @@
+"""Live-model flywheel units + in-process e2e: versioned pool, hot reload
+failure ladder, canary auto-rollback, shadow decision diffs, and the
+fleet rollout state machine (docs/SERVING.md "Live rollout").
+
+The jax-free classes (canary/shadow/diff/rollout-cmd/validation) are
+smoke-marked; the real-model reload ladder runs a phasenet pool and
+stays tier-1-only. The subprocess fleet e2e lives in
+tests/test_serve_fleet.py (fake replicas) and tests/test_serve_chaos.py
+(real replicas under load).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+# ----------------------------------------------- checkpoint compatibility
+@pytest.mark.smoke
+class TestCheckpointValidation:
+    def _expected(self):
+        return {
+            "params": {
+                "conv": {"kernel": np.zeros((3, 3, 8), np.float32),
+                         "bias": np.zeros((8,), np.float32)},
+            },
+        }
+
+    def _restored(self):
+        return json.loads(json.dumps(None)) or {  # deep copy via literals
+            "params": {
+                "conv": {"kernel": np.zeros((3, 3, 8), np.float32),
+                         "bias": np.zeros((8,), np.float32)},
+            },
+        }
+
+    def _check(self, restored):
+        from seist_tpu.serve.pool import validate_checkpoint_tree
+
+        validate_checkpoint_tree(
+            self._expected(), restored, model_name="m", checkpoint="ck"
+        )
+
+    def test_matching_tree_passes(self):
+        self._check(self._restored())
+
+    def test_missing_key_named(self):
+        from seist_tpu.serve.protocol import IncompatibleCheckpoint
+
+        bad = self._restored()
+        del bad["params"]["conv"]["bias"]
+        with pytest.raises(IncompatibleCheckpoint) as ei:
+            self._check(bad)
+        assert "missing key" in str(ei.value)
+        assert "params/conv/bias" in str(ei.value)
+
+    def test_unexpected_key_named(self):
+        from seist_tpu.serve.protocol import IncompatibleCheckpoint
+
+        bad = self._restored()
+        bad["params"]["extra_head"] = {"w": np.zeros((2,), np.float32)}
+        with pytest.raises(IncompatibleCheckpoint) as ei:
+            self._check(bad)
+        assert "unexpected key" in str(ei.value)
+        assert "params/extra_head" in str(ei.value)
+
+    def test_shape_mismatch_named(self):
+        from seist_tpu.serve.protocol import IncompatibleCheckpoint
+
+        bad = self._restored()
+        bad["params"]["conv"]["kernel"] = np.zeros((3, 3, 16), np.float32)
+        with pytest.raises(IncompatibleCheckpoint) as ei:
+            self._check(bad)
+        msg = str(ei.value)
+        assert "shape mismatch" in msg and "params/conv/kernel" in msg
+        assert "(3, 3, 8)" in msg and "(3, 3, 16)" in msg
+
+    def test_dtype_mismatch_named(self):
+        from seist_tpu.serve.protocol import IncompatibleCheckpoint
+
+        bad = self._restored()
+        bad["params"]["conv"]["bias"] = np.zeros((8,), np.float64)
+        with pytest.raises(IncompatibleCheckpoint) as ei:
+            self._check(bad)
+        assert "dtype mismatch" in str(ei.value)
+
+    def test_leaf_vs_subtree_named(self):
+        from seist_tpu.serve.protocol import IncompatibleCheckpoint
+
+        bad = self._restored()
+        bad["params"]["conv"] = np.zeros((4,), np.float32)
+        with pytest.raises(IncompatibleCheckpoint) as ei:
+            self._check(bad)
+        assert "subtree/leaf mismatch" in str(ei.value)
+
+    def test_missing_collection(self):
+        from seist_tpu.serve.protocol import IncompatibleCheckpoint
+
+        with pytest.raises(IncompatibleCheckpoint) as ei:
+            self._check({})
+        assert "missing collection" in str(ei.value)
+
+    def test_empty_expected_collection_is_optional(self):
+        from seist_tpu.serve.pool import validate_checkpoint_tree
+
+        expected = dict(self._expected(), batch_stats={})
+        validate_checkpoint_tree(
+            expected, self._restored(), model_name="m", checkpoint="ck"
+        )
+
+    def test_error_is_a_400_serve_error(self):
+        from seist_tpu.serve.protocol import IncompatibleCheckpoint
+
+        e = IncompatibleCheckpoint("x")
+        assert e.status == 400 and e.code == "incompatible_checkpoint"
+
+
+# ------------------------------------------------------------ canary units
+@pytest.mark.smoke
+class TestCanaryController:
+    def _canary(self, percent=20.0, **budget):
+        from seist_tpu.serve.canary import CanaryBudget, CanaryController
+
+        c = CanaryController()
+        c.start(2, percent, CanaryBudget(**budget))
+        return c
+
+    def test_weighted_share_is_exact(self):
+        c = self._canary(percent=20.0)
+        picks = [c.routing_cohort(True) for _ in range(200)]
+        assert picks.count("candidate") == 40  # deterministic counter
+
+    def test_retries_never_route_candidate(self):
+        c = self._canary(percent=100.0)
+        assert c.routing_cohort(True) == "candidate"
+        assert all(
+            c.routing_cohort(False) == "incumbent" for _ in range(20)
+        )
+
+    def test_inactive_means_version_blind(self):
+        from seist_tpu.serve.canary import CanaryController
+
+        c = CanaryController()
+        assert c.routing_cohort(True) is None
+        assert c.observe("candidate", True, None) is None
+
+    def test_error_delta_trips_rollback_once(self):
+        c = self._canary(percent=50.0, max_error_delta=0.2, min_requests=5)
+        for _ in range(20):
+            c.observe("incumbent", False, 10.0)
+        reasons = [c.observe("candidate", True, None) for _ in range(5)]
+        fired = [r for r in reasons if r]
+        assert len(fired) == 1 and "error-rate delta" in fired[0]
+        assert c.state == "rolled_back" and c.percent == 0.0
+        # Drained: the candidate cohort gets exactly 0% from now on.
+        assert all(
+            c.routing_cohort(True) == "incumbent" for _ in range(20)
+        )
+        # Post-rollback observations are inert (no double rollback).
+        assert c.observe("candidate", True, None) is None
+
+    def test_min_requests_guards_small_samples(self):
+        c = self._canary(percent=50.0, max_error_delta=0.1, min_requests=10)
+        for _ in range(9):
+            assert c.observe("candidate", True, None) is None
+        assert c.state == "active"
+
+    def test_latency_delta_trips(self):
+        c = self._canary(
+            percent=50.0, max_error_delta=1.1,  # error path disabled
+            max_latency_delta_ms=50.0, min_requests=5,
+        )
+        for _ in range(10):
+            c.observe("incumbent", False, 10.0)
+        reason = None
+        for _ in range(10):
+            reason = reason or c.observe("candidate", False, 200.0)
+        assert reason and "latency delta" in reason
+        assert c.state == "rolled_back"
+
+    def test_healthy_canary_never_rolls_back(self):
+        c = self._canary(percent=50.0, max_error_delta=0.1, min_requests=5)
+        for _ in range(50):
+            assert c.observe("candidate", False, 12.0) is None
+            assert c.observe("incumbent", False, 10.0) is None
+        assert c.state == "active"
+
+    def test_cohort_of_uses_versions(self):
+        c = self._canary()
+        assert c.cohort_of({"m": 2}) == "candidate"
+        assert c.cohort_of({"m": 1}) == "incumbent"
+        assert c.cohort_of({}) == "incumbent"
+
+    def test_model_scoped_cohort_ignores_other_models(self):
+        """Multi-model pools: model A already AT version 2 fleet-wide
+        must not make every replica 'candidate' when model B's version 2
+        is the canary."""
+        from seist_tpu.serve.canary import CanaryController
+
+        c = CanaryController()
+        c.start(2, 50.0, model="b")
+        # Serves a@2 but b@1: NOT the candidate.
+        assert c.cohort_of({"a": 2, "b": 1}) == "incumbent"
+        assert c.cohort_of({"a": 2, "b": 2}) == "candidate"
+        assert c.cohort_of({"a": 2}) == "incumbent"  # no b at all
+        assert c.status()["model"] == "b"
+
+    def test_serves_version_helper(self):
+        from seist_tpu.serve.canary import serves_version
+
+        assert serves_version({"m": 2}, 2)
+        assert not serves_version({"m": 1}, 2)
+        assert not serves_version({}, 2)
+        assert not serves_version(None, 2)
+        assert serves_version({"a": 2, "b": 1}, 2, model="a")
+        assert not serves_version({"a": 2, "b": 1}, 2, model="b")
+        assert not serves_version({"a": "junk"}, 2)
+
+    def test_stop_clears(self):
+        c = self._canary()
+        c.stop()
+        assert c.state == "inactive" and c.routing_cohort(True) is None
+
+    def test_bad_percent_rejected(self):
+        from seist_tpu.serve.canary import CanaryController
+
+        c = CanaryController()
+        with pytest.raises(ValueError):
+            c.start(2, 0.0)
+        with pytest.raises(ValueError):
+            c.start(2, 101.0)
+
+    def test_status_shape(self):
+        c = self._canary(percent=25.0)
+        s = c.status()
+        assert s["state"] == "active" and s["version"] == 2
+        assert s["percent"] == 25.0
+        assert set(s["cohorts"]) == {"candidate", "incumbent"}
+
+
+# ------------------------------------------------------------ shadow units
+@pytest.mark.smoke
+class TestShadowMirror:
+    def test_sample_one_mirrors_everything(self):
+        from seist_tpu.serve.canary import ShadowMirror
+
+        s = ShadowMirror()
+        s.start(2, 1.0)
+        assert s.should_mirror("deadbeef" * 4)
+        s.stop()
+        assert not s.should_mirror("deadbeef" * 4)
+
+    def test_sampling_is_deterministic(self):
+        import hashlib
+
+        from seist_tpu.serve.canary import ShadowMirror
+
+        s = ShadowMirror()
+        s.start(2, 0.5)
+        ids = [
+            hashlib.md5(str(i).encode()).hexdigest() for i in range(200)
+        ]
+        first = [s.should_mirror(t) for t in ids]
+        assert first == [s.should_mirror(t) for t in ids]
+        assert 0 < sum(first) < 200
+
+    def test_record_counts_and_jsonl_report(self, tmp_path):
+        from seist_tpu.serve.canary import ShadowMirror
+
+        report = str(tmp_path / "shadow.jsonl")
+        s = ShadowMirror()
+        s.start(2, 1.0, report)
+        s.record("t1", "match", {"diff": {"match": True}})
+        s.record("t2", "mismatch", {"diff": {"match": False}})
+        s.record("t3", "no_candidate", {"reason": "none"})
+        counts = s.status()["counts"]
+        assert counts["mirrored"] == 2 and counts["mismatch"] == 1
+        assert counts["no_candidate"] == 1
+        lines = [json.loads(x) for x in open(report)]
+        assert [x["verdict"] for x in lines] == [
+            "match", "mismatch", "no_candidate"
+        ]
+        assert lines[1]["trace_id"] == "t2"
+
+    def test_bad_sample_rejected(self):
+        from seist_tpu.serve.canary import ShadowMirror
+
+        with pytest.raises(ValueError):
+            ShadowMirror().start(2, 1.5)
+
+
+# ---------------------------------------------------------- decision diffs
+@pytest.mark.smoke
+class TestDecisionDiff:
+    def test_picks_within_tolerance_match(self):
+        from seist_tpu.serve.canary import decision_diff
+
+        a = {"task": "picking", "ppk": [{"sample": 100}], "spk": [],
+             "det": [{"onset": 90, "offset": 300}]}
+        b = {"task": "picking", "ppk": [{"sample": 105}], "spk": [],
+             "det": [{"onset": 95, "offset": 305}]}
+        assert decision_diff(a, b)["match"]
+
+    def test_moved_pick_mismatches(self):
+        from seist_tpu.serve.canary import decision_diff
+
+        a = {"task": "picking", "ppk": [{"sample": 100}], "spk": []}
+        b = {"task": "picking", "ppk": [{"sample": 200}], "spk": []}
+        d = decision_diff(a, b)
+        assert not d["match"] and not d["fields"]["ppk"]["match"]
+
+    def test_pick_count_mismatch(self):
+        from seist_tpu.serve.canary import decision_diff
+
+        a = {"task": "picking", "ppk": [{"sample": 100}], "spk": []}
+        b = {"task": "picking", "ppk": [], "spk": []}
+        assert not decision_diff(a, b)["match"]
+
+    def test_classifier_argmax(self):
+        from seist_tpu.serve.canary import decision_diff
+
+        a = {"task": "classification",
+             "pmp": {"class": 1, "scores": [0.1, 0.9]}}
+        same = {"task": "classification",
+                "pmp": {"class": 1, "scores": [0.4, 0.6]}}
+        flip = {"task": "classification",
+                "pmp": {"class": 0, "scores": [0.6, 0.4]}}
+        assert decision_diff(a, same)["match"]
+        assert not decision_diff(a, flip)["match"]
+
+    def test_regression_tolerance_scales(self):
+        from seist_tpu.serve.canary import decision_diff
+
+        a = {"task": "regression", "emg": 4.0}
+        assert decision_diff(a, {"task": "regression", "emg": 4.1})["match"]
+        assert not decision_diff(
+            a, {"task": "regression", "emg": 5.0}
+        )["match"]
+
+    def test_version_fields_ignored(self):
+        from seist_tpu.serve.canary import decision_diff
+
+        a = {"task": "regression", "emg": 4.0, "model_version": 1}
+        b = {"task": "regression", "emg": 4.0, "model_version": 2}
+        assert decision_diff(a, b)["match"]
+
+    def test_shape_divergence_is_a_mismatch_not_a_crash(self):
+        """A head whose output SHAPE changed between versions (dict vs
+        scalar, garbage pick lists) must report as a decision mismatch —
+        not crash the mirror thread into 'mirror_errors'."""
+        from seist_tpu.serve.canary import decision_diff
+
+        a = {"task": "classification",
+             "pmp": {"class": 1, "scores": [0.1, 0.9]}}
+        b = {"task": "classification", "pmp": 0.9}
+        d = decision_diff(a, b)
+        assert not d["match"]
+        assert "shape mismatch" in d["fields"]["pmp"]["detail"]
+        # Unparseable pick lists likewise.
+        d2 = decision_diff(
+            {"task": "picking", "ppk": [{"sample": 3}], "spk": []},
+            {"task": "picking", "ppk": [0.5], "spk": []},
+        )
+        assert not d2["match"]
+
+    def test_multitask_recurses_and_missing_task_fails(self):
+        from seist_tpu.serve.canary import decision_diff
+
+        a = {"tasks": {"dpk": {"task": "picking", "ppk": [], "spk": []},
+                       "emg": {"task": "regression", "emg": 4.0}}}
+        b_ok = {"tasks": {"dpk": {"task": "picking", "ppk": [], "spk": []},
+                          "emg": {"task": "regression", "emg": 4.02}}}
+        b_missing = {"tasks": {"dpk": {"task": "picking", "ppk": [],
+                                       "spk": []}}}
+        assert decision_diff(a, b_ok)["match"]
+        assert not decision_diff(a, b_missing)["match"]
+
+
+# ----------------------------------------------------- rollout cmd rewrite
+@pytest.mark.smoke
+class TestRolloutCmd:
+    def test_strips_and_appends_model_version(self):
+        from supervise_fleet import rollout_cmd
+
+        cmd = ["serve", "--model-version", "1", "--window", "256"]
+        out = rollout_cmd(cmd, 2)
+        assert out == ["serve", "--window", "256", "--model-version", "2"]
+
+    def test_checkpoint_substitution_all_forms(self):
+        from supervise_fleet import rollout_cmd
+
+        cmd = ["serve", "--model", "phasenet=old.ck", "--checkpoint", "o2",
+               "--model-group", "seist_s=dpk:a,emg:b"]
+        out = rollout_cmd(cmd, 3, "new.ck")
+        assert "--model" in out and "phasenet=new.ck" in out
+        assert out[out.index("--checkpoint") + 1] == "new.ck"
+        assert "seist_s=dpk:new.ck,emg:new.ck" in out
+        assert out[-2:] == ["--model-version", "3"]
+
+    def test_no_checkpoint_leaves_model_flags(self):
+        from supervise_fleet import rollout_cmd
+
+        cmd = ["serve", "--model", "phasenet=old.ck"]
+        out = rollout_cmd(cmd, 4)
+        assert "phasenet=old.ck" in out
+        assert out[-2:] == ["--model-version", "4"]
+
+
+# ------------------------------------------- fleet rollout state machine
+class _FakeProc:
+    _next_pid = [1000]
+
+    def __init__(self):
+        self.pid = self._next_pid[0]
+        self._next_pid[0] += 1
+        self.signals = []
+        self._rc = None
+
+    def poll(self):
+        return self._rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+
+class _FakeSlot:
+    def __init__(self, index, port):
+        self.index = index
+        self.port = port
+        self.url = f"127.0.0.1:{port}"
+        self.cmd = ["serve", "--model", "phasenet=", "--host", "127.0.0.1",
+                    "--port", str(port)]
+        self.proc = _FakeProc()
+        self.retired = False
+
+
+class _FakeRegistry:
+    def __init__(self, slots):
+        self._slots = slots
+        self.ready = {s.url: True for s in slots}
+
+    def replicas(self):
+        class R:
+            def __init__(self, url, ready):
+                self.url, self.probe_ready = url, ready
+
+        return [R(u, r) for u, r in self.ready.items()]
+
+
+@pytest.mark.smoke
+class TestFleetRolloutStateMachine:
+    def _roll(self, n=2, **kw):
+        from supervise_fleet import FleetRollout
+
+        slots = [_FakeSlot(i, 18100 + i) for i in range(n)]
+        return slots, FleetRollout(slots, version=2, **kw), _FakeRegistry(
+            slots
+        )
+
+    def test_one_replica_at_a_time_drain_relaunch_ready(self):
+        import signal as _signal
+
+        slots, roll, reg = self._roll(2, ready_timeout_s=30.0)
+        state = {s.index: (False, {}) for s in slots}
+
+        def probe(slot):
+            return state[slot.index]
+
+        # Tick 1: slot 0 drained (SIGTERM), slot 1 untouched.
+        roll.advance(reg, probe)
+        assert slots[0].proc.signals == [_signal.SIGTERM]
+        assert slots[1].proc.signals == []
+        assert slots[0].cmd[-2:] == ["--model-version", "2"]
+        assert slots[1].cmd[-2:] != ["--model-version", "2"]
+        # Simulate the monitor reaping 75 + respawning slot 0.
+        slots[0].proc = _FakeProc()
+        roll.advance(reg, probe)  # sees the new pid -> wait_ready
+        roll.advance(reg, probe)  # not ready yet: stays on slot 0
+        assert slots[1].proc.signals == []
+        # Slot 0 converges; next tick must move on and drain slot 1.
+        state[0] = (True, {"phasenet": 2})
+        roll.advance(reg, probe)
+        assert roll.rolled == [0]
+        roll.advance(reg, probe)
+        assert slots[1].proc.signals == [_signal.SIGTERM]
+        slots[1].proc = _FakeProc()
+        state[1] = (True, {"phasenet": 2})
+        roll.advance(reg, probe)  # relaunch seen
+        roll.advance(reg, probe)  # ready
+        assert roll.done and not roll.aborted
+        assert roll.rolled == [0, 1]
+
+    def test_stale_version_does_not_count_as_ready(self):
+        slots, roll, reg = self._roll(1, ready_timeout_s=30.0)
+        roll.advance(reg, lambda s: (True, {"phasenet": 1}))
+        slots[0].proc = _FakeProc()
+        roll.advance(reg, lambda s: (True, {"phasenet": 1}))
+        for _ in range(5):
+            roll.advance(reg, lambda s: (True, {"phasenet": 1}))
+        assert not roll.done  # still waiting: old version keeps serving
+
+    def test_ready_timeout_aborts(self, monkeypatch):
+        slots, roll, reg = self._roll(2, ready_timeout_s=0.05)
+        roll.advance(reg, lambda s: (False, {}))
+        slots[0].proc = _FakeProc()
+        roll.advance(reg, lambda s: (False, {}))  # enters wait_ready
+        time.sleep(0.06)
+        roll.advance(reg, lambda s: (False, {}))
+        assert roll.done and "not ready" in roll.aborted
+        # The roll stopped BEFORE touching slot 1: capacity floor held.
+        assert slots[1].proc.signals == []
+
+    def test_wedged_drain_aborts_instead_of_hanging(self):
+        """A replica that ignores SIGTERM (wedged flush thread): the
+        SAME per-slot deadline covers the drain, so the roll aborts
+        loudly instead of waiting on the old pid forever."""
+        slots, roll, reg = self._roll(2, ready_timeout_s=0.05)
+        roll.advance(reg, lambda s: (False, {}))  # SIGTERM sent
+        time.sleep(0.06)
+        # The old process never exited: same proc, same pid.
+        roll.advance(reg, lambda s: (False, {}))
+        assert roll.done and "never relaunched" in roll.aborted
+        assert slots[1].proc.signals == []
+
+    def test_retired_slot_mid_roll_aborts_and_skipped_upfront(self):
+        # Retired while being waited on -> abort.
+        slots, roll, reg = self._roll(2, ready_timeout_s=30.0)
+        roll.advance(reg, lambda s: (False, {}))
+        slots[0].retired = True
+        roll.advance(reg, lambda s: (False, {}))
+        assert roll.done and "retired mid-roll" in roll.aborted
+        # Retired before its turn -> skipped, roll completes on the rest.
+        slots2, roll2, reg2 = self._roll(2, ready_timeout_s=30.0)
+        slots2[0].retired = True
+        roll2.advance(reg2, lambda s: (True, {"phasenet": 2}))
+        assert slots2[0].proc.signals == []  # corpse never drained
+        slots2[1].proc = _FakeProc()
+        roll2.advance(reg2, lambda s: (True, {"phasenet": 2}))
+        roll2.advance(reg2, lambda s: (True, {"phasenet": 2}))
+        assert roll2.done and roll2.rolled == [1] and not roll2.aborted
+
+    def test_subset_rolls_only_named_replicas(self):
+        slots, roll, reg = self._roll(3, subset=[1])
+        assert [s.index for s in roll.queue] == [1]
+
+    def test_not_in_rotation_blocks_completion(self):
+        slots, roll, reg = self._roll(1, ready_timeout_s=30.0)
+        roll.advance(reg, lambda s: (True, {"phasenet": 2}))
+        slots[0].proc = _FakeProc()
+        roll.advance(reg, lambda s: (True, {"phasenet": 2}))
+        reg.ready[slots[0].url] = False  # router hasn't readmitted yet
+        roll.advance(reg, lambda s: (True, {"phasenet": 2}))
+        assert not roll.done
+        reg.ready[slots[0].url] = True
+        roll.advance(reg, lambda s: (True, {"phasenet": 2}))
+        assert roll.done and roll.rolled == [0]
+
+
+# --------------------------------------- router canary/shadow over sockets
+class _CannedReplica:
+    """Minimal scriptable replica: /healthz/ready with a version,
+    /predict answering a canned (status, body)."""
+
+    def __init__(self, version, status=200, body=None):
+        self.version = version
+        self.reply_status = status
+        self.reply_body = body or {"task": "regression", "emg": 4.0,
+                                   "model_version": version}
+        self.predicts = 0
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, status, payload):
+                raw = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+            def do_GET(self):
+                self._send(200, {"status": "ok", "ready": True,
+                                 "versions": {"m": outer.version}})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                outer.predicts += 1
+                self._send(outer.reply_status, dict(outer.reply_body))
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.server.daemon_threads = True
+        threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        ).start()
+        self.url = "127.0.0.1:%d" % self.server.server_address[1]
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def two_cohorts():
+    incumbent = _CannedReplica(1)
+    candidate = _CannedReplica(2)
+    yield incumbent, candidate
+    incumbent.close()
+    candidate.close()
+
+
+def _router_for(*replicas, **config_kw):
+    from seist_tpu.obs.bus import MetricsBus
+    from seist_tpu.serve.router import Router, RouterConfig
+
+    config = RouterConfig(
+        retries=2, request_timeout_s=5.0,
+        breaker_failures=100,  # the canary, not the breaker, must act
+        **config_kw,
+    )
+    router = Router(config=config, bus=MetricsBus())
+    for r in replicas:
+        rep = router.registry.add(r.url)
+        rep.versions = {"m": r.version}  # what the prober would learn
+    return router
+
+BODY = json.dumps({"data": [[0.0] * 3] * 8,
+                   "options": {"timeout_ms": 5000.0}}).encode()
+
+
+class TestRouterCanary:
+    def test_canary_percent_routes_and_healthy_stays_active(
+        self, two_cohorts
+    ):
+        from seist_tpu.serve.canary import CanaryBudget
+
+        incumbent, candidate = two_cohorts
+        router = _router_for(incumbent, candidate)
+        try:
+            router.canary.start(2, 50.0, CanaryBudget(min_requests=1000))
+            for _ in range(20):
+                status, _, _ = router.forward("/predict", BODY)
+                assert status == 200
+            assert candidate.predicts == 10  # exact weighted share
+            assert incumbent.predicts == 10
+            assert router.canary.state == "active"
+        finally:
+            router.stop()
+
+    def test_bad_candidate_rolls_back_and_drains(self, two_cohorts):
+        from seist_tpu.serve.canary import CanaryBudget
+
+        incumbent, candidate = two_cohorts
+        candidate.reply_status = 500
+        candidate.reply_body = {"error": "bad_candidate"}
+        router = _router_for(incumbent, candidate)
+        try:
+            router.canary.start(
+                2, 50.0,
+                CanaryBudget(max_error_delta=0.3, min_requests=4),
+            )
+            statuses = [
+                router.forward("/predict", BODY)[0] for _ in range(30)
+            ]
+            # Clients never failed: candidate 500s were retried on the
+            # incumbent within the request.
+            assert statuses == [200] * 30
+            assert router.canary.state == "rolled_back"
+            assert router.canary.percent == 0.0
+            n_at_rollback = candidate.predicts
+            for _ in range(20):
+                assert router.forward("/predict", BODY)[0] == 200
+            # Drained to 0%: not one more request reached the candidate.
+            assert candidate.predicts == n_at_rollback
+            # The event is on the bus and on a trace flag.
+            snap = router._bus.snapshot()
+            rollbacks = [
+                k for k in snap.get("counters", {})
+                if k.startswith("router_canary_rollback")
+            ]
+            assert rollbacks, snap.get("counters")
+            from seist_tpu.obs import trace as obs_trace
+
+            flagged = [
+                t for t in obs_trace.index_payload()["traces"]
+                if "canary_rollback" in t["flags"]
+            ]
+            assert flagged
+        finally:
+            router.stop()
+
+    def test_rollback_reason_in_status(self, two_cohorts):
+        from seist_tpu.serve.canary import CanaryBudget
+
+        incumbent, candidate = two_cohorts
+        candidate.reply_status = 500
+        router = _router_for(incumbent, candidate)
+        try:
+            router.canary.start(
+                2, 100.0, CanaryBudget(max_error_delta=0.1, min_requests=3)
+            )
+            for _ in range(10):
+                router.forward("/predict", BODY)
+            status = router.status()["canary"]
+            assert status["state"] == "rolled_back"
+            assert "error-rate delta" in status["rollback_reason"]
+        finally:
+            router.stop()
+
+
+class TestRouterShadow:
+    def test_shadow_mirrors_and_diffs_without_client_impact(
+        self, two_cohorts, tmp_path
+    ):
+        incumbent, candidate = two_cohorts
+        candidate.reply_body = {"task": "regression", "emg": 9.0,
+                                "model_version": 2}  # a decision flip
+        report = str(tmp_path / "shadow.jsonl")
+        router = _router_for(incumbent, candidate)
+        try:
+            router.shadow.start(2, 1.0, report)
+            for _ in range(6):
+                status, _, payload = router.forward("/predict", BODY)
+                assert status == 200
+                # The client always gets the INCUMBENT's answer.
+                assert json.loads(payload.decode())["emg"] == 4.0
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if router.shadow.status()["counts"]["mirrored"] >= 6:
+                    break
+                time.sleep(0.05)
+            counts = router.shadow.status()["counts"]
+            assert counts["mirrored"] == 6
+            assert counts["mismatch"] == 6  # emg 4.0 vs 9.0 flips
+            assert candidate.predicts == 6
+            lines = [json.loads(x) for x in open(report)]
+            assert len(lines) == 6
+            assert all(not x["diff"]["match"] for x in lines)
+            assert all(
+                not x["diff"]["fields"]["emg"]["match"] for x in lines
+            )
+        finally:
+            router.stop()
+
+    def test_mirror_concurrency_is_bounded(self, two_cohorts):
+        """With every mirror slot busy (slow candidate), further mirrors
+        are dropped and counted — never an unbounded thread pile."""
+        incumbent, candidate = two_cohorts
+        router = _router_for(incumbent, candidate)
+        try:
+            router.shadow.start(2, 1.0)
+            taken = 0
+            while router._mirror_slots.acquire(blocking=False):
+                taken += 1
+            assert taken > 0
+            status, _, _ = router.forward("/predict", BODY)
+            assert status == 200  # the client is unaffected
+            assert router.shadow.status()["counts"]["skipped_busy"] == 1
+            assert candidate.predicts == 0
+            for _ in range(taken):
+                router._mirror_slots.release()
+        finally:
+            router.stop()
+
+    def test_shadow_primary_traffic_stays_incumbent(self, two_cohorts):
+        incumbent, candidate = two_cohorts
+        router = _router_for(incumbent, candidate)
+        try:
+            router.shadow.start(2, 0.0001)  # mirror ~nothing
+            for _ in range(10):
+                assert router.forward("/predict", BODY)[0] == 200
+            # All primaries went incumbent despite round-robin.
+            assert incumbent.predicts == 10
+            assert candidate.predicts <= 1
+        finally:
+            router.stop()
+
+
+# ----------------------------------------------- real-model reload ladder
+WINDOW = 256
+
+
+@pytest.fixture(scope="module")
+def reload_service():
+    from seist_tpu.serve import BatcherConfig, ModelPool, ServeService
+
+    pool = ModelPool([("phasenet", "")], window=WINDOW)
+    svc = ServeService(
+        pool, BatcherConfig(max_batch=2, max_delay_ms=10.0, max_queue=32)
+    )
+    yield svc
+    svc.shutdown()
+
+
+def _predict_version(svc):
+    rng = np.random.default_rng(0)
+    out = svc.predict(
+        rng.standard_normal((WINDOW, 3)).astype(np.float32).tolist(),
+        options={"ppk_threshold": 0.05, "spk_threshold": 0.05},
+    )
+    return out["model_version"], out
+
+
+class TestReloadLadder:
+    def test_version_stamped_in_response_and_healthz(self, reload_service):
+        version, out = _predict_version(reload_service)
+        assert version == 1 and out["model"] == "phasenet"
+        hz = reload_service.healthz()
+        assert hz["entries"]["phasenet"]["version"] == 1
+        assert hz["entries"]["phasenet"]["variants"] == ["fp32"]
+        assert reload_service.model_versions() == {"phasenet": 1}
+
+    def test_reload_success_swaps_and_bumps_version(self, reload_service):
+        from seist_tpu.obs.bus import BUS
+
+        before = reload_service.pool.get("phasenet")
+        res = reload_service.reload(version=2)
+        assert res["version"] == 2 and res["previous_version"] == 1
+        assert res["programs"] > 0
+        version, _ = _predict_version(reload_service)
+        assert version == 2
+        assert reload_service.pool.get("phasenet") is not before
+        assert BUS.gauge("serve_model_version", model="phasenet").value == 2
+        # The reload's compile report is visible on /healthz.
+        assert any(
+            r.get("reload_version") == 2
+            for r in reload_service.pool.warmup_report
+        )
+
+    def test_version_must_be_monotonic(self, reload_service):
+        from seist_tpu.serve.protocol import BadRequest
+
+        current = reload_service.model_versions()["phasenet"]
+        with pytest.raises(BadRequest, match="monotonic"):
+            reload_service.reload(version=current)
+
+    def test_incompatible_checkpoint_leaves_incumbent(
+        self, reload_service, monkeypatch
+    ):
+        from seist_tpu.serve.protocol import IncompatibleCheckpoint
+        from seist_tpu.train import checkpoint as ckpt_mod
+
+        # A wrong-architecture checkpoint: phasenet (BN) expects
+        # batch_stats + its own param tree; this has neither.
+        monkeypatch.setattr(
+            ckpt_mod, "load_checkpoint",
+            lambda path: {"params": {"bogus": np.zeros((3, 3), np.float32)}},
+        )
+        before, _ = _predict_version(reload_service)
+        with pytest.raises(IncompatibleCheckpoint) as ei:
+            reload_service.reload(checkpoint="/fake/wrong-arch.ckpt")
+        msg = str(ei.value)
+        assert ei.value.code == "incompatible_checkpoint"
+        assert "does not fit model 'phasenet'" in msg
+        # Named first mismatch, not a flax traceback.
+        assert "missing collection at 'batch_stats'" in msg
+        after, _ = _predict_version(reload_service)
+        assert after == before  # incumbent serving, version pinned
+
+    def test_injected_parity_gate_failure_leaves_incumbent(
+        self, reload_service, monkeypatch
+    ):
+        from seist_tpu.serve.protocol import ParityGateFailed
+        from seist_tpu.utils.faults import (
+            ServeFaultInjector,
+            ServeFaultPlan,
+        )
+
+        before, _ = _predict_version(reload_service)
+        target = before + 1
+        monkeypatch.setattr(
+            reload_service, "_faults",
+            ServeFaultInjector(
+                ServeFaultPlan(bad_candidate_version=target)
+            ),
+        )
+        with pytest.raises(ParityGateFailed) as ei:
+            reload_service.reload(version=target)
+        assert ei.value.code == "parity_gate_failed"
+        assert ei.value.status == 409
+        after, _ = _predict_version(reload_service)
+        assert after == before
+
+    def test_mid_reload_crash_leaves_incumbent(
+        self, reload_service, monkeypatch
+    ):
+        from seist_tpu.serve.protocol import ReloadFailed
+
+        before, _ = _predict_version(reload_service)
+
+        def boom(entry, buckets):
+            raise RuntimeError("XLA compile exploded mid-reload")
+
+        monkeypatch.setattr(reload_service.pool, "warm_entry", boom)
+        with pytest.raises(ReloadFailed) as ei:
+            reload_service.reload(version=before + 1)
+        assert ei.value.code == "reload_failed"
+        assert "exploded" in str(ei.value)
+        after, _ = _predict_version(reload_service)
+        assert after == before
+
+    def test_bad_candidate_version_errors_requests(
+        self, reload_service, monkeypatch
+    ):
+        from seist_tpu.serve.protocol import ServeError
+        from seist_tpu.utils.faults import (
+            ServeFaultInjector,
+            ServeFaultPlan,
+        )
+
+        current = reload_service.model_versions()["phasenet"]
+        monkeypatch.setattr(
+            reload_service, "_faults",
+            ServeFaultInjector(
+                ServeFaultPlan(bad_candidate_version=current)
+            ),
+        )
+        with pytest.raises(ServeError) as ei:
+            _predict_version(reload_service)
+        assert ei.value.code == "bad_candidate" and ei.value.status == 500
+
+    def test_group_reload_needs_checkpoints_not_checkpoint(self):
+        from seist_tpu.serve.pool import ModelPool
+        from seist_tpu.serve.protocol import BadRequest
+
+        pool = ModelPool.__new__(ModelPool)
+        pool._window, pool._seed, pool._variants = 256, 0, ("fp32",)
+        pool._reload_lock = threading.Lock()
+        pool._entries_lock = threading.Lock()
+        pool._entries = {
+            "seist_s": type("E", (), {
+                "name": "seist_s", "is_group": True, "version": 1,
+                "task_checkpoints": {"dpk": ""}, "tasks": ("dpk",),
+            })()
+        }
+        with pytest.raises(BadRequest, match="checkpoints"):
+            pool.reload("seist_s", buckets=[1], checkpoint="x", version=2)
+
+
+class TestReloadOverHTTP:
+    def test_admin_reload_roundtrip(self, reload_service):
+        import http.client
+
+        from seist_tpu.serve.server import start_http_server
+
+        server = start_http_server(reload_service, port=0)
+        host, port = server.server_address[:2]
+        try:
+            current = reload_service.model_versions()["phasenet"]
+            target = current + 1
+
+            def post(payload):
+                conn = http.client.HTTPConnection(host, port, timeout=120)
+                try:
+                    raw = json.dumps(payload).encode()
+                    conn.request("POST", "/admin/reload", raw,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    return resp.status, json.loads(resp.read().decode())
+                finally:
+                    conn.close()
+
+            status, out = post({"version": target})
+            assert status == 200, out
+            assert out["version"] == target
+            assert out["previous_version"] == current
+
+            # /healthz reflects the new version + variant surface.
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            try:
+                conn.request("GET", "/healthz")
+                hz = json.loads(conn.getresponse().read().decode())
+            finally:
+                conn.close()
+            assert hz["entries"]["phasenet"]["version"] == target
+
+            # Non-monotonic target: structured 400, version untouched.
+            status, out = post({"version": target})
+            assert status == 400 and out["error"] == "bad_request"
+            assert reload_service.model_versions()["phasenet"] == target
+        finally:
+            server.shutdown()

@@ -11,6 +11,13 @@ ISSUE 7 acceptance runs.
 * Overload at ~2x the sustainable arrival rate: the batch tier is shed
   with the distinct 503 'shed' (not the queue-full 429) while the alert
   tier's p99 passes its SLO gate — both verdicts from bench_serve.
+* Live-model flywheel (ISSUE 13): a 3-replica fleet rolled to a new
+  model version under sustained open-loop load — zero failed requests,
+  zero stale-version responses after convergence, the roll visible
+  drain -> relaunch -> ready per replica.
+* Canary auto-rollback: an injected bad candidate version
+  (SEIST_FAULT_SERVE_BAD_CANDIDATE) is drained back to 0% by the
+  router's cohort-delta budget while retries keep clients green.
 
 Replica warm-up is compile-bound; the serve CLI enables the persistent
 XLA cache, so replicas after the first (and every supervisor relaunch)
@@ -377,6 +384,181 @@ def test_blackhole_circuit_opens_then_closes(tmp_path):
         )
     finally:
         rc, err = _stop_fleet(proc)
+    assert rc == 0, err
+
+
+def test_rollout_flywheel_zero_downtime(tmp_path):
+    """Acceptance (ISSUE 13): roll a 3-replica fleet to a new model
+    version under sustained open-loop load — ZERO failed requests
+    (error_rate 0.0), ZERO stale-version responses after convergence
+    (bench_serve's --expect-version gate), with the roll visible per
+    replica (drain -> relaunch -> ready) in the supervisor log."""
+    spec = tmp_path / "rollout.json"
+    proc, host, port = _start_fleet(
+        tmp_path,
+        replicas=3,
+        fleet_args=(
+            "--router-retries", "3",
+            "--request-timeout-s", "30",
+            "--rollout-file", str(spec),
+            "--rollout-ready-timeout-s", "240",
+        ),
+    )
+    try:
+        _wait_probed_ready(host, port, 3)
+        url = f"http://{host}:{port}"
+        results = {}
+
+        def run_bench():
+            results["bench"] = _bench(
+                url, tmp_path, "flywheel",
+                "--arrival-rps", "5",
+                "--duration-s", "150",
+                "--concurrency", "32",
+                "--timeout-ms", "30000",
+                "--expect-version", "2",
+            )
+
+        bench_thread = threading.Thread(target=run_bench)
+        bench_thread.start()
+        time.sleep(3.0)  # load flowing against version 1 first
+        spec.write_text(json.dumps({"version": 2}))
+        proc.send_signal(signal.SIGHUP)
+        bench_thread.join(timeout=400)
+        assert not bench_thread.is_alive(), "bench never finished"
+        rc, res = results["bench"]
+        # Zero downtime: every request of the sustained run succeeded.
+        assert res["errors"] == 0 and res["error_rate"] == 0.0, res
+        # The run really spanned the roll: both versions answered...
+        assert res["by_version"].get("1", 0) > 0, res
+        assert res["by_version"].get("2", 0) > 0, res
+        # ...the fleet converged during it, and afterwards not one
+        # response carried the old version.
+        assert res["converged_at_s"] > 0, res
+        assert res["stale_after_convergence"] == 0, res
+        assert rc == 0, res  # the bench's own rollout gate agrees
+    finally:
+        rc, err = _stop_fleet(proc, timeout=120)
+    assert rc == 0, err
+    # The roll is visible per replica, strictly one at a time.
+    for i in range(3):
+        assert f"rollout: draining replica {i}" in err, err
+        assert re.search(
+            rf"rollout: replica {i} ready \+ re-registered \(version 2\)",
+            err,
+        ), err
+    assert err.index("rollout: replica 0 ready") < err.index(
+        "rollout: draining replica 1"
+    ), "replica 1 drained before replica 0 converged"
+    assert err.index("rollout: replica 1 ready") < err.index(
+        "rollout: draining replica 2"
+    ), "replica 2 drained before replica 1 converged"
+    assert "rollout complete: version 2" in err, err
+    assert "clean preempt (rc=75)" in err, err
+
+
+def test_canary_bad_candidate_auto_rollback(tmp_path):
+    """Acceptance (ISSUE 13): an injected bad candidate
+    (SEIST_FAULT_SERVE_BAD_CANDIDATE — elevated error rate on the
+    candidate version) is drained back to 0% automatically, the
+    incumbent cohort serves 100% of traffic, clients see no failures
+    (router retries rescue every canary error), and the rollback event
+    is on the bus and in the trace flags."""
+    spec = tmp_path / "rollout.json"
+    proc, host, port = _start_fleet(
+        tmp_path,
+        replicas=2,
+        env_extra={"SEIST_FAULT_SERVE_BAD_CANDIDATE": "2"},
+        fleet_args=(
+            "--router-retries", "2",
+            "--request-timeout-s", "30",
+            # The canary policy, not the breaker, must do the draining.
+            "--breaker-failures", "100",
+            "--rollout-file", str(spec),
+            "--rollout-ready-timeout-s", "240",
+        ),
+    )
+    try:
+        _wait_probed_ready(host, port, 2)
+        url = f"http://{host}:{port}"
+        # Canary stage: roll ONE replica to the (bad) candidate version.
+        spec.write_text(json.dumps({"version": 2, "replicas": [0]}))
+        proc.send_signal(signal.SIGHUP)
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            _, reg = _get(host, port, "/router/replicas")
+            versions = sorted(
+                r.get("versions", {}).get("phasenet", 0)
+                for r in reg.get("replicas", [])
+                if r["probe_state"] == "ok"
+            )
+            if versions == [1, 2]:
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError("canary replica never came up on v2")
+
+        # 40% canary with a tight budget over the candidate cohort.
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            body = json.dumps({
+                "version": 2, "percent": 40,
+                "max_error_delta": 0.2, "min_requests": 8,
+            }).encode()
+            conn.request("POST", "/router/canary", body,
+                         {"Content-Type": "application/json"})
+            assert conn.getresponse().status == 200
+        finally:
+            conn.close()
+
+        rc, res = _bench(
+            url, tmp_path, "canary",
+            "--requests", "80", "--concurrency", "8",
+            "--timeout-ms", "30000",
+        )
+        # No client-visible failures: every candidate 500 was retried
+        # onto the incumbent cohort within the request.
+        assert res["errors"] == 0 and res["error_rate"] == 0.0, res
+
+        _, canary = _get(host, port, "/router/canary")
+        assert canary["state"] == "rolled_back", canary
+        assert canary["percent"] == 0.0, canary
+        assert "error-rate delta" in canary["rollback_reason"], canary
+        assert canary["cohorts"]["candidate"]["errors"] >= 8, canary
+
+        # Drained to 0%: the candidate replica takes not one more
+        # request while the incumbent serves all of a follow-up run.
+        _, reg = _get(host, port, "/router/replicas")
+        cand = next(
+            r for r in reg["replicas"]
+            if r.get("versions", {}).get("phasenet") == 2
+        )
+        routed_at_rollback = cand["routed"]
+        rc2, res2 = _bench(
+            url, tmp_path, "post_rollback",
+            "--requests", "24", "--concurrency", "6",
+            "--timeout-ms", "30000",
+        )
+        assert res2["errors"] == 0, res2
+        assert res2["by_version"] == {"1": 24}, res2
+        _, reg2 = _get(host, port, "/router/replicas")
+        cand2 = next(
+            r for r in reg2["replicas"]
+            if r.get("versions", {}).get("phasenet") == 2
+        )
+        assert cand2["routed"] == routed_at_rollback, (
+            cand2, routed_at_rollback
+        )
+
+        # The rollback event: bus counter + flagged trace.
+        _, text = _get(host, port, "/metrics")
+        assert "router_canary_rollback" in text
+        _, idx = _get(host, port, "/traces")
+        assert any(
+            "canary_rollback" in t["flags"] for t in idx["traces"]
+        ), [t["flags"] for t in idx["traces"][:10]]
+    finally:
+        rc, err = _stop_fleet(proc, timeout=120)
     assert rc == 0, err
 
 

@@ -40,7 +40,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from datetime import datetime, timezone
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 _TOOLS = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_TOOLS))
@@ -88,6 +88,21 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     "in-process mode loads fp32 + the requested variant")
     ap.add_argument("--arrival-rps", type=float, default=0.0,
                     help="open-loop arrival rate (0 = closed loop)")
+    ap.add_argument("--duration-s", type=float, default=0.0,
+                    help="sustained-load mode: keep offering load for "
+                    "this many seconds (open-loop: arrivals until the "
+                    "deadline; closed-loop: workers loop until it) "
+                    "instead of a fixed --requests count — the client "
+                    "shape a rolling restart is measured under")
+    ap.add_argument("--expect-version", type=int, default=0,
+                    help="rollout acceptance gate: poll the router's "
+                    "/router/replicas until every replica is ready on "
+                    "this model version (convergence), then require "
+                    "ZERO responses launched after convergence to carry "
+                    "another version (stale_after_convergence == 0); "
+                    "exit 1 otherwise. Requires --url (router). Every "
+                    "response's model_version is counted in by_version "
+                    "regardless")
     ap.add_argument("--slo-p99-ms", type=float, default=0.0,
                     help=f"gate: exit {SLO_EXIT_CODE} if p99 of SUCCESSFUL "
                     "requests exceeds this (0 = no gate)")
@@ -117,19 +132,46 @@ class _Stats:
         self.failed: List[Dict[str, Any]] = []  # {trace_id, status, code}
         self.by_status: Dict[str, int] = {}
         self.by_code: Dict[str, int] = {}
+        #: responses per served model_version ("unknown" when absent) —
+        #: the rollout acceptance accounting (docs/SERVING.md).
+        self.by_version: Dict[str, int] = {}
+        #: (launched_at monotonic, version) per success, for the
+        #: stale-after-convergence gate.
+        self._versioned: List[Tuple[float, int]] = []
         self.ok = 0
         self.errors = 0
 
-    def success(self, latency_ms: float, trace_id: str = "") -> None:
+    def success(
+        self,
+        latency_ms: float,
+        trace_id: str = "",
+        version: Optional[int] = None,
+        launched_at: float = 0.0,
+    ) -> None:
         with self._lock:
             self.ok += 1
             self.by_status["200"] = self.by_status.get("200", 0) + 1
+            key = str(version) if version is not None else "unknown"
+            self.by_version[key] = self.by_version.get(key, 0) + 1
+            if version is not None:
+                self._versioned.append((launched_at, int(version)))
             self.latencies_ms.append(latency_ms)
             if trace_id:
                 self.successes.append({
                     "trace_id": trace_id,
                     "latency_ms": round(latency_ms, 3),
                 })
+
+    def stale_after(self, converged_at: float, expect: int) -> int:
+        """Successes LAUNCHED after the fleet converged on ``expect``
+        that still reported another version — the zero-staleness gate's
+        numerator. Launch time (not completion) is the honest clock: a
+        request sent pre-convergence may legitimately answer old."""
+        with self._lock:
+            return sum(
+                1 for launched, v in self._versioned
+                if launched > converged_at and v != expect
+            )
 
     def error(self, status: int, code: str, trace_id: str = "",
               latency_ms: float = 0.0) -> None:
@@ -162,6 +204,72 @@ class _Stats:
             "failed": failed[:failed_cap],
             "failed_total": len(failed),
         }
+
+
+class _ConvergenceWatch:
+    """Poll ``<router>/router/replicas`` until every listed replica is
+    probe-ready AND reports only ``expect_version`` — the client-side
+    definition of "the roll converged". ``converged_at`` (monotonic) is
+    None until then."""
+
+    def __init__(self, url: str, expect_version: int, poll_s: float = 0.3):
+        self.url = url
+        self.expect_version = int(expect_version)
+        self.poll_s = poll_s
+        self.converged_at: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="bench-converge", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _converged(self, payload: Dict[str, Any]) -> bool:
+        replicas = payload.get("replicas") or []
+        if not replicas:
+            return False
+        for r in replicas:
+            versions = r.get("versions") or {}
+            if not r.get("ready") or not versions:
+                return False
+            try:
+                if any(
+                    int(v) != self.expect_version
+                    for v in versions.values()
+                ):
+                    return False
+            except (TypeError, ValueError):
+                return False
+        return True
+
+    def _loop(self) -> None:
+        # The whole body under try: a watcher surprise must not kill the
+        # gate silently mid-bench (threadlint thread-target-raises) —
+        # converged_at just stays None and the gate fails loudly.
+        try:
+            from seist_tpu.serve.router import _http_request
+
+            while not self._stop.is_set() and self.converged_at is None:
+                try:
+                    status, _, body = _http_request(
+                        self.url, "GET", "/router/replicas", timeout_s=2.0
+                    )
+                    if status == 200 and self._converged(
+                        json.loads(body.decode())
+                    ):
+                        self.converged_at = time.monotonic()
+                        return
+                except Exception:  # noqa: BLE001 — poll again next tick
+                    pass
+                self._stop.wait(self.poll_s)
+        except BaseException as e:  # noqa: BLE001
+            print(f"[bench_serve] convergence watcher died: {e!r}",
+                  file=sys.stderr, flush=True)
 
 
 def _http_client(url: str, timeout_ms: float):
@@ -296,6 +404,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     def one(i: int) -> None:
         traceparent = obs_trace.mint_traceparent()
         trace_id = traceparent.split("-")[1]
+        launched_at = time.monotonic()
         with stopwatch() as elapsed:
             try:
                 status, body = one_request(
@@ -321,21 +430,42 @@ def main(argv: Optional[List[str]] = None) -> int:
                                    f"{sorted(tasks)}"}
         latency_ms = elapsed() * 1000.0
         if status == 200:
-            stats.success(latency_ms, trace_id=trace_id)
+            version = body.get("model_version")
+            try:
+                version = int(version) if version is not None else None
+            except (TypeError, ValueError):
+                version = None
+            stats.success(latency_ms, trace_id=trace_id, version=version,
+                          launched_at=launched_at)
         else:
             stats.error(status, str(body.get("error", "")),
                         trace_id=trace_id, latency_ms=latency_ms)
 
+    # Rollout convergence watcher: a background poll of the router's
+    # /router/replicas that records the moment EVERY replica is ready on
+    # --expect-version — the timestamp the staleness gate compares
+    # per-request launch times against.
+    watch: Optional[_ConvergenceWatch] = None
+    if args.expect_version > 0 and args.url:
+        watch = _ConvergenceWatch(args.url, args.expect_version)
+        watch.start()
+
+    t_start = time.monotonic()
     with stopwatch() as wall:
         if args.arrival_rps > 0:
             _drive_open_loop(one, args.requests, args.arrival_rps,
-                             args.concurrency, stats)
+                             args.concurrency, stats,
+                             duration_s=args.duration_s)
+        elif args.duration_s > 0:
+            _drive_closed_loop_for(one, args.concurrency, args.duration_s)
         else:
             with ThreadPoolExecutor(args.concurrency) as ex:
                 # ex.map would abort the whole bench on the first raised
                 # error; one() catches per-request instead.
                 list(ex.map(one, range(args.requests)))
     wall_s = wall()
+    if watch is not None:
+        watch.stop()
 
     batcher_stats: Dict[str, Any] = {}
     fanout_stats: Dict[str, Any] = {}
@@ -368,7 +498,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         "target": args.url or "in-process",
         "mode": "open-loop" if args.arrival_rps > 0 else "closed-loop",
         "window": args.window,
-        "requests": args.requests,
+        # Sustained-load mode offers whatever fits the duration; report
+        # what was actually driven, not the unused --requests default.
+        "requests": total if args.duration_s > 0 else args.requests,
+        "duration_s": args.duration_s,
         "concurrency": args.concurrency,
         "arrival_rps": args.arrival_rps,
         "priority": args.priority or "default",
@@ -386,6 +519,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         "error_rate": round(error_rate, 4),
         "by_status": dict(sorted(stats.by_status.items())),
         "by_error_code": dict(sorted(stats.by_code.items())),
+        # Served model versions per response — the live-rollout
+        # accounting (docs/SERVING.md "Live rollout").
+        "by_version": dict(sorted(stats.by_version.items())),
         "device": device,
         # The handles for `python tools/trace_report.py --from-bench`:
         # p99 suspects + every failure, by trace id. Failed exemplars are
@@ -432,6 +568,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
 
     rc = 0
+    if args.expect_version > 0:
+        # The rollout acceptance gate: the fleet must converge on the
+        # expected version during the bench, and once it has, every
+        # subsequently-launched response must carry it.
+        result["expected_version"] = args.expect_version
+        if watch is None:
+            result["converged_at_s"] = -1.0
+            result["stale_after_convergence"] = -1
+            print("[bench_serve] --expect-version needs --url (router)",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        elif watch.converged_at is None:
+            result["converged_at_s"] = -1.0
+            result["stale_after_convergence"] = -1
+            print(
+                f"[bench_serve] ROLLOUT GATE FAILED: fleet never "
+                f"converged on version {args.expect_version}",
+                file=sys.stderr, flush=True,
+            )
+            rc = 1
+        else:
+            stale = stats.stale_after(
+                watch.converged_at, args.expect_version
+            )
+            result["converged_at_s"] = round(
+                watch.converged_at - t_start, 3
+            )
+            result["stale_after_convergence"] = stale
+            if stale:
+                print(
+                    f"[bench_serve] ROLLOUT GATE FAILED: {stale} "
+                    f"stale-version responses after convergence "
+                    f"(by_version={result['by_version']})",
+                    file=sys.stderr, flush=True,
+                )
+                rc = 1
     if tasks:
         missing = stats.by_code.get("missing_head", 0)
         result["fanout_complete"] = missing == 0 and stats.ok > 0
@@ -470,9 +642,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     return rc
 
 
+def _drive_closed_loop_for(one, concurrency: int, duration_s: float) -> None:
+    """Sustained closed-loop: ``concurrency`` workers each fire the next
+    request as soon as the previous answers, until the deadline — the
+    fixed-duration client a rolling restart is benched under (total
+    request count is whatever the service sustained)."""
+    deadline = time.monotonic() + duration_s
+    counter = iter(range(1 << 62))
+    counter_lock = threading.Lock()
+
+    def worker() -> None:
+        # one() accounts every exception itself; the loop shape is the
+        # only logic here (threadlint thread-target-raises).
+        try:
+            while time.monotonic() < deadline:
+                with counter_lock:
+                    i = next(counter)
+                one(i)
+        except BaseException as e:  # noqa: BLE001
+            print(f"[bench_serve] closed-loop worker died: {e!r}",
+                  file=sys.stderr, flush=True)
+
+    threads = [
+        threading.Thread(target=worker, daemon=True)
+        for _ in range(max(1, concurrency))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
 def _drive_open_loop(
     one, n_requests: int, arrival_rps: float, max_inflight: int,
-    stats: "_Stats",
+    stats: "_Stats", duration_s: float = 0.0,
 ) -> None:
     """Launch request i at t0 + i/rps on a worker thread, independent of
     completions (the open-loop arrival model). The thread pool is capped
@@ -481,14 +684,29 @@ def _drive_open_loop(
     client silently throttling arrivals. Past that cap, further arrivals
     are dropped ON THE CLIENT and counted as status 0 ``client_overrun``
     errors — an open-loop bench that quietly stopped offering load would
-    otherwise report a fake SLO pass."""
+    otherwise report a fake SLO pass.
+
+    ``duration_s > 0`` switches from a fixed request count to sustained
+    load: arrivals keep coming on the same clock until the deadline."""
     interval = 1.0 / arrival_rps
     cap = max(1, max_inflight) * 4
     sem = threading.Semaphore(cap)
     n_over = 0
     threads: List[threading.Thread] = []
     t0 = time.monotonic()
-    for i in range(n_requests):
+    if duration_s > 0:
+        deadline = t0 + duration_s
+
+        def arrivals():
+            i = 0
+            while time.monotonic() < deadline:
+                yield i
+                i += 1
+
+        schedule = arrivals()
+    else:
+        schedule = iter(range(n_requests))
+    for i in schedule:
         target = t0 + i * interval
         delay = target - time.monotonic()
         if delay > 0:

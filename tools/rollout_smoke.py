@@ -1,0 +1,209 @@
+"""Rollout smoke (`make rollout-smoke`): a real 2-replica phasenet fleet
+is rolled to a new model version while a sustained open-loop bench runs
+against the router — the zero-downtime acceptance in one command
+(docs/SERVING.md "Live rollout").
+
+Asserts, from the bench's own JSON:
+
+* ``error_rate == 0.0`` — not one request failed across the roll;
+* ``converged_at_s > 0`` — the fleet reached the target version while
+  the load was still running;
+* ``stale_after_convergence == 0`` — after convergence, no response
+  carried the old version;
+* both versions appear in ``by_version`` (the run really spanned the
+  roll);
+
+and, from the supervisor log, that each replica was drained, relaunched
+and probed ready one at a time. Prints one JSON verdict line; exit 0/1.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+WINDOW = 256
+TARGET_VERSION = 2
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _drain(pipe, buf):
+    # The whole body under try: a reader surprise must not silently stop
+    # draining the fleet's pipe — a full kernel buffer would wedge every
+    # fleet process on its next write (threadlint thread-target-raises).
+    try:
+        for line in pipe:
+            buf.append(line)
+    except Exception as e:  # noqa: BLE001
+        buf.append(f"[rollout_smoke] pipe drain died: {e!r}\n")
+
+
+def main() -> int:
+    import tempfile
+
+    import bench_serve
+
+    tmp = tempfile.mkdtemp(prefix="rollout_smoke_")
+    spec_path = os.path.join(tmp, "rollout.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.join(HERE, "supervise_fleet.py"),
+            "--replicas", "2",
+            "--base-port", str(_free_port()),
+            "--router-port", "0",
+            "--probe-interval-s", "0.3",
+            "--router-retries", "3",
+            "--request-timeout-s", "30",
+            "--rollout-file", spec_path,
+            "--rollout-ready-timeout-s", "240",
+            "--",
+            sys.executable, os.path.join(REPO, "main.py"), "serve",
+            "--model", "phasenet=",
+            "--window", str(WINDOW),
+            "--max-batch", "4",
+            "--max-delay-ms", "5",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
+    )
+    err_buf: list = []
+    threading.Thread(
+        target=_drain, args=(proc.stderr, err_buf), daemon=True
+    ).start()
+    router = None
+    for _ in range(50):
+        line = proc.stdout.readline()
+        if not line:
+            break
+        m = re.search(r"ROUTER=http://([\d.]+):(\d+)", line)
+        if m:
+            router = f"http://{m.group(1)}:{m.group(2)}"
+            break
+    threading.Thread(
+        target=_drain, args=(proc.stdout, []), daemon=True
+    ).start()
+    verdict = {"metric": "rollout_smoke", "ok": False}
+    bench_ok = False
+    try:
+        if router is None:
+            verdict["error"] = "no ROUTER line from supervise_fleet"
+            return _finish(proc, err_buf, verdict, bench_ok)
+        # Wait for both replicas probed-ready (first run pays compiles).
+        from seist_tpu.serve.router import _http_request
+
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            try:
+                _, _, body = _http_request(
+                    router, "GET", "/router/replicas", timeout_s=3.0
+                )
+                reps = json.loads(body.decode()).get("replicas", [])
+                if sum(
+                    1 for r in reps if r.get("probe_state") == "ok"
+                ) >= 2:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.3)
+        else:
+            verdict["error"] = "fleet never warmed"
+            return _finish(proc, err_buf, verdict, bench_ok)
+
+        results = {}
+
+        def run_bench():
+            # Missing results["bench"] IS the recorded death signal the
+            # main thread checks (threadlint thread-target-raises).
+            try:
+                out = os.path.join(tmp, "bench.json")
+                rc = bench_serve.main([
+                    "--url", router,
+                    "--window", str(WINDOW),
+                    "--model-name", "phasenet",
+                    "--arrival-rps", "5",
+                    "--duration-s", "90",
+                    "--concurrency", "32",
+                    "--timeout-ms", "30000",
+                    "--expect-version", str(TARGET_VERSION),
+                    "--output", out,
+                ])
+                with open(out) as f:
+                    results["bench"] = (rc, json.load(f))
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"[rollout_smoke] bench died: {e!r}\n")
+
+        t = threading.Thread(target=run_bench)
+        t.start()
+        time.sleep(3.0)
+        with open(spec_path, "w") as f:
+            json.dump({"version": TARGET_VERSION}, f)
+        proc.send_signal(signal.SIGHUP)
+        t.join(timeout=300.0)
+        if "bench" not in results:
+            verdict["error"] = "bench never finished"
+            return _finish(proc, err_buf, verdict, bench_ok)
+        rc, res = results["bench"]
+        verdict.update({
+            "bench_rc": rc,
+            "requests": res["requests"],
+            "error_rate": res["error_rate"],
+            "by_version": res["by_version"],
+            "converged_at_s": res.get("converged_at_s"),
+            "stale_after_convergence": res.get("stale_after_convergence"),
+        })
+        bench_ok = all([
+            rc == 0,
+            res["error_rate"] == 0.0,
+            res.get("converged_at_s", -1) > 0,
+            res.get("stale_after_convergence", -1) == 0,
+            res["by_version"].get("1", 0) > 0,
+            res["by_version"].get(str(TARGET_VERSION), 0) > 0,
+        ])
+        return _finish(proc, err_buf, verdict, bench_ok)
+    except BaseException:
+        _finish(proc, err_buf, verdict, bench_ok)
+        raise
+
+
+def _finish(proc, err_buf, verdict, bench_ok) -> int:
+    """Tear the fleet down, fold the supervisor-log checks into the
+    verdict, print it, return the exit code."""
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=90)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    err = "".join(err_buf)
+    verdict["rollout_log_ok"] = bool(
+        re.search(rf"rollout complete: version {TARGET_VERSION}", err)
+        and all(f"rollout: draining replica {i}" in err for i in (0, 1))
+    )
+    verdict["ok"] = bool(bench_ok and verdict["rollout_log_ok"])
+    print(json.dumps(verdict), flush=True)
+    if not verdict["ok"]:
+        sys.stderr.write(err[-4000:])
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,18 +38,33 @@ replica's ``/metrics.json`` plus the in-process router's bus and serves
 the merged view (counters summed, histograms merged bucket-wise,
 per-replica breakdown retained) at ``GET /fleet/metrics[.json]`` on the
 router port (docs/SERVING.md "Fleet metrics").
+
+**Rolling restart** (the live-model flywheel, docs/SERVING.md "Live
+rollout"): ``SIGHUP`` makes the supervisor read ``--rollout-file`` (JSON:
+``{"version": N, "checkpoint"?: path, "cmd"?: [...], "replicas"?: [i]}``)
+and roll the fleet to the new model version ONE replica at a time —
+SIGTERM-drain (exit 75, in-flight requests finish, the router routes
+away), relaunch on the rewritten command (``--model-version N`` +
+checkpoint substitution), then wait until the replica answers
+``/healthz/ready`` with the target version AND is probe-ready in the
+router's registry before touching the next. Capacity never dips below
+N-1, and a replica that never converges aborts the roll loudly instead
+of draining the next one. ``"replicas": [0]`` rolls a subset — the
+canary-staging primitive (roll one, canary it via ``POST
+/router/canary``, then roll the rest).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import subprocess
 import sys
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 _TOOLS = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_TOOLS))
@@ -62,6 +77,217 @@ PREEMPT_EXIT_CODE = 75
 
 def _log(msg: str) -> None:
     print(f"[fleet] {msg}", file=sys.stderr, flush=True)
+
+
+def rollout_cmd(
+    cmd: List[str], version: int, checkpoint: Optional[str] = None
+) -> List[str]:
+    """Rewrite a replica command for a new model version: strip any
+    existing ``--model-version``, substitute checkpoints when one is
+    given (``--model NAME=CKPT`` values, ``--checkpoint`` values, and
+    every task of ``--model-group PREFIX=task:CKPT,...``), then append
+    ``--model-version N``. Pure function (unit-tested); anything fancier
+    ships a full ``"cmd"`` in the rollout file instead."""
+    out: List[str] = []
+    i = 0
+    while i < len(cmd):
+        arg = cmd[i]
+        if arg == "--model-version":
+            i += 2  # drop flag + value
+            continue
+        if arg.startswith("--model-version="):
+            i += 1
+            continue
+        if checkpoint is not None:
+            if arg == "--model" and i + 1 < len(cmd):
+                name = cmd[i + 1].partition("=")[0]
+                out += [arg, f"{name}={checkpoint}"]
+                i += 2
+                continue
+            if arg == "--checkpoint" and i + 1 < len(cmd):
+                out += [arg, checkpoint]
+                i += 2
+                continue
+            if arg == "--model-group" and i + 1 < len(cmd):
+                prefix, _, rest = cmd[i + 1].partition("=")
+                tasks = [
+                    part.partition(":")[0] for part in rest.split(",")
+                ]
+                out += [
+                    arg,
+                    prefix + "=" + ",".join(
+                        f"{t}:{checkpoint}" for t in tasks if t
+                    ),
+                ]
+                i += 2
+                continue
+        out.append(arg)
+        i += 1
+    return out + ["--model-version", str(version)]
+
+
+class FleetRollout:
+    """One in-flight rolling restart, advanced by the monitor loop (a
+    state machine, not a blocking call — crash relaunches and budget
+    accounting keep running for the rest of the fleet mid-roll).
+
+    Per replica: ``drain`` (SIGTERM; the replica exits 75 after serving
+    its in-flight work and the monitor relaunches it IMMEDIATELY on the
+    already-rewritten command) -> ``wait_ready`` (poll the replica's
+    ``/healthz/ready`` until it reports the target version, plus the
+    router's probe_ready so it is actually back in rotation) -> next
+    slot. Aborts loudly on a per-replica ready timeout."""
+
+    def __init__(
+        self,
+        slots: List["ReplicaSlot"],
+        version: int,
+        checkpoint: Optional[str] = None,
+        cmd: Optional[List[str]] = None,
+        subset: Optional[List[int]] = None,
+        ready_timeout_s: float = 300.0,
+    ):
+        self.version = int(version)
+        self.checkpoint = checkpoint
+        self.cmd = list(cmd) if cmd else None
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.queue = [
+            s for s in slots
+            if not s.retired and (subset is None or s.index in subset)
+        ]
+        self.phase = "start"  # start -> wait_relaunch -> wait_ready
+        self.current: Optional[ReplicaSlot] = None
+        self._old_pid: Optional[int] = None
+        self._ready_deadline = 0.0
+        self.done = False
+        self.aborted = ""
+        self.rolled: List[int] = []
+
+    def _finish(self) -> None:
+        self.done = True
+        _log(
+            f"rollout complete: version {self.version} on "
+            f"replica(s) {self.rolled}"
+        )
+
+    def _next_slot(self) -> None:
+        # A queued slot may have burned its crash budget since SIGHUP:
+        # skip retired slots instead of draining a corpse (the monitor
+        # never relaunches them, so waiting on one would hang the roll).
+        while self.queue and self.queue[0].retired:
+            skipped = self.queue.pop(0)
+            _log(
+                f"rollout: replica {skipped.index} retired since the "
+                "roll started; skipping"
+            )
+        if not self.queue:
+            self._finish()
+            return
+        self.current = self.queue.pop(0)
+        slot = self.current
+        # One deadline covers the slot's WHOLE drain -> relaunch -> ready
+        # journey: a replica that ignores SIGTERM (wedged flush thread)
+        # must abort the roll just as loudly as one that never converges.
+        self._ready_deadline = time.monotonic() + self.ready_timeout_s
+        base = self.cmd if self.cmd is not None else slot.cmd
+        # Keep the supervisor-assigned --host/--port intact: rollout_cmd
+        # only touches model flags; a full "cmd" replacement gets the
+        # slot's host/port re-appended (argparse: last value wins).
+        new_cmd = rollout_cmd(base, self.version, self.checkpoint)
+        if self.cmd is not None:
+            new_cmd += ["--host", "127.0.0.1", "--port", str(slot.port)]
+        slot.cmd = new_cmd
+        if slot.proc is not None and slot.proc.poll() is None:
+            self._old_pid = slot.proc.pid
+            _log(
+                f"rollout: draining replica {slot.index} "
+                f"(pid {self._old_pid}) for version {self.version}"
+            )
+            try:
+                slot.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            self.phase = "wait_relaunch"
+        else:
+            # Slot already down (crash backoff): the monitor's next
+            # relaunch uses the rewritten command.
+            self._old_pid = None
+            _log(
+                f"rollout: replica {slot.index} already down; relaunch "
+                f"will carry version {self.version}"
+            )
+            self.phase = "wait_relaunch"
+
+    def _abort(self, reason: str) -> None:
+        self.aborted = reason
+        self.done = True
+        _log(f"rollout ABORTED: {reason}")
+
+    def advance(self, registry, probe_ready_fn) -> None:
+        """One monitor tick. ``probe_ready_fn(slot) -> (ready, versions)``
+        polls the replica's own /healthz/ready (injectable for tests)."""
+        if self.done:
+            return
+        if self.phase == "start":
+            self._next_slot()
+            if self.done:
+                return
+        slot = self.current
+        now = time.monotonic()
+        if self.phase == "wait_relaunch":
+            if slot.retired:
+                self._abort(
+                    f"replica {slot.index} retired mid-roll (crash budget)"
+                )
+                return
+            if now >= self._ready_deadline:
+                self._abort(
+                    f"replica {slot.index} never relaunched within "
+                    f"{self.ready_timeout_s:.0f}s (drain wedged?)"
+                )
+                return
+            proc = slot.proc
+            if proc is None or (
+                self._old_pid is not None and proc.pid == self._old_pid
+            ):
+                return  # still draining / in the monitor's relaunch gap
+            _log(
+                f"rollout: replica {slot.index} relaunched "
+                f"(pid {proc.pid}, version {self.version}); waiting ready"
+            )
+            self.phase = "wait_ready"
+            return
+        if self.phase == "wait_ready":
+            if slot.retired:
+                self._abort(
+                    f"replica {slot.index} retired mid-roll (crash budget)"
+                )
+                return
+            if now >= self._ready_deadline:
+                self._abort(
+                    f"replica {slot.index} not ready on version "
+                    f"{self.version} within {self.ready_timeout_s:.0f}s"
+                )
+                return
+            ready, versions = probe_ready_fn(slot)
+            if not ready or not versions:
+                return
+            if any(int(v) != self.version for v in versions.values()):
+                return  # relaunched but still reporting the old version
+            in_rotation = any(
+                r.probe_ready and r.url.endswith(f":{slot.port}")
+                for r in registry.replicas()
+            )
+            if not in_rotation:
+                return  # ready, but the router's prober hasn't readmitted
+            _log(
+                f"rollout: replica {slot.index} ready + re-registered "
+                f"(version {self.version})"
+            )
+            self.rolled.append(slot.index)
+            self.phase = "start"
+            if not self.queue:
+                self._finish()  # the last replica converged this tick
 
 
 class ReplicaSlot:
@@ -121,6 +347,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="how often the fleet aggregator pulls every "
                     "replica's /metrics.json (served merged on the "
                     "router port at GET /fleet/metrics[.json])")
+    ap.add_argument("--rollout-file", default="",
+                    help="JSON rollout spec ({'version': N, "
+                    "'checkpoint'?: path, 'cmd'?: [...], 'replicas'?: "
+                    "[i, ...]}) read when SIGHUP arrives: rolls the "
+                    "fleet to the new model version one replica at a "
+                    "time (docs/SERVING.md 'Live rollout')")
+    ap.add_argument("--rollout-ready-timeout-s", type=float, default=300.0,
+                    help="per-replica ready deadline during a roll; "
+                    "exceeding it ABORTS the roll (capacity stays N-1, "
+                    "never N-2)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="the replica command, after `--` (without "
                     "--host/--port, which the supervisor assigns)")
@@ -179,15 +415,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     _log(f"router on http://{host}:{port}, {len(slots)} replica(s)")
 
     stop = threading.Event()
+    #: SIGHUP arrivals (handler does a GIL-atomic increment only —
+    #: threadlint signal-handler-unsafe); the monitor loop compares
+    #: against its consumed count and starts the roll itself.
+    hup = {"count": 0, "seen": 0}
 
     def _term(signum, frame):
         stop.set()
 
+    def _hup(signum, frame):
+        hup["count"] += 1
+
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
+    signal.signal(signal.SIGHUP, _hup)
 
     try:
-        _monitor(slots, router, args, stop)
+        _monitor(slots, router, args, stop, hup)
     finally:
         fleet.stop()
         _drain(slots, args.drain_timeout_s)
@@ -202,11 +446,75 @@ def main(argv: Optional[List[str]] = None) -> int:
     return 0 if live_slots else 1
 
 
+def _probe_replica(slot: "ReplicaSlot") -> Tuple[bool, Dict[str, int]]:
+    """Poll one replica's /healthz/ready directly: (ready, versions).
+    The rollout's convergence check — the router's registry alone is not
+    enough (its prober can lag a probe interval)."""
+    from seist_tpu.serve.router import _http_request
+
+    try:
+        status, _, body = _http_request(
+            slot.url, "GET", "/healthz/ready", timeout_s=2.0
+        )
+    except Exception:  # noqa: BLE001 — a dead/warming replica is "not yet"
+        return False, {}
+    try:
+        payload = json.loads(body.decode())
+    except (ValueError, UnicodeDecodeError):
+        payload = {}
+    versions = (
+        payload.get("versions") if isinstance(payload, dict) else None
+    )
+    return status == 200, versions if isinstance(versions, dict) else {}
+
+
+def _read_rollout_spec(path: str) -> Optional[dict]:
+    if not path:
+        _log("SIGHUP but no --rollout-file configured; ignoring")
+        return None
+    try:
+        with open(path) as f:
+            spec = json.load(f)
+    except (OSError, ValueError) as e:
+        _log(f"rollout file {path!r} unreadable: {e!r}; ignoring SIGHUP")
+        return None
+    if not isinstance(spec, dict) or "version" not in spec:
+        _log(f"rollout file {path!r} needs {{'version': N}}; ignoring")
+        return None
+    return spec
+
+
 def _monitor(
-    slots: List["ReplicaSlot"], router, args, stop: threading.Event
+    slots: List["ReplicaSlot"], router, args, stop: threading.Event,
+    hup: Optional[Dict[str, int]] = None,
 ) -> None:
-    """Poll replica processes; restart / retire per the exit contract."""
+    """Poll replica processes; restart / retire per the exit contract.
+    Also advances an in-flight rolling restart (SIGHUP + --rollout-file)
+    one state-machine tick per loop — crash handling for the REST of the
+    fleet keeps running mid-roll."""
+    rollout: Optional[FleetRollout] = None
     while not stop.is_set():
+        if hup is not None and hup["count"] > hup["seen"]:
+            hup["seen"] = hup["count"]
+            if rollout is not None and not rollout.done:
+                _log("SIGHUP during an active rollout; ignoring")
+            else:
+                spec = _read_rollout_spec(args.rollout_file)
+                if spec is not None:
+                    rollout = FleetRollout(
+                        slots,
+                        version=spec["version"],
+                        checkpoint=spec.get("checkpoint"),
+                        cmd=spec.get("cmd"),
+                        subset=spec.get("replicas"),
+                        ready_timeout_s=args.rollout_ready_timeout_s,
+                    )
+                    _log(
+                        f"rollout started: version {rollout.version} over "
+                        f"{len(rollout.queue)} replica(s), one at a time"
+                    )
+        if rollout is not None and not rollout.done:
+            rollout.advance(router.registry, _probe_replica)
         active = 0
         for slot in slots:
             if slot.retired:
