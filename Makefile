@@ -5,7 +5,8 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
 .PHONY: native test t1 lint lint-baseline irlint-report lockgraph \
-	serve-smoke serve-chaos obs-smoke trace-smoke rollout-smoke chaos clean
+	serve-smoke serve-chaos obs-smoke trace-smoke rollout-smoke chaos \
+	pack-smoke bench-loader clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -71,6 +72,21 @@ t1:
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'chaos or faults' \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Packed data-plane smoke (docs/DATA.md): 2-worker shard-parallel pack
+# of the synthetic dataset (cross-checked bit-identical against a serial
+# pack), then 2 training epochs on packed vs unpacked at the same seed
+# with the loss curves pinned equal. One JSON verdict line; non-zero on
+# any parity failure.
+pack-smoke:
+	JAX_PLATFORMS=cpu python -m tools.pack_smoke
+
+# Packed-ingest throughput ladder (docs/DATA.md "Benchmarks"): hdf5
+# per-sample reads vs packed per-sample reads vs packed+direct-ingest
+# batch fills on one shared fixture, with the per-stage ms/wf budget.
+# Gate: direct >= 2x hdf5. The committed headline is BENCH_loader_r01.json.
+bench-loader:
+	JAX_PLATFORMS=cpu python -m tools.bench_loader --compare
 
 # Telemetry-plane smoke (docs/OBSERVABILITY.md): 2-step CPU train run
 # with --metrics-port, live Prometheus/JSON/flight scrape, then an

@@ -135,6 +135,15 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "epoch store. 0 = auto: half the device "
                         "bytes_limit, or 4 GiB when the backend reports "
                         "no memory stats")
+    parser.add_argument("--ingest", default="auto", type=str,
+                        choices=["auto", "direct", "host"],
+                        help="raw-row feed for the device-aug step path "
+                        "(docs/DATA.md). 'auto': direct shard->staging->"
+                        "device ingest whenever the dataset is packed "
+                        "(no Event decode, no resident waveform upload); "
+                        "'host': always upload a resident RawStore; "
+                        "'direct': demand the fast path, error instead "
+                        "of degrading. Default auto")
 
     # Random seed
     parser.add_argument("--seed", default=0, type=int)
@@ -155,6 +164,16 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--data-split", type=bool_, default=True)
     parser.add_argument("--train-size", type=float, default=0.8)
     parser.add_argument("--val-size", type=float, default=0.1)
+    parser.add_argument("--mixture-temperature", default=0.0, type=float,
+                        dest="mixture_temperature",
+                        help="temperature-weighted TRAIN sampling over a "
+                        "multi-source packed mixture (pack_dataset.py "
+                        "--mixture): per epoch slot, source s is drawn "
+                        "with p ∝ (n_s/N)^(1/T) — 1.0 = proportional, "
+                        "higher = flatter across sources. Deterministic "
+                        "under the (seed, epoch, start_batch) resume "
+                        "contract; 0 disables (plain global shuffle). "
+                        "Eval/test always walk their splits plainly")
 
     # Data loader
     parser.add_argument("--shuffle", type=bool_, default=True)

@@ -839,12 +839,17 @@ def select_device_aug_mode(
     est_bytes: int,
     budget_bytes: int,
     reasons: Sequence[str],
-    multi_process: bool = False,
 ) -> Tuple[str, str]:
     """Resolve the effective --device-aug mode with automatic fallback:
     unsupported config -> 'off' (host path); 'cached' over the HBM budget
-    or on a multi-host run -> 'step' (device aug, host-fed raw rows).
-    Returns (mode, reason)."""
+    -> 'step' (device aug, host-fed raw rows). Returns (mode, reason).
+
+    Multi-host runs no longer force the step fallback: the cache places
+    each host's addressable sample-axis slices itself
+    (``pipeline.DeviceEpochCache``) and the epoch index stream is
+    host-sharded under the same deterministic global shard contract as
+    the host Loader (``epoch_index_chunks(num_shards=, shard_index=)``)
+    — the invariant the old fallback existed to protect."""
     if requested not in ("off", "step", "cached"):
         raise ValueError(f"--device-aug must be off|step|cached, got '{requested}'")
     if requested == "off":
@@ -852,8 +857,6 @@ def select_device_aug_mode(
     if reasons:
         return "off", "unsupported by device pipeline: " + "; ".join(reasons)
     if requested == "cached":
-        if multi_process:
-            return "step", "multi-host run: per-host raw-row feed instead"
         if est_bytes > budget_bytes:
             return "step", (
                 f"epoch cache ~{est_bytes / 2**20:.0f} MiB exceeds HBM "

@@ -1,0 +1,403 @@
+"""Direct shard->device ingest: packed shards -> staging batch -> HBM.
+
+The final hop of the packed data plane. The host Loader path spends its
+per-sample budget on Event-dict assembly, numpy preprocessing and
+``_stack`` batch assembly; the device-aug 'step' path already moved the
+preprocessing on-device but still pays a full :class:`RawStore` upload —
+every waveform decoded through the Event reader into a resident host
+array. On a packed dataset BOTH costs are artifacts of the format
+conversion: the shard file *is* the contiguous float32 batch source.
+
+:class:`PackedRawStore` therefore feeds the device-aug step path straight
+from the shards:
+
+* **build** is metadata-only — phases/labels come from the columnar
+  index (the same ``host_prepare`` row contract, vectorized over the
+  index; parity is test-pinned against ``RawStore.build``), no waveform
+  is decoded and host RAM stays O(index), not O(dataset);
+* **row_batch_at** slices each sample's bytes out of the per-shard
+  ``np.memmap`` directly into a preallocated staging batch — ONE memcpy
+  per sample from page cache to the slab ``prefetch_raw_to_device``
+  hands to ``jax.device_put``; no per-sample Event dict, no ``_stack``,
+  no intermediate numpy copies;
+* **io_guard parity** — every row fill runs the same fault ladder as the
+  HDF5 readers (data/io_guard.py): transient ``OSError`` retried with
+  the memmap re-mapped, short reads / NaN-poisoned waveforms / injected
+  ``SEIST_FAULT_IO_*`` faults quarantined and deterministically replaced
+  via the dataset's shared :class:`~seist_tpu.data.io_guard.Quarantine`
+  (fallbacks keyed ``(seed, epoch, logical idx)`` — resume-stable), so
+  the worker's epoch-end quarantine report covers this path too;
+* **accounting** — ``data_ingest_batches/samples/bytes`` counters and a
+  ``data_ingest_fill`` span on the bus; the bounded prefetch queue's
+  backpressure lands in ``data_ingest_backpressure_s`` (pipeline.py).
+
+Staging reuse: on accelerator backends ``device_put`` always copies
+host->HBM, so a small ring of staging slabs is recycled. On the CPU
+backend jax may *alias* host memory into the device array, so reuse is
+disabled there (a recycled slab would corrupt an in-flight batch) —
+``SEIST_INGEST_REUSE_STAGING=0/1`` overrides the auto choice.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from seist_tpu import taskspec
+from seist_tpu.data import io_guard
+from seist_tpu.data.packed import PackedDataset, read_waveform_slice
+from seist_tpu.data.pipeline import RawStore, SeismicDataset
+from seist_tpu.data.preprocess import pad_phases
+
+# Invalid phase-slot sentinel — MUST match device_aug._BIG (the device
+# kernels treat it as "no phase"); re-declared to keep this module free
+# of the jax import device_aug pulls.
+_BIG = 2**30
+
+_SCALAR = ("ppks", "spks", "emg", "smg", "pmp", "clr", "baz", "dis")
+
+
+def packed_dataset_of(sds: SeismicDataset) -> Optional[PackedDataset]:
+    """The underlying :class:`PackedDataset` when ``sds`` reads packed
+    shards, else None — the direct-ingest eligibility check."""
+    ds = getattr(sds, "_dataset", None)
+    return ds if isinstance(ds, PackedDataset) else None
+
+
+class PackedRawStore(RawStore):
+    """A :class:`RawStore` whose waveforms stay on disk: the small
+    per-sample arrays (phases, values, onehots) are resident, the
+    ``data`` rows are filled per batch straight from the shard memmaps.
+    Duck-compatible with ``pipeline.iter_raw_batches`` /
+    ``prefetch_raw_to_device`` / the device-aug step train path."""
+
+    def __init__(
+        self,
+        arrays: Dict[str, Any],
+        *,
+        n_raw: int,
+        augmentation: bool,
+        raw_len: int,
+        phase_slots: int,
+        n_ch: int,
+        data_dir: str,
+        shards: np.ndarray,
+        offsets: np.ndarray,
+        seed: int,
+        quarantine: io_guard.Quarantine,
+        injector=None,
+        batch_size: int = 0,
+        prefetch: int = 2,
+        reuse_staging: Optional[bool] = None,
+    ) -> None:
+        super().__init__(
+            arrays,
+            n_raw=n_raw,
+            augmentation=augmentation,
+            raw_len=raw_len,
+            phase_slots=phase_slots,
+        )
+        self.n_ch = int(n_ch)
+        self.row_nbytes = self.n_ch * self.raw_len * 4
+        self._data_dir = data_dir
+        self._shards = np.asarray(shards, np.int64)
+        self._offsets = np.asarray(offsets, np.int64)
+        self._seed = int(seed)
+        self._quarantine = quarantine
+        self._injector = injector
+        self._injector_enabled = bool(getattr(injector, "enabled", False))
+        self._mmaps: Dict[int, np.memmap] = {}
+        if reuse_staging is None:
+            env = os.environ.get("SEIST_INGEST_REUSE_STAGING", "auto")
+            if env in ("0", "1"):
+                reuse_staging = env == "1"
+            else:
+                import jax
+
+                # CPU device_put may alias host memory into the device
+                # array; recycling the slab would then corrupt the batch
+                # still referenced by the in-flight step.
+                reuse_staging = jax.default_backend() != "cpu"
+        self._reuse = bool(reuse_staging) and batch_size > 0
+        self._batch_size = int(batch_size)
+        self._ring: List[np.ndarray] = (
+            [
+                np.empty(
+                    (self._batch_size, self.n_ch, self.raw_len), np.float32
+                )
+                # one slab filling + `prefetch` queued + one in the step
+                for _ in range(prefetch + 2)
+            ]
+            if self._reuse
+            else []
+        )
+        self._ring_i = 0
+        from seist_tpu.obs.bus import BUS
+
+        self._c_batches = BUS.counter("data_ingest_batches")
+        self._c_samples = BUS.counter("data_ingest_samples")
+        self._c_bytes = BUS.counter("data_ingest_bytes")
+
+    # ------------------------------------------------------------- build
+    @classmethod
+    def build(
+        cls,
+        sds: SeismicDataset,
+        *,
+        batch_size: int = 0,
+        prefetch: int = 2,
+        reuse_staging: Optional[bool] = None,
+    ) -> "PackedRawStore":
+        """Metadata-only construction from a packed-backed
+        :class:`SeismicDataset`. Mirrors ``RawStore.build``'s row
+        contract (``host_prepare``) and its refusal semantics — every
+        refusal raises ``ValueError`` so the worker falls back to the
+        host path. No waveform is read."""
+        ds = packed_dataset_of(sds)
+        if ds is None:
+            raise ValueError(
+                "direct ingest requires a packed dataset "
+                "(--dataset-name packed; see docs/DATA.md)"
+            )
+        pre = sds.preprocessor
+        frame = ds._meta_data
+        n = len(ds)
+        if n == 0:
+            raise ValueError("empty packed split")
+        col = {c: frame[c].to_numpy() for c in frame.columns}
+        n_ch_col, n_samp_col = col["n_ch"], col["n_samp"]
+        if (n_ch_col != n_ch_col[0]).any() or (
+            n_samp_col != n_samp_col[0]
+        ).any():
+            raise ValueError(
+                "direct ingest needs uniform raw trace shapes; this pack "
+                "mixes them"
+            )
+        n_ch, raw_len = int(n_ch_col[0]), int(n_samp_col[0])
+
+        names = taskspec.flatten_io_names(
+            sds.input_names + sds.label_names
+        )
+        value_names = sorted(
+            {m for m in names if taskspec.get_kind(m) == taskspec.VALUE}
+        )
+        onehot_names = sorted(
+            {m for m in names if taskspec.get_kind(m) == taskspec.ONEHOT}
+        )
+
+        snr = np.stack(
+            [col["snr_0"], col["snr_1"], col["snr_2"]], axis=1
+        )
+        # data only feeds _is_noise's shape check; one zero-size proxy
+        # with the right trailing dim serves every row.
+        shape_proxy = np.empty((0, raw_len), np.float32)
+
+        def row_phases(i):
+            p, s = col["ppks"][i], col["spks"][i]
+            ppks = [] if p != p else [int(p)]
+            spks = [] if s != s else [int(s)]
+            if pre._is_noise(shape_proxy, ppks, spks, snr[i]):
+                return [], [], True
+            pp, ss = pad_phases(
+                ppks, spks, pre.min_event_gap, pre.in_samples
+            )
+            return pp, ss, False
+
+        # One pass over the metadata (it IS the build cost here — there
+        # is no per-sample decode to hide behind); phase_slots is sized
+        # from the cached results exactly like RawStore.build.
+        phases = [row_phases(i) for i in range(n)]
+        max_phases = max(
+            [1]
+            + [max(len(pp), len(ss)) for pp, ss, noise in phases if not noise]
+        )
+        phase_slots = max(max_phases, pre._max_event_num)
+
+        arrays: Dict[str, Any] = {
+            "ppks": np.full((n, phase_slots), _BIG, np.int32),
+            "np_p": np.empty((n,), np.int32),
+            "spks": np.full((n, phase_slots), _BIG, np.int32),
+            "np_s": np.empty((n,), np.int32),
+        }
+        vals = {m: np.zeros((n, 1), np.float32) for m in value_names}
+        oh = {m: np.zeros((n,), np.int32) for m in onehot_names}
+        for i, (pp, ss, is_noise) in enumerate(phases):
+            arrays["ppks"][i, : len(pp)] = pp
+            arrays["np_p"][i] = len(pp)
+            arrays["spks"][i, : len(ss)] = ss
+            arrays["np_s"][i] = len(ss)
+            if is_noise and (value_names or onehot_names):
+                # Same refusal as RawStore.build: never fabricate
+                # VALUE/ONEHOT labels for a noise-classified trace.
+                raise ValueError(
+                    f"sample {i} is noise-classified but the task has "
+                    f"VALUE/ONEHOT labels "
+                    f"({value_names + onehot_names}); the device path "
+                    "will not fabricate label values for it"
+                )
+            for m in value_names:
+                v = col[m][i]
+                if v != v:  # NaN = absent; host path crashes at stacking
+                    raise ValueError(
+                        f"sample {i} has no '{m}' value; refusing to "
+                        "fabricate a device-path label"
+                    )
+                vals[m][i] = np.float32(v)
+            for m in onehot_names:
+                v = col[m][i]
+                if v != v:
+                    raise ValueError(
+                        f"sample {i} has no '{m}' class; refusing to "
+                        "fabricate a device-path label"
+                    )
+                oh[m][i] = int(v)
+        if value_names:
+            arrays["values"] = vals
+        if onehot_names:
+            arrays["onehots"] = oh
+        return cls(
+            arrays,
+            n_raw=n,
+            augmentation=sds.augmentation,
+            raw_len=raw_len,
+            phase_slots=phase_slots,
+            n_ch=n_ch,
+            data_dir=ds._data_dir,
+            shards=col["shard"],
+            offsets=col["offset"],
+            seed=sds._seed,
+            quarantine=sds.quarantine,
+            injector=sds.io_faults,
+            batch_size=batch_size,
+            prefetch=prefetch,
+            reuse_staging=reuse_staging,
+        )
+
+    # ---------------------------------------------------------- raw read
+    def _read_into(self, out: np.ndarray, r: int, validate: bool) -> None:
+        """Fill ``out`` (C, L) with raw sample ``r`` — the one memcpy of
+        the fast path. Fault classification (transient OSError with
+        memmap evict vs permanent short-read corruption) is the shared
+        :func:`~seist_tpu.data.packed.read_waveform_slice` ladder; a
+        non-finite waveform is permanent corruption too."""
+        raw = read_waveform_slice(
+            self._mmaps,
+            self._data_dir,
+            int(self._shards[r]),
+            int(self._offsets[r]),
+            self.row_nbytes,
+            desc=f"packed.direct (sample {r})",
+        )
+        out[...] = np.frombuffer(raw, np.float32).reshape(
+            self.n_ch, self.raw_len
+        )
+        if validate and not np.isfinite(out).all():
+            bad = int(out.size - np.isfinite(out).sum())
+            raise io_guard.CorruptSampleError(
+                f"packed.direct: sample {r} has {bad} non-finite value(s)"
+            )
+
+    def _fill_row(self, out: np.ndarray, raw: int, *, epoch: int, key: int) -> int:
+        """Guarded fill of one staging row; returns the index actually
+        read (== ``raw`` unless a quarantine fallback replaced it) so the
+        caller gathers the matching phase/label rows."""
+        if not io_guard.enabled():
+            self._read_into(out, raw, validate=False)
+            return raw
+        if not (self._quarantine.active or self._injector_enabled):
+            try:
+                self._read_into(out, raw, validate=True)
+                io_guard.COUNTERS.inc("reads")
+                return raw
+            except (OSError, io_guard.CorruptSampleError):
+                pass  # enter the retrying/quarantining ladder below
+        for cand in self._quarantine.candidates(
+            raw, seed=self._seed, epoch=epoch, idx=key
+        ):
+            try:
+                io_guard.read_with_retry(
+                    lambda c=cand: self._read_into(out, c, validate=True),
+                    desc=f"packed.direct[{cand}]",
+                    fault_key=cand,
+                    injector=self._injector,
+                )
+                if self._injector is not None and self._injector.is_corrupt(
+                    cand
+                ):
+                    raise io_guard.CorruptSampleError(
+                        f"[faults] injected corrupt sample {cand}"
+                    )
+            except io_guard.CorruptSampleError as e:
+                self._quarantine.add(cand, repr(e))
+                continue
+            if cand != raw:
+                io_guard.COUNTERS.inc("fallback_reads")
+            return cand
+        raise io_guard.CorruptSampleError(
+            f"no clean fallback found for packed sample {raw} "
+            f"(quarantined: {len(self._quarantine)}/{self.n_raw})"
+        )
+
+    # --------------------------------------------------------- batch fill
+    def _staging(self, batch: int) -> np.ndarray:
+        if not self._reuse:
+            return np.empty((batch, self.n_ch, self.raw_len), np.float32)
+        buf = self._ring[self._ring_i]
+        self._ring_i = (self._ring_i + 1) % len(self._ring)
+        return buf[:batch]
+
+    def row_batch_at(
+        self,
+        raw_idx: np.ndarray,
+        *,
+        epoch: int = 0,
+        idx: Optional[np.ndarray] = None,
+    ) -> Dict[str, Any]:
+        """Fill one staging batch straight from the shards and gather the
+        matching resident rows. ``idx`` (the logical epoch indices) keys
+        quarantine fallbacks exactly like the host path."""
+        import jax
+
+        from seist_tpu.obs.bus import BUS
+
+        raw_idx = np.asarray(raw_idx)
+        batch = int(raw_idx.shape[0])
+        if self._reuse and batch > self._batch_size:
+            raise ValueError(
+                f"batch {batch} exceeds the staging ring's {self._batch_size}"
+            )
+        buf = self._staging(batch)
+        actual = np.empty(batch, np.int64)
+        with BUS.span("data_ingest_fill"):
+            for j in range(batch):
+                key = int(idx[j]) if idx is not None else int(raw_idx[j])
+                actual[j] = self._fill_row(
+                    buf[j], int(raw_idx[j]), epoch=int(epoch), key=key
+                )
+        rows = jax.tree.map(lambda a: a[actual], self.arrays)
+        rows["data"] = buf
+        self._c_batches.inc()
+        self._c_samples.inc(batch)
+        self._c_bytes.inc(batch * self.row_nbytes)
+        return rows
+
+    def row_batch(self, raw_idx: np.ndarray) -> Dict[str, Any]:
+        return self.row_batch_at(raw_idx)
+
+    @property
+    def disk_bytes(self) -> int:
+        """Waveform bytes that STAY on disk (the RawStore would hold
+        these resident)."""
+        return int(self.n_raw) * self.row_nbytes
+
+
+def describe(store: PackedRawStore) -> str:
+    return (
+        f"packed direct ingest: {store.n_raw} samples, "
+        f"{store.disk_bytes / 2**20:.1f} MiB on-disk waveforms, "
+        f"{store.nbytes / 2**20:.2f} MiB resident metadata, "
+        f"staging {'ring' if store._reuse else 'per-batch'} "
+        f"({store.n_ch}x{store.raw_len} f32 rows)"
+    )

@@ -12,11 +12,31 @@ The repack trades that per-sample API cost for ONE seek-free slice:
 * ``shard_XXXXX.bin`` — raw float32 C-order ``(C, L)`` waveforms,
   concatenated. Served through a per-process ``np.memmap`` (page-cache
   backed, zero-copy until the training-path ``.astype`` copy).
-* ``index.npz`` — columnar metadata: per-sample shard id, byte offset,
-  shape, and every Event label field (NaN = absent), loaded once into
-  the pandas frame that :class:`~seist_tpu.data.base.DatasetBase`'s
-  seeded shuffle-then-contiguous-split already operates on.
-* ``meta.json`` — source dataset name, channels, sampling rate, count.
+* ``shard_XXXXX.idx.npz`` — the shard's columnar sidecar (per-sample
+  within-shard byte offset, shape, every Event label field, source id).
+  Written atomically AFTER the ``.bin`` — its presence is the
+  shard-complete marker the resumable packer keys on.
+* ``index.npz`` — the merged columnar metadata (sidecars + a ``shard``
+  column), loaded once into the pandas frame that
+  :class:`~seist_tpu.data.base.DatasetBase`'s seeded
+  shuffle-then-contiguous-split already operates on.
+* ``meta.json`` — source dataset name(s), channels, sampling rate,
+  counts. Written LAST: a directory without it is an incomplete pack
+  and the reader refuses it.
+
+Packing is **plan-first**: the shard partition is a pure function of the
+source sizes and ``samples_per_shard`` (derived deterministically from
+sample 0 when only ``--shard-mb`` is given), computed before any bytes
+move. That buys three properties at once:
+
+* **parallel** — workers own disjoint shard ranges; an N-worker pack is
+  bit-identical to a 1-worker pack (pinned by tests/test_packed.py);
+* **resumable** — an interrupted pack re-plans identically and skips
+  every shard whose sidecar already matches its ``.bin``;
+* **mixture** — several registered datasets pack into ONE directory
+  (sources occupy consecutive shard ranges; every index row carries a
+  ``source_id`` provenance column) for temperature-weighted joint
+  training (``pipeline.mixture_epoch_indices``, arXiv:2203.17189).
 
 ``pack_dataset`` converts ANY registered dataset (constructed with
 ``data_split=False, shuffle=False`` so the pack order is the source
@@ -31,9 +51,11 @@ datasets/*.py); the packer asserts that and stores scalar-or-NaN.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import zipfile
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pandas as pd
@@ -45,6 +67,7 @@ from seist_tpu.utils.logger import logger
 
 _INDEX = "index.npz"
 _META = "meta.json"
+_SIDECAR_SUFFIX = ".idx.npz"
 
 # Event fields packed as scalar-or-NaN columns, in a fixed order.
 # ppks/spks are sample indices (int at heart, float for the NaN), the
@@ -52,99 +75,460 @@ _META = "meta.json"
 _SCALAR_FIELDS = ("ppks", "spks", "emg", "smg", "pmp", "clr", "baz", "dis")
 _INT_FIELDS = frozenset({"ppks", "spks", "pmp", "clr"})
 
+# Sidecar/index column dtypes (keys excluded; they stay str).
+_INT_COLS = (
+    "shard", "offset", "n_ch", "n_samp", "source_id",
+    "total_bytes", "plan_lo", "plan_hi",
+)
+# Per-shard bookkeeping columns that never reach the merged index.
+_SIDECAR_ONLY = ("total_bytes", "plan_lo", "plan_hi")
+
+
+def shard_path(out_dir: str, shard_id: int) -> str:
+    return os.path.join(out_dir, f"shard_{shard_id:05d}.bin")
+
+
+def sidecar_path(out_dir: str, shard_id: int) -> str:
+    return shard_path(out_dir, shard_id) + _SIDECAR_SUFFIX
+
+
+# ------------------------------------------------------------------- planning
+@dataclasses.dataclass(frozen=True)
+class ShardPlan:
+    """One shard's assignment: source ``source_id``'s samples
+    ``[lo, hi)`` (source-local indices, source metadata order)."""
+
+    shard_id: int
+    source_id: int
+    lo: int
+    hi: int
+
+    @property
+    def n(self) -> int:
+        return self.hi - self.lo
+
+
+def _samples_per_shard(sample_nbytes: int, shard_mb: float) -> int:
+    """Deterministic shard capacity from a byte budget: how many sample-0
+    sized waveforms fit in ``shard_mb`` (matches the v1 rollover rule for
+    uniform-size datasets — every current dataset decodes fixed-length
+    traces)."""
+    return max(1, int(shard_mb * 1_000_000) // max(int(sample_nbytes), 1))
+
+
+def plan_shards(
+    sources: Sequence[Any],
+    *,
+    samples_per_shard: Optional[int] = None,
+    shard_mb: float = 512,
+) -> Tuple[List[ShardPlan], List[int]]:
+    """The deterministic shard partition: a pure function of the source
+    lengths and the capacity knobs — NEVER of worker count or of which
+    shards already exist. Returns ``(plans, per-source capacities)``.
+
+    Sources occupy consecutive shard-id ranges (shards never span
+    sources: provenance stays a per-shard constant and workers can own
+    contiguous per-source sample ranges). With only ``shard_mb`` given,
+    capacity derives PER SOURCE from that source's sample-0 nbytes —
+    mixture sources with different trace lengths each honor the byte
+    budget; reading one sample per source is the only data the plan
+    ever touches."""
+    caps: List[int] = []
+    for src in sources:
+        if samples_per_shard is not None:
+            caps.append(max(1, int(samples_per_shard)))
+            continue
+        event0, _ = src[0]
+        nbytes0 = np.ascontiguousarray(
+            event0["data"], dtype=np.float32
+        ).nbytes
+        caps.append(_samples_per_shard(nbytes0, shard_mb))
+    plans: List[ShardPlan] = []
+    shard_id = 0
+    for source_id, src in enumerate(sources):
+        n = len(src)
+        sps = caps[source_id]
+        for lo in range(0, n, sps):
+            plans.append(
+                ShardPlan(shard_id, source_id, lo, min(lo + sps, n))
+            )
+            shard_id += 1
+    return plans, caps
+
+
+# ---------------------------------------------------------------- shard write
+def _new_cols() -> Dict[str, list]:
+    return {
+        **{f: [] for f in _SCALAR_FIELDS},
+        "snr_0": [],
+        "snr_1": [],
+        "snr_2": [],
+        "offset": [],
+        "n_ch": [],
+        "n_samp": [],
+        "key": [],
+    }
+
+
+def _append_sample(cols: Dict[str, list], event: Event, row: Any, i: int) -> None:
+    for f in _SCALAR_FIELDS:
+        v = event.get(f, [])
+        if len(v) > 1:
+            raise ValueError(
+                f"event {i}: field {f} has {len(v)} values; the "
+                "packed format stores one event per window"
+            )
+        cols[f].append(float(v[0]) if len(v) else np.nan)
+    snr = np.asarray(event.get("snr", []), dtype=np.float64).ravel()
+    for c in range(3):
+        cols[f"snr_{c}"].append(float(snr[c]) if c < snr.size else np.nan)
+    cols["key"].append(str(row.get("key", i)) if isinstance(row, dict) else str(i))
+
+
+def _col_array(name: str, values: list) -> np.ndarray:
+    if name in _INT_COLS:
+        return np.asarray(values, np.int64)
+    if name == "key":
+        return np.asarray(values, str)
+    return np.asarray(values, np.float64)
+
+
+def _write_atomic_npz(path: str, cols: Dict[str, Any]) -> None:
+    # Suffix .npz so np.savez doesn't append one of its own.
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **{k: _col_array(k, v) for k, v in cols.items()})
+    os.replace(tmp, path)
+
+
+def pack_shard(src, out_dir: str, plan: ShardPlan) -> Dict[str, int]:
+    """Pack ONE shard: the plan's sample range streamed into
+    ``shard_XXXXX.bin`` (via a ``.tmp`` rename) followed by its sidecar —
+    the sidecar rename is the shard-complete commit point, so a kill at
+    any instant leaves either a complete shard or a resumable hole."""
+    cols = _new_cols()
+    total = 0
+    bin_path = shard_path(out_dir, plan.shard_id)
+    tmp_bin = bin_path + ".tmp"
+    try:
+        with open(tmp_bin, "wb") as f:
+            for j in range(plan.lo, plan.hi):
+                event, row = src[j]
+                data = np.ascontiguousarray(event["data"], dtype=np.float32)
+                if data.ndim != 2:
+                    raise ValueError(
+                        f"event {j}: data must be (C, L), got {data.shape}"
+                    )
+                f.write(data.tobytes())
+                _append_sample(cols, event, row, j)
+                cols["offset"].append(total)
+                cols["n_ch"].append(data.shape[0])
+                cols["n_samp"].append(data.shape[1])
+                total += data.nbytes
+    except BaseException:
+        # A failed/interrupted shard must not leave a .tmp that a later
+        # resume could mistake for progress (it can't — only the sidecar
+        # commits a shard — but don't litter the pack dir either).
+        try:
+            os.unlink(tmp_bin)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp_bin, bin_path)
+    cols["source_id"] = [plan.source_id] * plan.n
+    cols["total_bytes"] = [total]
+    # Plan identity: lets shard_complete refuse a resume whose re-plan
+    # assigns this shard a different sample range (source count/order or
+    # capacity knobs changed). NOTE an in-place content change of the
+    # SOURCE with identical sizes is undetectable without re-reading it
+    # — resume assumes immutable sources; use --no-resume after editing
+    # a source in place (docs/DATA.md).
+    cols["plan_lo"] = [plan.lo]
+    cols["plan_hi"] = [plan.hi]
+    _write_atomic_npz(sidecar_path(out_dir, plan.shard_id), cols)
+    return {"samples": plan.n, "bytes": total}
+
+
+def shard_complete(out_dir: str, plan: ShardPlan) -> bool:
+    """A shard is complete iff its sidecar exists, describes the plan's
+    sample count, and the ``.bin`` on disk has exactly the byte length
+    the sidecar recorded (a truncated bin from a crashed ``os.replace``
+    window or a re-plan with different capacity both fail this)."""
+    side = sidecar_path(out_dir, plan.shard_id)
+    bin_p = shard_path(out_dir, plan.shard_id)
+    if not (os.path.exists(side) and os.path.exists(bin_p)):
+        return False
+    try:
+        with np.load(side, allow_pickle=False) as z:
+            total = int(z["total_bytes"][0])
+            n = int(z["offset"].shape[0])
+            source_id = int(z["source_id"][0]) if n else plan.source_id
+            lo = int(z["plan_lo"][0])
+            hi = int(z["plan_hi"][0])
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+        # A torn/garbled sidecar (np.load raises BadZipFile), or one
+        # from a pre-plan-identity pack, is just an incomplete shard:
+        # repack it.
+        return False
+    return (
+        n == plan.n
+        and source_id == plan.source_id
+        and (lo, hi) == (plan.lo, plan.hi)
+        and os.path.getsize(bin_p) == total
+    )
+
+
+# --------------------------------------------------------------- orchestration
+@dataclasses.dataclass
+class PackSource:
+    """One pack input: either a live dataset instance or a registered
+    dataset spec (name + data_dir + kwargs) that every pack worker can
+    construct for itself. Spec-based sources are what the CLI builds;
+    live instances serve in-process callers and tests."""
+
+    name: str = ""
+    data_dir: str = ""
+    dataset_kwargs: Optional[dict] = None
+    dataset: Any = None
+
+    def create(self) -> Any:
+        if self.dataset is not None:
+            return self.dataset
+        from seist_tpu.registry import DATASETS
+
+        # Pack order must be the source metadata order: no shuffle, no
+        # split (the packed reader applies the standard seeded
+        # shuffle/split itself — same seed => same split as the source).
+        self.dataset = DATASETS.create(
+            self.name,
+            seed=0,
+            mode="train",
+            data_dir=self.data_dir,
+            shuffle=False,
+            data_split=False,
+            **(self.dataset_kwargs or {}),
+        )
+        return self.dataset
+
+
+_POOL_SOURCES: Optional[List[Any]] = None
+
+
+def _pack_pool_init(sources: List[PackSource]) -> None:
+    global _POOL_SOURCES
+    import seist_tpu.data  # noqa: F401  (dataset registrations)
+
+    _POOL_SOURCES = [s.create() for s in sources]
+
+
+def _pack_pool_shard(job: Tuple[str, ShardPlan]) -> Dict[str, int]:
+    out_dir, plan = job
+    return pack_shard(_POOL_SOURCES[plan.source_id], out_dir, plan)
+
+
+def merge_index(
+    out_dir: str, plans: Sequence[ShardPlan]
+) -> Dict[str, np.ndarray]:
+    """Concatenate every sidecar (in shard order) into ``index.npz``
+    with the per-row ``shard`` column added. Returns the merged columns."""
+    merged: Dict[str, List[Any]] = {}
+    for plan in plans:
+        with np.load(
+            sidecar_path(out_dir, plan.shard_id), allow_pickle=False
+        ) as z:
+            for k in z.files:
+                if k in _SIDECAR_ONLY:
+                    continue
+                merged.setdefault(k, []).append(z[k])
+            merged.setdefault("shard", []).append(
+                np.full(plan.n, plan.shard_id, np.int64)
+            )
+    arrays = {k: np.concatenate(v) for k, v in merged.items()}
+    _write_atomic_npz(os.path.join(out_dir, _INDEX), arrays)
+    return arrays
+
+
+def pack_sources(
+    sources: Sequence[PackSource],
+    out_dir: str,
+    *,
+    num_workers: int = 0,
+    samples_per_shard: Optional[int] = None,
+    shard_mb: float = 512,
+    resume: bool = True,
+) -> Dict[str, Any]:
+    """Pack one or more sources into ``out_dir`` (the parallel,
+    resumable, mixture-capable path behind both :func:`pack_dataset` and
+    ``python -m tools.pack_dataset``). Returns the stats dict the CLI
+    prints as its JSON verdict."""
+    from seist_tpu.obs.bus import monotonic
+
+    t0 = monotonic()
+    os.makedirs(out_dir, exist_ok=True)
+    datasets = [s.create() for s in sources]
+    channels = list(datasets[0].channels())
+    fs = int(datasets[0].sampling_rate())
+    for ds in datasets[1:]:
+        if list(ds.channels()) != channels or int(ds.sampling_rate()) != fs:
+            raise ValueError(
+                "mixture sources must share channels and sampling rate: "
+                f"{ds.name()} has ({ds.channels()}, {ds.sampling_rate()}) "
+                f"vs ({channels}, {fs})"
+            )
+    plans, caps = plan_shards(
+        datasets, samples_per_shard=samples_per_shard, shard_mb=shard_mb
+    )
+    todo = [
+        p for p in plans if not (resume and shard_complete(out_dir, p))
+    ]
+    skipped = len(plans) - len(todo)
+    if skipped:
+        logger.info(
+            f"pack resume: {skipped}/{len(plans)} shard(s) already "
+            f"complete in {out_dir}; packing the remaining {len(todo)}"
+        )
+
+    stats = {"samples": 0, "bytes": 0}
+    if todo:
+        if num_workers and num_workers > 1:
+            import multiprocessing
+            from concurrent.futures import ProcessPoolExecutor
+
+            # forkserver/spawn, never fork: pack may run inside a
+            # JAX-initialized parent (pipeline.py has the full rationale).
+            try:
+                ctx = multiprocessing.get_context("forkserver")
+            except ValueError:
+                ctx = multiprocessing.get_context("spawn")
+            # Spec-based sources are shipped as specs (workers rebuild
+            # them), not as the parent's live instances — a live reader
+            # can hold unpicklable/expensive state (e.g. a PackedDataset
+            # source's cached memmap pickles as the whole shard).
+            ship = [
+                dataclasses.replace(s, dataset=None) if s.name else s
+                for s in sources
+            ]
+            with ProcessPoolExecutor(
+                max_workers=num_workers,
+                mp_context=ctx,
+                initializer=_pack_pool_init,
+                initargs=(ship,),
+            ) as pool:
+                for out in pool.map(
+                    _pack_pool_shard, [(out_dir, p) for p in todo]
+                ):
+                    stats["samples"] += out["samples"]
+                    stats["bytes"] += out["bytes"]
+        else:
+            for plan in todo:
+                out = pack_shard(datasets[plan.source_id], out_dir, plan)
+                stats["samples"] += out["samples"]
+                stats["bytes"] += out["bytes"]
+
+    arrays = merge_index(out_dir, plans)
+    n_total = int(arrays["offset"].shape[0])
+    meta = {
+        "source": (
+            datasets[0].name()
+            if len(datasets) == 1
+            else "mixture:" + "+".join(ds.name() for ds in datasets)
+        ),
+        "channels": channels,
+        "sampling_rate": fs,
+        "n_events": n_total,
+        "n_shards": len(plans),
+        "format_version": 2,
+        "samples_per_shard": caps[0] if len(set(caps)) == 1 else caps,
+        "sources": [
+            {
+                "source_id": sid,
+                "name": ds.name(),
+                "data_dir": getattr(sources[sid], "data_dir", ""),
+                "n_events": len(ds),
+                "samples_per_shard": caps[sid],
+            }
+            for sid, ds in enumerate(datasets)
+        ],
+    }
+    # meta.json LAST — its presence is the whole-pack commit point.
+    tmp = os.path.join(out_dir, _META + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(out_dir, _META))
+    wall_s = monotonic() - t0
+    logger.info(
+        f"packed {n_total} events into {len(plans)} shard(s) at {out_dir} "
+        f"({skipped} resumed, {wall_s:.1f}s)"
+    )
+    return {
+        "out": out_dir,
+        "shards": len(plans),
+        "shards_skipped": skipped,
+        "samples": n_total,
+        "samples_packed": stats["samples"],
+        "bytes": stats["bytes"],
+        "samples_per_shard": meta["samples_per_shard"],
+        "sources": [s["name"] for s in meta["sources"]],
+        "wall_s": round(wall_s, 2),
+    }
+
 
 def pack_dataset(
     src,
     out_dir: str,
     *,
     shard_mb: float = 512,
-    log_every: int = 20_000,
+    samples_per_shard: Optional[int] = None,
+    num_workers: int = 0,
+    log_every: int = 0,  # kept for call-site compat; progress is per shard
 ) -> str:
     """Repack ``src`` (any DatasetBase, pre-split disabled) into packed
     shards under ``out_dir``. Returns ``out_dir``."""
-    os.makedirs(out_dir, exist_ok=True)
-    shard_bytes_max = int(shard_mb * 1_000_000)
-    n = len(src)
-    cols: Dict[str, list] = {
-        **{f: [] for f in _SCALAR_FIELDS},
-        "snr_0": [],
-        "snr_1": [],
-        "snr_2": [],
-        "shard": [],
-        "offset": [],
-        "n_ch": [],
-        "n_samp": [],
-        "key": [],
-    }
-    shard_id = 0
-    shard_off = 0
-    shard_f = open(os.path.join(out_dir, f"shard_{shard_id:05d}.bin"), "wb")
-    try:
-        for i in range(n):
-            event, row = src[i]
-            data = np.ascontiguousarray(event["data"], dtype=np.float32)
-            if data.ndim != 2:
-                raise ValueError(f"event {i}: data must be (C, L), got {data.shape}")
-            if shard_off + data.nbytes > shard_bytes_max and shard_off:
-                shard_f.close()
-                shard_id += 1
-                shard_off = 0
-                shard_f = open(
-                    os.path.join(out_dir, f"shard_{shard_id:05d}.bin"), "wb"
-                )
-            shard_f.write(data.tobytes())
-            for f in _SCALAR_FIELDS:
-                v = event.get(f, [])
-                if len(v) > 1:
-                    raise ValueError(
-                        f"event {i}: field {f} has {len(v)} values; the "
-                        "packed format stores one event per window"
-                    )
-                cols[f].append(float(v[0]) if len(v) else np.nan)
-            snr = np.asarray(event.get("snr", []), dtype=np.float64).ravel()
-            for c in range(3):
-                cols[f"snr_{c}"].append(
-                    float(snr[c]) if c < snr.size else np.nan
-                )
-            cols["shard"].append(shard_id)
-            cols["offset"].append(shard_off)
-            cols["n_ch"].append(data.shape[0])
-            cols["n_samp"].append(data.shape[1])
-            cols["key"].append(str(row.get("key", i)) if isinstance(row, dict) else str(i))
-            shard_off += data.nbytes
-            if log_every and (i + 1) % log_every == 0:
-                logger.info(f"packed {i + 1}/{n} events ({shard_id + 1} shards)")
-    finally:
-        shard_f.close()
-
-    np.savez(
-        os.path.join(out_dir, _INDEX),
-        **{
-            k: np.asarray(
-                v,
-                dtype=(
-                    np.int64
-                    if k in ("shard", "offset", "n_ch", "n_samp")
-                    else (str if k == "key" else np.float64)
-                ),
-            )
-            for k, v in cols.items()
-        },
+    del log_every
+    pack_sources(
+        [PackSource(dataset=src)],
+        out_dir,
+        num_workers=num_workers,
+        samples_per_shard=samples_per_shard,
+        shard_mb=shard_mb,
     )
-    with open(os.path.join(out_dir, _META), "w") as f:
-        json.dump(
-            {
-                "source": src.name(),
-                "channels": src.channels(),
-                "sampling_rate": src.sampling_rate(),
-                "n_events": n,
-                "n_shards": shard_id + 1,
-                "format_version": 1,
-            },
-            f,
-        )
-    logger.info(f"packed {n} events into {shard_id + 1} shard(s) at {out_dir}")
     return out_dir
+
+
+def read_waveform_slice(
+    mmaps: Dict[int, np.memmap],
+    data_dir: str,
+    shard: int,
+    off: int,
+    nbytes: int,
+    *,
+    desc: str,
+) -> np.ndarray:
+    """THE raw-slice fault ladder for packed shards, shared by the Event
+    reader (:class:`PackedDataset`) and the direct-ingest store
+    (data/ingest.py) so their io_guard classification can never diverge:
+    per-shard memmaps cached in ``mmaps``; ``OSError`` (shard vanished /
+    page-in failure on a network mount) evicts the cached map — counted
+    as ``reopens``, same telemetry as evict_h5 — and re-raises as a
+    TRANSIENT fault (the retry re-mmaps a fresh fd); a slice that comes
+    back short means the shard file is truncated — PERMANENT corruption
+    (:class:`CorruptSampleError`). Returns the uint8 slice view."""
+    mm = mmaps.get(shard)
+    if mm is None:
+        mm = mmaps[shard] = np.memmap(
+            shard_path(data_dir, shard), dtype=np.uint8, mode="r"
+        )
+    try:
+        raw = mm[off : off + nbytes]
+    except OSError:
+        if mmaps.pop(shard, None) is not None:
+            COUNTERS.inc("reopens")
+        raise
+    if raw.size != nbytes:
+        raise CorruptSampleError(
+            f"{desc}: short read in shard {shard} (want {nbytes} bytes "
+            f"at {off}, got {raw.size} — truncated shard?)"
+        )
+    return raw
 
 
 class PackedDataset(DatasetBase):
@@ -184,6 +568,25 @@ class PackedDataset(DatasetBase):
     def sampling_rate(self):  # type: ignore[override]
         return int(self._meta["sampling_rate"])
 
+    def sources(self) -> List[Dict[str, Any]]:
+        """Provenance of a mixture pack (one entry per source; v1 packs
+        report their single source)."""
+        return list(
+            self._meta.get(
+                "sources",
+                [{"source_id": 0, "name": self._meta["source"],
+                  "n_events": self._meta["n_events"]}],
+            )
+        )
+
+    def source_ids(self) -> Optional[np.ndarray]:
+        """Per-sample source id (THIS split's row order) when the pack
+        holds a mixture; ``None`` for single-source packs — the signal
+        ``pipeline``'s temperature-weighted sampler keys on."""
+        if len(self.sources()) <= 1 or "source_id" not in self._meta_data:
+            return None
+        return self._meta_data["source_id"].to_numpy()
+
     def _load_meta_data(self) -> pd.DataFrame:
         with np.load(
             os.path.join(self._data_dir, _INDEX), allow_pickle=False
@@ -196,37 +599,26 @@ class PackedDataset(DatasetBase):
             )
         return self._shuffle_and_split(frame)
 
-    def _mmap(self, shard: int) -> np.memmap:
-        mm = self._mmaps.get(shard)
-        if mm is None:
-            mm = self._mmaps[shard] = np.memmap(
-                os.path.join(self._data_dir, f"shard_{shard:05d}.bin"),
-                dtype=np.uint8,
-                mode="r",
-            )
-        return mm
+    # Instances cross process boundaries (process-pool loader workers,
+    # shard-parallel pack workers). A cached np.memmap pickles as a FULL
+    # ndarray — the entire shard's bytes per worker — so ship the state
+    # without the maps; workers re-mmap lazily on first read.
+    def __getstate__(self) -> Dict[str, Any]:
+        state = dict(self.__dict__)
+        state["_mmaps"] = {}
+        return state
 
     def _load_event_data(self, idx: int) -> Tuple[Event, dict]:
         row = self._row_dict(idx)
         c, length = int(row["n_ch"]), int(row["n_samp"])
-        off = int(row["offset"])
-        shard = int(row["shard"])
-        nbytes = c * length * 4
-        # OSError on the mmap (shard vanished / page-in failure on a
-        # network mount) is transient: drop the cached map so the retry
-        # re-mmaps a fresh fd. A slice that comes back short means the
-        # shard file is truncated — permanent corruption of this sample.
-        try:
-            raw = self._mmap(shard)[off : off + nbytes]
-        except OSError:
-            if self._mmaps.pop(shard, None) is not None:
-                COUNTERS.inc("reopens")  # same telemetry as evict_h5
-            raise
-        if raw.size != nbytes:
-            raise CorruptSampleError(
-                f"packed: short read in shard {shard} (sample {idx}: want "
-                f"{nbytes} bytes at {off}, got {raw.size} — truncated shard?)"
-            )
+        raw = read_waveform_slice(
+            self._mmaps,
+            self._data_dir,
+            int(row["shard"]),
+            int(row["offset"]),
+            c * length * 4,
+            desc=f"packed (sample {idx})",
+        )
         data = np.frombuffer(raw, dtype=np.float32).reshape(c, length).copy()
 
         def scalar(field):

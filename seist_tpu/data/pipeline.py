@@ -159,6 +159,18 @@ class SeismicDataset:
         raw traces here and runs augmentation/labels on device."""
         return self._dataset[idx % self._dataset_size]
 
+    def source_ids(self) -> Optional[np.ndarray]:
+        """Per-LOGICAL-index source ids when the underlying dataset is a
+        multi-source (mixture) pack, else None. Under the 2x augmentation
+        rule the array is doubled — logical index ``n + i`` is sample
+        ``i``'s augmented replica, same source."""
+        fn = getattr(self._dataset, "source_ids", None)
+        sids = fn() if callable(fn) else None
+        if sids is None:
+            return None
+        sids = np.asarray(sids)
+        return np.concatenate([sids, sids]) if self._augmentation else sids
+
     def _fetch_event(self, raw_idx: int, *, idx: int) -> Tuple[Event, dict]:
         """Guarded sample read (data/io_guard.py): transient faults are
         retried (with injected flakiness riding the same loop); a sample
@@ -274,6 +286,23 @@ def from_task_spec(
     )
 
 
+def _shard_order(
+    order: np.ndarray, num_shards: int, shard_index: int
+) -> np.ndarray:
+    """Host-shard a global epoch order: head-wrapped to equalize shard
+    sizes (torch ``DistributedSampler``'s pad rule; unequal step counts
+    would deadlock the collective-bearing jitted steps), then interleaved
+    ``rank::world`` — the union over hosts covers the full order and the
+    per-position shards are disjoint (test-pinned)."""
+    if num_shards <= 1:
+        return order
+    n = len(order)
+    target = -(-n // num_shards) * num_shards
+    if target > n:
+        order = np.concatenate([order, order[: target - n]])
+    return order[shard_index::num_shards]
+
+
 def epoch_indices(
     n: int,
     *,
@@ -287,19 +316,116 @@ def epoch_indices(
     shared by the host :class:`Loader` and the device-aug executors, so
     both paths consume the identical global sample sequence: seeded
     permutation (a pure function of (seed, epoch) — mid-epoch resume
-    depends on this), head-wrapped to equalize shard sizes (torch
-    ``DistributedSampler``'s pad rule; unequal step counts would deadlock
-    the collective-bearing jitted steps), interleaved ``rank::world``."""
+    depends on this), host-sharded by :func:`_shard_order`. Together with
+    a batch offset this is the full resume address: ``(seed, epoch,
+    shard_index, start_batch)`` determines the remaining batch sequence
+    exactly, with no replay and no skips."""
     if shuffle:
         rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
         order = rng.permutation(n)
     else:
         order = np.arange(n)
-    if num_shards > 1:
-        target = -(-n // num_shards) * num_shards
-        if target > n:
-            order = np.concatenate([order, order[: target - n]])
-    return order[shard_index::num_shards]
+    return _shard_order(order, num_shards, shard_index)
+
+
+# Keys the mixture-draw PRNG stream apart from the shuffle/fallback ones.
+_MIXTURE_SALT = 0x313C7
+
+
+def mixture_epoch_indices(
+    source_ids: np.ndarray,
+    *,
+    seed: int,
+    epoch: int,
+    temperature: float,
+    num_shards: int = 1,
+    shard_index: int = 0,
+) -> np.ndarray:
+    """Temperature-weighted mixture epoch order over multi-source packed
+    data (seqio-style mixing, arXiv:2203.17189), under the SAME resume
+    contract as :func:`epoch_indices`: a pure function of
+    ``(seed, epoch)``, epoch length fixed at ``len(source_ids)`` (so
+    steps_per_epoch and ``(epoch, start_batch)`` addressing are
+    unchanged), host-sharded by :func:`_shard_order`.
+
+    Each epoch slot draws its source with probability
+    ``p_s ∝ (n_s / n)^(1/T)`` (T=1: proportional — every sample appears
+    ~once; T→∞: uniform over sources) and consumes the next sample of
+    that source's stream: a seeded permutation of the source's members,
+    re-permuted on every wrap — small sources are resampled evenly,
+    large ones subsampled without replacement."""
+    source_ids = np.asarray(source_ids)
+    n = int(source_ids.shape[0])
+    if temperature <= 0:
+        raise ValueError(f"mixture temperature must be > 0, got {temperature}")
+    counts = np.bincount(source_ids)
+    if counts.size < 2:
+        raise ValueError("mixture sampling needs >= 2 sources")
+    p = (counts / n) ** (1.0 / float(temperature))
+    p = np.where(counts > 0, p, 0.0)
+    p = p / p.sum()
+    rng = np.random.default_rng(
+        np.random.SeedSequence([int(seed), int(epoch), _MIXTURE_SALT])
+    )
+    choice = rng.choice(counts.size, size=n, p=p)
+    order = np.empty(n, np.int64)
+    for s in range(counts.size):
+        slots = np.flatnonzero(choice == s)
+        if slots.size == 0:
+            continue
+        members = np.flatnonzero(source_ids == s)
+        wraps = -(-slots.size // members.size)
+        stream = np.concatenate(
+            [
+                np.random.default_rng(
+                    np.random.SeedSequence(
+                        [int(seed), int(epoch), _MIXTURE_SALT, s, w]
+                    )
+                ).permutation(members)
+                for w in range(wraps)
+            ]
+        )
+        order[slots] = stream[: slots.size]
+    return _shard_order(order, num_shards, shard_index)
+
+
+def _epoch_order(
+    n: int,
+    *,
+    seed: int,
+    epoch: int,
+    shuffle: bool,
+    num_shards: int = 1,
+    shard_index: int = 0,
+    source_ids: Optional[np.ndarray] = None,
+    mixture_temperature: float = 0.0,
+) -> np.ndarray:
+    """The ONE epoch-order dispatcher every consumer goes through (host
+    Loader, raw-row step feed, cached device executor): plain seeded
+    permutation, or the temperature-weighted mixture order when a
+    multi-source pack + temperature are configured. Both are pure
+    functions of (seed, epoch) — the O(1) mid-epoch resume contract."""
+    if mixture_temperature and source_ids is not None:
+        if len(source_ids) != n:
+            raise ValueError(
+                f"source_ids has {len(source_ids)} entries for {n} samples"
+            )
+        return mixture_epoch_indices(
+            source_ids,
+            seed=seed,
+            epoch=epoch,
+            temperature=mixture_temperature,
+            num_shards=num_shards,
+            shard_index=shard_index,
+        )
+    return epoch_indices(
+        n,
+        seed=seed,
+        epoch=epoch,
+        shuffle=shuffle,
+        num_shards=num_shards,
+        shard_index=shard_index,
+    )
 
 
 def _stack(samples: List[Any]) -> Any:
@@ -342,6 +468,7 @@ class Loader:
         seed: int = 0,
         num_shards: int = 1,
         shard_index: int = 0,
+        mixture_temperature: float = 0.0,
     ) -> None:
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {batch_size}")
@@ -354,6 +481,20 @@ class Loader:
         self.seed = seed
         self.num_shards = num_shards
         self.shard_index = shard_index
+        # Temperature-weighted mixture sampling (multi-source packs only;
+        # see mixture_epoch_indices). Resolved once: the per-sample source
+        # ids are static for the dataset's lifetime.
+        self.mixture_temperature = float(mixture_temperature or 0.0)
+        self._source_ids = None
+        if self.mixture_temperature > 0:
+            fn = getattr(dataset, "source_ids", None)
+            self._source_ids = fn() if callable(fn) else None
+            if self._source_ids is None:
+                raise ValueError(
+                    "mixture_temperature set but the dataset exposes no "
+                    "mixture sources (pack with tools/pack_dataset.py "
+                    "--mixture)"
+                )
         self.epoch = 0
         self._start_batch = 0
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -415,13 +556,15 @@ class Loader:
             pass
 
     def _indices(self) -> np.ndarray:
-        return epoch_indices(
+        return _epoch_order(
             len(self.dataset),
             seed=self.seed,
             epoch=self.epoch,
             shuffle=self.shuffle,
             num_shards=self.num_shards,
             shard_index=self.shard_index,
+            source_ids=self._source_ids,
+            mixture_temperature=self.mixture_temperature,
         )
 
     def __len__(self) -> int:
@@ -565,18 +708,39 @@ def _proc_worker_getitem(epoch_idx):
     return _PROC_DATASET[idx]
 
 
-def _double_buffer(iterator, transform, prefetch: int):
+def _double_buffer(iterator, transform, prefetch: int, account: str = ""):
     """Producer-thread double buffering: apply ``transform`` (typically a
     sharded device_put) to each item ahead of the consumer, propagating
-    producer exceptions. Shared by the prefetch_* variants."""
+    producer exceptions. Shared by the prefetch_* variants.
+
+    ``account`` names a bus-counter prefix for backpressure accounting on
+    the bounded queue: ``<account>_backpressure_s`` accumulates the
+    seconds the producer spent blocked on a full queue (the consumer —
+    i.e. the device step — was the bottleneck), ``<account>_queue_full``
+    counts the blocking puts. Zero backpressure = the pipeline is
+    input-bound; saturated backpressure = the chip is."""
     buf: "queue.Queue" = queue.Queue(maxsize=prefetch)
     sentinel = object()
     err: List[BaseException] = []
+    if account:
+        from seist_tpu.obs.bus import BUS, monotonic
+
+        c_wait = BUS.counter(f"{account}_backpressure_s")
+        c_full = BUS.counter(f"{account}_queue_full")
+
+    def _put(item) -> None:
+        if not account or not buf.full():
+            buf.put(item)
+            return
+        c_full.inc()
+        t0 = monotonic()
+        buf.put(item)
+        c_wait.inc(monotonic() - t0)
 
     def producer():
         try:
             for item in iterator:
-                buf.put(transform(item))
+                _put(transform(item))
         except BaseException as e:  # propagate loader errors to the consumer
             err.append(e)
         finally:
@@ -850,9 +1014,24 @@ class DeviceEpochCache:
                     arrays,
                 )
             sharding = NamedSharding(mesh, P(AXIS_DATA))
-            self.arrays = jax.tree.map(
-                lambda a: jax.device_put(a, sharding), arrays
-            )
+            if jax.process_count() > 1:
+                # Multi-host: every host holds the full raw arrays (the
+                # upload reads the whole dataset), but device_put cannot
+                # place onto non-addressable devices — hand XLA only the
+                # slices this host's devices own. Combined with the
+                # host-sharded epoch_index_chunks below this is the
+                # deterministic global shard contract that used to force
+                # the cached->step fallback on multi-host.
+                self.arrays = jax.tree.map(
+                    lambda a: jax.make_array_from_callback(
+                        a.shape, sharding, lambda idx, a=a: a[idx]
+                    ),
+                    arrays,
+                )
+            else:
+                self.arrays = jax.tree.map(
+                    lambda a: jax.device_put(a, sharding), arrays
+                )
         else:
             self.arrays = jax.tree.map(jax.device_put, arrays)
         self.nbytes = int(
@@ -868,14 +1047,29 @@ class DeviceEpochCache:
         batch_size: int,
         steps_per_call: int,
         start_batch: int = 0,
+        num_shards: int = 1,
+        shard_index: int = 0,
+        source_ids: Optional[np.ndarray] = None,
+        mixture_temperature: float = 0.0,
     ):
-        """Yield (k, B) int32 global-index arrays for one epoch — the
-        same global sample sequence the host Loader would produce
-        (:func:`epoch_indices`), chunked for the scan-based executor.
-        Trailing part-groups are dropped (drop-last + static jit shapes,
-        as on the packed host path)."""
-        order = epoch_indices(
-            len(self.store), seed=seed, epoch=epoch, shuffle=shuffle
+        """Yield (k, B) int32 index arrays for one epoch — the same
+        global sample sequence the host Loader would produce
+        (:func:`_epoch_order`), chunked for the scan-based executor. On
+        multi-host runs each host yields ITS interleaved shard of the
+        global order (``batch_size`` local rows per step;
+        ``shard_stacked_batch`` assembles the global batch), so the
+        union over hosts covers exactly what a single host would train
+        on. Trailing part-groups are dropped (drop-last + static jit
+        shapes, as on the packed host path)."""
+        order = _epoch_order(
+            len(self.store),
+            seed=seed,
+            epoch=epoch,
+            shuffle=shuffle,
+            num_shards=num_shards,
+            shard_index=shard_index,
+            source_ids=source_ids,
+            mixture_temperature=mixture_temperature,
         )
         nb = len(order) // batch_size
         calls = nb // steps_per_call
@@ -899,23 +1093,30 @@ def iter_raw_batches(
     num_shards: int = 1,
     shard_index: int = 0,
     start_batch: int = 0,
+    source_ids: Optional[np.ndarray] = None,
+    mixture_temperature: float = 0.0,
 ):
     """Step-mode (``--device-aug step``) feed: per batch, gather the raw
     rows on host (a numpy fancy index — no per-sample augmentation, no
     label synthesis, no Python stacking) and yield
     ``(rows, idx, aug)`` for the augment-inside-the-step train step.
-    Sample order matches the host Loader exactly (:func:`epoch_indices`,
-    drop-last)."""
-    order = epoch_indices(
+    Sample order matches the host Loader exactly (:func:`_epoch_order`,
+    drop-last). A store exposing ``row_batch_at`` (the packed
+    direct-ingest store) gets the (epoch, logical idx) context its
+    guarded reads key quarantine fallbacks on."""
+    order = _epoch_order(
         len(store),
         seed=seed,
         epoch=epoch,
         shuffle=shuffle,
         num_shards=num_shards,
         shard_index=shard_index,
+        source_ids=source_ids,
+        mixture_temperature=mixture_temperature,
     )
     nb = len(order) // batch_size
     n_raw = store.n_raw
+    row_batch_at = getattr(store, "row_batch_at", None)
     for b in range(start_batch, nb):
         sel = np.asarray(order[b * batch_size : (b + 1) * batch_size], np.int64)
         raw = sel % n_raw if store.augmentation else sel
@@ -924,13 +1125,19 @@ def iter_raw_batches(
             if store.augmentation
             else np.zeros(sel.shape, bool)
         )
-        yield store.row_batch(raw), sel.astype(np.int32), aug
+        if row_batch_at is not None:
+            rows = row_batch_at(raw, epoch=epoch, idx=sel)
+        else:
+            rows = store.row_batch(raw)
+        yield rows, sel.astype(np.int32), aug
 
 
 def prefetch_raw_to_device(iterator, mesh, prefetch: int = 2):
     """Double-buffered device feed for :func:`iter_raw_batches` items:
     rows/idx/aug all batch-sharded on ``data`` (same placement rule as
-    the host path's batches)."""
+    the host path's batches). The bounded queue's backpressure is
+    accounted on the bus (``data_ingest_backpressure_s`` /
+    ``data_ingest_queue_full`` — docs/OBSERVABILITY.md)."""
     if mesh is None:
         yield from iterator
         return
@@ -938,7 +1145,10 @@ def prefetch_raw_to_device(iterator, mesh, prefetch: int = 2):
     from seist_tpu.parallel.mesh import shard_batch
 
     yield from _double_buffer(
-        iterator, lambda item: shard_batch(mesh, item), prefetch
+        iterator,
+        lambda item: shard_batch(mesh, item),
+        prefetch,
+        account="data_ingest",
     )
 
 

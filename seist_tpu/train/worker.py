@@ -181,6 +181,15 @@ class _BadUpdateMonitor:
             )
 
 
+def _mixture_temperature(args: Any, mode: str) -> float:
+    """--mixture-temperature applies to TRAIN sampling only: evaluation
+    walks every source's split plainly so per-source metrics stay
+    comparable across temperature settings."""
+    if mode != "train":
+        return 0.0
+    return float(getattr(args, "mixture_temperature", 0.0) or 0.0)
+
+
 def _build_loader(args: Any, spec: taskspec.TaskSpec, mode: str) -> pipeline.Loader:
     sds = pipeline.from_task_spec(
         spec,
@@ -240,6 +249,7 @@ def _build_loader(args: Any, spec: taskspec.TaskSpec, mode: str) -> pipeline.Loa
         seed=args.seed,
         num_shards=jax.process_count(),
         shard_index=jax.process_index(),
+        mixture_temperature=_mixture_temperature(args, mode),
     )
 
 
@@ -586,6 +596,23 @@ def train_worker(args: Any) -> str:
     device_mode = "off"
     dev_store = dev_cache = None
     sds_train = train_loader.dataset
+    # --ingest: how raw rows reach the device on the device-aug step path.
+    # 'auto' takes the direct shard->staging->device fast path whenever
+    # the dataset is packed (data/ingest.py), 'host' forces the resident
+    # RawStore upload, 'direct' demands the fast path and errors when the
+    # prerequisites are missing instead of degrading silently.
+    ingest_req = str(getattr(args, "ingest", "auto") or "auto")
+    if ingest_req not in ("auto", "direct", "host"):
+        raise ValueError(
+            f"--ingest must be auto|direct|host, got '{ingest_req}'"
+        )
+    if ingest_req == "direct" and device_req == "off":
+        raise ValueError(
+            "--ingest direct feeds the device-aug step path; run with "
+            "--device-aug step (docs/DATA.md)"
+        )
+    mixture_t = _mixture_temperature(args, "train")
+    src_ids_logical = sds_train.source_ids() if mixture_t > 0 else None
     if device_req != "off":
         from seist_tpu.data import device_aug as da
 
@@ -618,16 +645,49 @@ def train_worker(args: Any) -> str:
                 # path, whose quarantine machinery handles it.
                 reasons = [str(e)]
         device_mode, why = da.select_device_aug_mode(
-            device_req, est, budget, reasons, jax.process_count() > 1
+            device_req, est, budget, reasons
         )
         if device_mode != device_req:
             logger.warning(f"--device-aug {device_req} -> {device_mode}: {why}")
+        if ingest_req == "direct" and device_mode != "step":
+            # The ONE resolved-mode guard for --ingest direct (the
+            # pre-flight check above already rejected --device-aug off;
+            # a non-packed dataset is rejected by the build below).
+            raise ValueError(
+                "--ingest direct requires the device-aug step path; the "
+                f"run resolved --device-aug to '{device_mode}' ({why})"
+            )
         if device_mode != "off":
-            try:
-                dev_store = pipeline.RawStore.build(sds_train)
-            except ValueError as e:
-                logger.warning(f"--device-aug {device_mode} -> off: {e}")
-                device_mode = "off"
+            from seist_tpu.data import ingest as ingest_lib
+
+            # Direct shard->device ingest: on a packed dataset the step
+            # path streams staging batches straight off the shard memmaps
+            # — no Event decode, no resident waveform upload. The cached
+            # mode keeps the RawStore (its whole point is HBM residency).
+            direct = device_mode == "step" and ingest_req != "host" and (
+                ingest_req == "direct"
+                or ingest_lib.packed_dataset_of(sds_train) is not None
+            )
+            if direct:
+                try:
+                    dev_store = ingest_lib.PackedRawStore.build(
+                        sds_train, batch_size=args.batch_size
+                    )
+                    logger.info(ingest_lib.describe(dev_store))
+                except ValueError as e:
+                    if ingest_req == "direct":
+                        raise
+                    logger.warning(
+                        f"packed direct ingest unavailable ({e}); "
+                        "uploading a resident RawStore instead"
+                    )
+                    direct = False
+            if not direct:
+                try:
+                    dev_store = pipeline.RawStore.build(sds_train)
+                except ValueError as e:
+                    logger.warning(f"--device-aug {device_mode} -> off: {e}")
+                    device_mode = "off"
         if device_mode == "step" and spc > 1:
             # Explicit 'step' + packing is a config error; but a 'cached'
             # request that FELL BACK to 'step' must not crash on its
@@ -1158,6 +1218,10 @@ def train_worker(args: Any) -> str:
                     batch_size=args.batch_size,
                     steps_per_call=kpack,
                     start_batch=skip,
+                    num_shards=jax.process_count(),
+                    shard_index=jax.process_index(),
+                    source_ids=src_ids_logical,
+                    mixture_temperature=mixture_t,
                 ),
                 start=skip // kpack,
             ):
@@ -1240,6 +1304,8 @@ def train_worker(args: Any) -> str:
                                 num_shards=jax.process_count(),
                                 shard_index=jax.process_index(),
                                 start_batch=skip,
+                                source_ids=src_ids_logical,
+                                mixture_temperature=mixture_t,
                             ),
                             mesh,
                         ),

@@ -18,6 +18,10 @@ proc_id, nprocs, port, logdir = (
     sys.argv[3],
     sys.argv[4],
 )
+# Optional 5th arg: --device-aug mode ("cached" exercises the multi-host
+# epoch cache — per-host addressable-slice placement + the host-sharded
+# index stream that replaced the old cached->step fallback).
+device_aug = sys.argv[5] if len(sys.argv) > 5 else "off"
 
 import jax  # noqa: E402
 
@@ -59,6 +63,10 @@ args = make_args(
     # dominant cost) must stay small or the test rig's timeout trips.
     in_samples=512,
     dataset_kwargs={"num_events": 30, "trace_samples": 2048},
+    device_aug=device_aug,
+    # One update per call keeps the scanned cached executor's compile
+    # small enough for the shared-core rig.
+    steps_per_call=1 if device_aug == "cached" else 0,
 )
 ckpt = train_worker(args)
 assert ckpt and os.path.exists(ckpt), ckpt

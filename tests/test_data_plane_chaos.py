@@ -132,6 +132,107 @@ def test_rotted_dataset_aborts_loudly(tmp_path, monkeypatch):
         train_worker(make_args(max_quarantine_frac=0.1))
 
 
+# ------------------------------------------- packed shards: same contract
+def _pack_for_chaos(tmp_path):
+    """Synthetic pack matching the make_args training recipe, plus a
+    train-split victim sample that is the file-tail of its shard (so a
+    truncation kills exactly that sample)."""
+    from seist_tpu.data.packed import PackedDataset, PackSource, pack_sources
+
+    out = str(tmp_path / "pack")
+    pack_sources(
+        [
+            PackSource(
+                name="synthetic",
+                dataset_kwargs={
+                    "num_events": 40, "trace_samples": 2048, "cache": False,
+                },
+            )
+        ],
+        out,
+        samples_per_shard=8,
+    )
+    with np.load(os.path.join(out, "index.npz"), allow_pickle=False) as z:
+        shard, offset = z["shard"], z["offset"]
+    ds = PackedDataset(seed=1, mode="train", data_dir=out)
+    frame = ds._meta_data
+    victims = [
+        (pos, int(r_shard), int(r_off))
+        for pos, (r_shard, r_off) in enumerate(
+            zip(frame["shard"].to_numpy(), frame["offset"].to_numpy())
+        )
+        if r_off == offset[shard == r_shard].max()
+    ]
+    assert victims, "no shard-tail sample landed in the train split"
+    pos, v_shard, v_off = victims[0]
+    row_nbytes = int(frame["n_ch"].iloc[0]) * int(frame["n_samp"].iloc[0]) * 4
+    return out, pos, v_shard, v_off + row_nbytes // 2
+
+
+def _assert_quarantine_report(logdir, pos):
+    with open(os.path.join(str(logdir), "global.log")) as f:
+        log = f.read()
+    assert "quarantine report" in log, log[-3000:]
+    assert f'"quarantined": [{pos}]' in log, log[-3000:]
+    assert "truncated shard" in log, log[-3000:]
+
+
+def test_packed_shard_truncation_quarantined_e2e(tmp_path):
+    """ISSUE acceptance: a shard truncated mid-epoch surfaces as a short
+    memmap read; the sample is quarantined + deterministically replaced,
+    training completes, and the epoch-end report names it — io_guard
+    parity between the packed path and the HDF5 readers."""
+    from seist_tpu.data.packed import shard_path
+    from seist_tpu.train.worker import train_worker
+
+    out, pos, v_shard, cut = _pack_for_chaos(tmp_path)
+    with open(shard_path(out, v_shard), "r+b") as f:
+        f.truncate(cut)
+
+    io_guard.COUNTERS.reset()
+    logger.set_logdir(str(tmp_path / "logs"))
+    ckpt = train_worker(
+        make_args(
+            dataset_name="packed", data=out, dataset_kwargs={},
+            max_quarantine_frac=0.25,
+        )
+    )
+    assert ckpt and os.path.exists(ckpt)
+    snap = io_guard.COUNTERS.snapshot()
+    assert snap["quarantined"] == 1, snap
+    assert snap["fallback_reads"] >= 1, snap
+    _assert_quarantine_report(tmp_path / "logs", pos)
+    for leaf in _params(ckpt):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_packed_truncation_direct_ingest_e2e(tmp_path):
+    """The same truncation through --device-aug step + --ingest direct:
+    the staging-fill fault ladder (data/ingest.py) quarantines and the
+    run completes — the fast path carries the full PR 5 contract."""
+    from seist_tpu.data.packed import shard_path
+    from seist_tpu.train.worker import train_worker
+
+    out, pos, v_shard, cut = _pack_for_chaos(tmp_path)
+    with open(shard_path(out, v_shard), "r+b") as f:
+        f.truncate(cut)
+
+    io_guard.COUNTERS.reset()
+    logger.set_logdir(str(tmp_path / "logs"))
+    ckpt = train_worker(
+        make_args(
+            dataset_name="packed", data=out, dataset_kwargs={},
+            device_aug="step", ingest="direct",
+            max_quarantine_frac=0.25,
+        )
+    )
+    assert ckpt and os.path.exists(ckpt)
+    assert io_guard.COUNTERS.snapshot()["quarantined"] == 1
+    _assert_quarantine_report(tmp_path / "logs", pos)
+    with open(os.path.join(str(tmp_path / "logs"), "global.log")) as f:
+        assert "packed direct ingest" in f.read()
+
+
 # ------------------------------------------------ loader death -> preempt
 def test_loader_thread_death_exits_preempt_code(tmp_path, monkeypatch):
     """A loader worker raising a non-fault exception mid-epoch surfaces

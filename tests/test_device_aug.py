@@ -659,8 +659,6 @@ class TestFallbackSelection:
         assert sel("cached", 50, 100, [])[0] == "cached"
         mode, why = sel("cached", 200, 100, [])
         assert mode == "step" and "budget" in why
-        mode, why = sel("cached", 50, 100, [], multi_process=True)
-        assert mode == "step"
         mode, why = sel("cached", 50, 100, ["mask_percent"])
         assert mode == "off" and "mask_percent" in why
         mode, why = sel("step", 10**12, 100, [])

@@ -1,0 +1,416 @@
+"""Direct shard->device ingest (seist_tpu/data/ingest.py) + the packed
+data plane's determinism contracts:
+
+* PackedRawStore row parity with RawStore.build (same phases/labels/
+  waveforms, no Event decode);
+* O(1) mid-epoch resume: (seed, epoch, host, start_batch) pins the
+  remaining batch stream byte-identical, 1-host and 2-host (union
+  coverage + per-position disjointness);
+* io_guard parity on the fast path: truncation / NaN poison / injected
+  SEIST_FAULT_IO_* faults quarantine + deterministically replace exactly
+  like the HDF5 readers;
+* temperature-weighted mixture sampling determinism.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import seist_tpu
+from seist_tpu import taskspec
+from seist_tpu.data import io_guard, pipeline
+from seist_tpu.data.ingest import PackedRawStore, packed_dataset_of
+from seist_tpu.data.packed import PackSource, pack_sources, shard_path
+from seist_tpu.obs.bus import BUS
+
+seist_tpu.load_all()
+
+N_EVENTS = 28
+L_TRACE = 640
+WINDOW = 512
+
+
+def _pack_synthetic(root, n_events=N_EVENTS, trace=L_TRACE, sps=5):
+    return pack_sources(
+        [
+            PackSource(
+                name="synthetic",
+                dataset_kwargs={
+                    "num_events": n_events,
+                    "trace_samples": trace,
+                    "cache": False,
+                },
+            )
+        ],
+        str(root),
+        samples_per_shard=sps,
+    )["out"]
+
+
+@pytest.fixture(scope="module")
+def packed_dir(tmp_path_factory):
+    return _pack_synthetic(tmp_path_factory.mktemp("ingest_pack"))
+
+
+def _sds(packed_dir, *, model="seist_s_dpk", augmentation=True, seed=3, **kw):
+    spec = taskspec.get_task_spec(model)
+    return pipeline.from_task_spec(
+        spec,
+        "packed",
+        "train",
+        seed=seed,
+        in_samples=WINDOW,
+        augmentation=augmentation,
+        data_dir=packed_dir,
+        **kw,
+    )
+
+
+# ----------------------------------------------------------------- row parity
+def test_packed_raw_store_matches_raw_store(packed_dir):
+    """The metadata-only build + memmap batch fill must reproduce
+    RawStore.build's rows bit-for-bit — phases, counts, and waveforms."""
+    sds = _sds(packed_dir)
+    ref = pipeline.RawStore.build(sds)
+    fast = PackedRawStore.build(sds, batch_size=8)
+    assert packed_dataset_of(sds) is not None
+    assert fast.n_raw == ref.n_raw
+    assert fast.raw_len == ref.raw_len == L_TRACE
+    assert fast.phase_slots == ref.phase_slots
+    assert fast.augmentation == ref.augmentation
+    for k in ("ppks", "np_p", "spks", "np_s"):
+        np.testing.assert_array_equal(fast.arrays[k], ref.arrays[k])
+    idx = np.array([0, 5, 3, fast.n_raw - 1])
+    rows_ref = ref.row_batch(idx)
+    rows_fast = fast.row_batch(idx)
+    for k in rows_ref:
+        np.testing.assert_array_equal(rows_fast[k], rows_ref[k])
+
+
+def test_packed_raw_store_value_onehot_labels(packed_dir):
+    """VALUE (emg) labels come from the index columns, matching the
+    Event-decode path."""
+    sds = _sds(packed_dir, model="magnet")
+    ref = pipeline.RawStore.build(sds)
+    fast = PackedRawStore.build(sds)
+    assert "values" in fast.arrays
+    for name in ref.arrays["values"]:
+        np.testing.assert_array_equal(
+            fast.arrays["values"][name], ref.arrays["values"][name]
+        )
+
+
+def test_ingest_counters_account_batches(packed_dir):
+    sds = _sds(packed_dir)
+    fast = PackedRawStore.build(sds, batch_size=4)
+    before = BUS.counter("data_ingest_samples").value
+    fast.row_batch(np.arange(4))
+    assert BUS.counter("data_ingest_samples").value == before + 4
+    assert BUS.counter("data_ingest_bytes").value > 0
+
+
+# ------------------------------------------------------- mid-epoch resume
+def _collect(store, *, epoch, start_batch, num_shards=1, shard_index=0,
+             batch_size=4, seed=3):
+    out = []
+    for rows, idx, aug in pipeline.iter_raw_batches(
+        store,
+        epoch,
+        seed=seed,
+        shuffle=True,
+        batch_size=batch_size,
+        num_shards=num_shards,
+        shard_index=shard_index,
+        start_batch=start_batch,
+    ):
+        out.append((
+            {k: np.array(v) for k, v in rows.items() if k != "values"},
+            np.array(idx),
+            np.array(aug),
+        ))
+    return out
+
+
+def _assert_streams_equal(a, b):
+    assert len(a) == len(b)
+    for (ra, ia, ga), (rb, ib, gb) in zip(a, b):
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(ga, gb)
+        assert ra.keys() == rb.keys()
+        for k in ra:
+            np.testing.assert_array_equal(ra[k], rb[k])
+
+
+def test_mid_epoch_resume_byte_identical_one_host(packed_dir):
+    """Kill at batch k, resume at start_batch=k: the remaining stream is
+    byte-identical to the uninterrupted run (ISSUE acceptance)."""
+    sds = _sds(packed_dir)
+    store = PackedRawStore.build(sds, batch_size=4)
+    full = _collect(store, epoch=1, start_batch=0)
+    assert len(full) >= 4
+    k = len(full) // 2
+    resumed = _collect(store, epoch=1, start_batch=k)
+    _assert_streams_equal(full[k:], resumed)
+
+
+def test_mid_epoch_resume_two_host_union_and_disjoint(packed_dir):
+    """Simulated 2-host split: per-host streams resume byte-identically,
+    every global batch position is disjoint across hosts, and the union
+    covers the head-wrapped global order."""
+    sds = _sds(packed_dir)
+    store = PackedRawStore.build(sds, batch_size=4)
+    hosts = [
+        _collect(store, epoch=2, start_batch=0, num_shards=2, shard_index=h)
+        for h in (0, 1)
+    ]
+    # Resume each host at batch k: identical remainder.
+    k = len(hosts[0]) // 2
+    for h in (0, 1):
+        resumed = _collect(
+            store, epoch=2, start_batch=k, num_shards=2, shard_index=h
+        )
+        _assert_streams_equal(hosts[h][k:], resumed)
+    # Disjointness per position + union coverage of the global order.
+    n_logical = len(store)
+    global_order = pipeline.epoch_indices(
+        n_logical, seed=3, epoch=2, shuffle=True
+    )
+    target = -(-n_logical // 2) * 2
+    wrapped = np.concatenate(
+        [global_order, global_order[: target - n_logical]]
+    )
+    seen = []
+    for (_, ia, _), (_, ib, _) in zip(*hosts):
+        # n_logical divides evenly here: no head-wrap duplicates, so the
+        # two hosts' rows must be strictly disjoint at every position.
+        assert not (set(ia.tolist()) & set(ib.tolist()))
+        seen.extend(ia.tolist())
+        seen.extend(ib.tolist())
+    n_batches = len(hosts[0])
+    interleaved = np.stack(
+        [wrapped[0::2][: n_batches * 4], wrapped[1::2][: n_batches * 4]]
+    )
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(seen)),
+        np.sort(interleaved.ravel()),
+    )
+
+
+def test_host_loader_resume_byte_identical(packed_dir):
+    """The host Loader path honors the same contract via
+    set_start_batch (checkpoint restore's mid-epoch hook)."""
+    sds = _sds(packed_dir, augmentation=False)
+    loader = pipeline.Loader(
+        sds, batch_size=4, shuffle=True, drop_last=True, num_workers=2,
+        seed=3,
+    )
+    try:
+        loader.set_epoch(1)
+        full = [b.inputs for b in loader]
+        k = len(full) // 2
+        loader.set_epoch(1)
+        loader.set_start_batch(k)
+        resumed = [b.inputs for b in loader]
+        assert len(resumed) == len(full) - k
+        for a, b in zip(full[k:], resumed):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        loader.close()
+
+
+# ------------------------------------------------------------ fault parity
+def test_truncated_shard_quarantines_and_falls_back(tmp_path):
+    """A truncated shard_XXXXX.bin surfaces as a short read: the sample
+    is quarantined and deterministically replaced — batch shapes hold,
+    the replacement is the first cleanly-reading candidate of the
+    (seed, epoch, idx) fallback sequence (io_guard parity)."""
+    out = _pack_synthetic(tmp_path / "pack", sps=5)
+    sds = _sds(out, augmentation=False, seed=0, shuffle=False,
+               data_split=False)
+    store = PackedRawStore.build(sds, batch_size=4)
+    # Truncate the LAST shard mid-sample: its final sample dies.
+    last_shard = int(store._shards.max())
+    p = shard_path(out, last_shard)
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.truncate(size - store.row_nbytes // 2)
+    victims = np.flatnonzero(
+        (store._shards == last_shard)
+        & (store._offsets + store.row_nbytes > size - store.row_nbytes // 2)
+    )
+    assert victims.size == 1
+    bad = int(victims[0])
+
+    io_guard.COUNTERS.reset()
+    raw_idx = np.array([bad, 0, 1, 2])
+    rows = store.row_batch_at(raw_idx, epoch=0, idx=raw_idx)
+    snap = io_guard.COUNTERS.snapshot()
+    assert snap["quarantined"] == 1
+    assert snap["fallback_reads"] == 1
+    assert bad in sds.quarantine
+    # The replacement row is the deterministic candidate's content.
+    cand = next(
+        c
+        for c in sds.quarantine.candidates(bad, seed=0, epoch=0, idx=bad)
+        if c != bad
+    )
+    expect = store.row_batch_at(np.array([cand]), epoch=0,
+                                idx=np.array([cand]))
+    np.testing.assert_array_equal(rows["data"][0], expect["data"][0])
+    np.testing.assert_array_equal(rows["ppks"][0], expect["ppks"][0])
+    assert np.isfinite(rows["data"]).all()
+
+
+def test_nan_poisoned_waveform_quarantined(tmp_path):
+    out = _pack_synthetic(tmp_path / "pack", sps=50)  # one shard
+    sds = _sds(out, augmentation=False, seed=0)
+    store = PackedRawStore.build(sds, batch_size=4)
+    poison = 3
+    with open(shard_path(out, 0), "r+b") as f:
+        f.seek(int(store._offsets[poison]))
+        f.write(np.full(8, np.nan, np.float32).tobytes())
+    io_guard.COUNTERS.reset()
+    rows = store.row_batch_at(
+        np.array([poison, 0]), epoch=0, idx=np.array([poison, 0])
+    )
+    assert io_guard.COUNTERS.snapshot()["quarantined"] == 1
+    assert poison in sds.quarantine
+    assert np.isfinite(rows["data"]).all()
+
+
+def test_injected_flaky_reads_are_invisible(tmp_path, monkeypatch):
+    """SEIST_FAULT_IO_FLAKY_P transient faults: absorbed by retries, the
+    byte stream is identical to a clean run — the same contract the
+    HDF5 readers pin in the chaos lane."""
+    out = _pack_synthetic(tmp_path / "pack")
+    clean_sds = _sds(out, augmentation=False, seed=0)
+    clean = PackedRawStore.build(clean_sds, batch_size=4).row_batch(
+        np.arange(8)
+    )
+
+    monkeypatch.setenv("SEIST_FAULT_IO_FLAKY_P", "0.5")
+    monkeypatch.setenv("SEIST_IO_BACKOFF_MS", "1")
+    io_guard.COUNTERS.reset()
+    flaky_sds = _sds(out, augmentation=False, seed=0)
+    assert flaky_sds.io_faults.enabled
+    flaky = PackedRawStore.build(flaky_sds, batch_size=4).row_batch_at(
+        np.arange(8), epoch=0, idx=np.arange(8)
+    )
+    snap = io_guard.COUNTERS.snapshot()
+    assert snap["retries"] > 0, "injected flakiness never fired"
+    assert snap["quarantined"] == 0
+    for k in ("data", "ppks", "np_p", "spks", "np_s"):
+        np.testing.assert_array_equal(flaky[k], clean[k])
+
+
+def test_injected_corrupt_sample_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("SEIST_FAULT_IO_CORRUPT", "2")
+    out = _pack_synthetic(tmp_path / "pack")
+    sds = _sds(out, augmentation=False, seed=0)
+    store = PackedRawStore.build(sds, batch_size=4)
+    io_guard.COUNTERS.reset()
+    rows = store.row_batch_at(np.array([2, 0]), epoch=0, idx=np.array([2, 0]))
+    assert io_guard.COUNTERS.snapshot()["quarantined"] == 1
+    assert 2 in sds.quarantine
+    assert np.isfinite(rows["data"]).all()
+
+
+def test_non_packed_dataset_refused():
+    spec = taskspec.get_task_spec("seist_s_dpk")
+    sds = pipeline.from_task_spec(
+        spec, "synthetic", "train", seed=0, in_samples=256,
+        dataset_kwargs={"num_events": 8, "trace_samples": 256},
+    )
+    with pytest.raises(ValueError, match="packed"):
+        PackedRawStore.build(sds)
+
+
+# ------------------------------------------------------------- mixture order
+def _mixture_ids():
+    return np.concatenate([np.zeros(300, int), np.ones(100, int)])
+
+
+def test_mixture_epoch_indices_deterministic_and_valid():
+    sids = _mixture_ids()
+    a = pipeline.mixture_epoch_indices(
+        sids, seed=7, epoch=2, temperature=1.0
+    )
+    b = pipeline.mixture_epoch_indices(
+        sids, seed=7, epoch=2, temperature=1.0
+    )
+    np.testing.assert_array_equal(a, b)
+    c = pipeline.mixture_epoch_indices(
+        sids, seed=7, epoch=3, temperature=1.0
+    )
+    assert not np.array_equal(a, c)
+    assert a.shape == (400,)  # epoch length preserved -> resume contract
+    # Every slot's sample really belongs to the drawn source.
+    assert set(a.tolist()) <= set(range(400))
+
+
+def test_mixture_temperature_shifts_source_shares():
+    sids = _mixture_ids()
+    t1 = pipeline.mixture_epoch_indices(sids, seed=1, epoch=0, temperature=1.0)
+    t8 = pipeline.mixture_epoch_indices(sids, seed=1, epoch=0, temperature=8.0)
+    share_small_t1 = np.mean(sids[t1] == 1)
+    share_small_t8 = np.mean(sids[t8] == 1)
+    # T=1 ~ proportional (25%); T=8 pulls toward uniform (50%).
+    assert abs(share_small_t1 - 0.25) < 0.08
+    assert share_small_t8 > share_small_t1 + 0.1
+
+
+def test_mixture_sharding_matches_contract():
+    sids = _mixture_ids()
+    full = pipeline.mixture_epoch_indices(
+        sids, seed=5, epoch=1, temperature=2.0
+    )
+    shards = [
+        pipeline.mixture_epoch_indices(
+            sids, seed=5, epoch=1, temperature=2.0,
+            num_shards=2, shard_index=h,
+        )
+        for h in (0, 1)
+    ]
+    np.testing.assert_array_equal(shards[0], full[0::2])
+    np.testing.assert_array_equal(shards[1], full[1::2])
+
+
+def test_mixture_loader_end_to_end(tmp_path):
+    """Loader over a 2-source mixture pack: deterministic epochs, small
+    source oversampled at high temperature, resume byte-identical."""
+    out = str(tmp_path / "mix")
+    srcs = [
+        PackSource(
+            name="synthetic",
+            dataset_kwargs={"num_events": n, "trace_samples": 256,
+                            "cache": False},
+        )
+        for n in (24, 8)
+    ]
+    pack_sources(srcs, out, samples_per_shard=6)
+    sds = _sds(out, augmentation=False, seed=1, shuffle=False,
+               data_split=False)
+    assert sds.source_ids() is not None
+    loader = pipeline.Loader(
+        sds, batch_size=4, shuffle=True, drop_last=True, num_workers=2,
+        seed=1, mixture_temperature=4.0,
+    )
+    try:
+        loader.set_epoch(0)
+        a = [np.array(b.inputs) for b in loader]
+        loader.set_epoch(0)
+        b = [np.array(x.inputs) for x in loader]
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+    finally:
+        loader.close()
+    # Temperature on a source-less dataset is a config error, not a
+    # silent no-op.
+    plain = pipeline.from_task_spec(
+        taskspec.get_task_spec("seist_s_dpk"), "synthetic", "train",
+        seed=0, in_samples=256,
+        dataset_kwargs={"num_events": 8, "trace_samples": 256},
+    )
+    with pytest.raises(ValueError, match="mixture"):
+        pipeline.Loader(plain, batch_size=4, mixture_temperature=0.5)

@@ -58,9 +58,15 @@ def test_two_host_simulation():
         assert f"worker {i}: OK" in outs[i]
 
 
-def test_two_host_training(tmp_path):
+@pytest.mark.parametrize("device_aug", ["off", "cached"])
+def test_two_host_training(tmp_path, device_aug):
     """Full train_worker epoch across 2 simulated hosts: sharded loaders,
-    global eval loss, synced metrics, multi-host orbax checkpoint."""
+    global eval loss, synced metrics, multi-host orbax checkpoint.
+
+    device_aug='cached' additionally pins the multi-host epoch cache
+    (per-host addressable-slice placement + host-sharded index chunks) —
+    the contract that let PR 14 remove the cached->step multi-host
+    fallback."""
     port = _free_port()
     repo = os.path.abspath(os.path.join(HERE, ".."))
     env = dict(os.environ)
@@ -70,7 +76,10 @@ def test_two_host_training(tmp_path):
     worker = os.path.join(HERE, "_multihost_train_worker.py")
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(i), "2", str(port), str(tmp_path)],
+            [
+                sys.executable, worker, str(i), "2", str(port),
+                str(tmp_path), device_aug,
+            ],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             env=env,

@@ -8,13 +8,21 @@ memmap slice instead of h5py's per-sample group walk (the measured ~30%
 read tax, BASELINE.md §Input pipeline).
 """
 
+import json
 import os
 
 import numpy as np
 import pytest
 
 import seist_tpu
-from seist_tpu.data.packed import PackedDataset, pack_dataset
+from seist_tpu.data.packed import (
+    PackedDataset,
+    PackSource,
+    pack_dataset,
+    pack_sources,
+    sidecar_path,
+    shard_path,
+)
 from seist_tpu.registry import DATASETS
 
 seist_tpu.load_all()
@@ -107,6 +115,134 @@ def test_packed_through_pipeline(packed_pair):
         loader.close()
     assert batch.inputs.shape == (8, 512, 3)
     assert np.isfinite(batch.inputs).all()
+
+
+# ------------------------------------------------ parallel / resume / mixture
+def _synthetic_source(n_events=30, trace_samples=512):
+    return PackSource(
+        name="synthetic",
+        dataset_kwargs={
+            "num_events": n_events,
+            "trace_samples": trace_samples,
+            "cache": False,
+        },
+    )
+
+
+def _dir_fingerprint(root):
+    """Byte content of every shard bin + the index/sidecar ARRAY contents
+    (npz zip bytes carry timestamps, so the arrays are the identity)."""
+    out = {}
+    for f in sorted(os.listdir(root)):
+        p = os.path.join(root, f)
+        if f.endswith(".bin"):
+            with open(p, "rb") as fh:
+                out[f] = fh.read()
+        elif f.endswith(".npz"):
+            with np.load(p, allow_pickle=False) as z:
+                out[f] = {k: z[k].tolist() for k in sorted(z.files)}
+    return out
+
+
+def test_parallel_pack_bit_identical_to_serial(tmp_path):
+    """A 2-worker pack must produce byte-identical shards and an
+    identical index to a 1-worker pack: the shard partition is a pure
+    function of the plan, never of worker count (ISSUE acceptance)."""
+    a, b = str(tmp_path / "serial"), str(tmp_path / "par")
+    s1 = pack_sources([_synthetic_source()], a, samples_per_shard=7)
+    s2 = pack_sources(
+        [_synthetic_source()], b, num_workers=2, samples_per_shard=7
+    )
+    assert s1["shards"] == s2["shards"] > 1
+    assert s1["samples"] == s2["samples"] == 30
+    assert _dir_fingerprint(a) == _dir_fingerprint(b)
+
+
+def test_pack_resume_skips_complete_shards(tmp_path):
+    """Interrupted pack: kill after some shards -> the re-run re-plans
+    identically, skips every complete shard, and the result is identical
+    to an uninterrupted pack."""
+    full, part = str(tmp_path / "full"), str(tmp_path / "part")
+    pack_sources([_synthetic_source()], full, samples_per_shard=7)
+    pack_sources([_synthetic_source()], part, samples_per_shard=7)
+    # Simulate the interruption: no meta/index yet, shard 1 half-written
+    # (bin exists, sidecar missing), shard 2 gone entirely.
+    os.unlink(os.path.join(part, "meta.json"))
+    os.unlink(os.path.join(part, "index.npz"))
+    os.unlink(sidecar_path(part, 1))
+    os.unlink(shard_path(part, 2))
+    os.unlink(sidecar_path(part, 2))
+    stats = pack_sources([_synthetic_source()], part, samples_per_shard=7)
+    assert stats["shards_skipped"] == stats["shards"] - 2
+    assert stats["samples_packed"] == 7 + 7  # only the two holes re-read
+    assert _dir_fingerprint(full) == _dir_fingerprint(part)
+
+
+def test_mixture_pack_provenance_and_roundtrip(tmp_path):
+    """--mixture: two sources in one directory, consecutive shard
+    ranges, a source_id column on every row, and events identical to
+    reading each source directly."""
+    out = str(tmp_path / "mix")
+    src_a = _synthetic_source(n_events=10, trace_samples=256)
+    src_b = _synthetic_source(n_events=17, trace_samples=256)
+    stats = pack_sources(
+        [src_a, src_b], out, samples_per_shard=4, num_workers=0
+    )
+    assert stats["samples"] == 27
+    with open(os.path.join(out, "meta.json")) as f:
+        meta = json.load(f)
+    assert [s["n_events"] for s in meta["sources"]] == [10, 17]
+    assert meta["source"].startswith("mixture:")
+
+    ds = PackedDataset(
+        seed=0, mode="train", data_dir=out, shuffle=False, data_split=False
+    )
+    sids = ds.source_ids()
+    assert sids is not None and sids.shape == (27,)
+    assert (sids[:10] == 0).all() and (sids[10:] == 1).all()
+    # Row 10+j of the mixture == source B's own event j.
+    b = src_b.create()
+    for j in (0, 16):
+        ev_mix, row = ds[10 + j]
+        ev_src, _ = b[j]
+        np.testing.assert_array_equal(ev_mix["data"], ev_src["data"])
+        assert int(row["source_id"]) == 1
+    # Single-source packs expose no source ids (mixture sampler stays off).
+    single = PackedDataset(
+        seed=0,
+        mode="train",
+        data_dir=pack_sources(
+            [_synthetic_source(8, 256)], str(tmp_path / "one"),
+            samples_per_shard=4,
+        )["out"],
+        shuffle=False,
+        data_split=False,
+    )
+    assert single.source_ids() is None
+
+
+def test_mixture_rejects_mismatched_sources(tmp_path):
+    class OtherRate:
+        def __len__(self):
+            return 1
+
+        def __getitem__(self, i):
+            return {"data": np.zeros((3, 64), np.float32), "snr": np.zeros(3)}, {}
+
+        def name(self):
+            return "other"
+
+        def channels(self):
+            return ["z", "n", "e"]
+
+        def sampling_rate(self):
+            return 100  # != synthetic's 50
+
+    with pytest.raises(ValueError, match="sampling rate"):
+        pack_sources(
+            [_synthetic_source(4, 128), PackSource(dataset=OtherRate())],
+            str(tmp_path / "bad"),
+        )
 
 
 def test_pack_rejects_multi_event_windows(tmp_path):

@@ -17,6 +17,16 @@ BENCH_WORKERS (os.cpu_count), DEVICE_WFS, BENCH_DATASET
 DiTing-light-format CSV+HDF5 fixture once under logs/ and measures the
 real h5py/pandas reader path end to end; packed measures the
 packed-shard repack of that same fixture, tools/pack_dataset.py).
+
+--compare (``python -m tools.bench_loader --compare [--out f.json]``)
+runs the packed-ingest ladder on ONE shared fixture instead: hdf5
+per-sample reads vs packed per-sample reads vs packed+direct-ingest
+batch fills (data/ingest.py), with a per-stage budget that shows the
+per-sample Event decode and ``_stack`` assembly eliminated on the fast
+path. Pass gate: direct >= 2x the hdf5 per-sample read throughput
+(ISSUE 14 acceptance; the committed verdict lives in
+BENCH_loader_r01.json). Env: BENCH_EVENTS (512), BENCH_SAMPLES (8192),
+BENCH_READS (400), BENCH_BATCH (64).
 """
 
 from __future__ import annotations
@@ -146,5 +156,123 @@ def run() -> None:
     )
 
 
-if __name__ == "__main__":
+def compare(out_path: str = "") -> int:
+    """hdf5 vs packed vs packed+direct-ingest on one shared fixture."""
+    import numpy as np
+
+    import seist_tpu
+    from seist_tpu import taskspec
+    from seist_tpu.data import pipeline
+    from seist_tpu.data.ingest import PackedRawStore
+    from seist_tpu.registry import DATASETS
+    from tools.fixtures import ensure_loader_fixture, ensure_packed_fixture
+
+    seist_tpu.load_all()
+    n_events = int(os.environ.get("BENCH_EVENTS", 512))
+    in_samples = int(os.environ.get("BENCH_SAMPLES", 8192))
+    n_reads = int(os.environ.get("BENCH_READS", 400))
+    batch = int(os.environ.get("BENCH_BATCH", 64))
+
+    src_dir = ensure_loader_fixture(n_events, in_samples)
+    packed_dir = ensure_packed_fixture(n_events, in_samples)
+    hdf5 = DATASETS.create(
+        "diting_light", seed=0, mode="train", data_dir=src_dir
+    )
+    packed = DATASETS.create(
+        "packed", seed=0, mode="train", data_dir=packed_dir
+    )
+    idxs = [i % len(hdf5) for i in range(n_reads)]
+    for i in idxs[:16]:  # warm h5 handles / memmaps / page cache
+        hdf5[i]
+        packed[i]
+
+    def rate(fn, items):
+        t0 = time.perf_counter()
+        for i in items:
+            fn(i)
+        dt = time.perf_counter() - t0
+        return len(items) / dt, dt * 1e3 / len(items)
+
+    hdf5_wfs, hdf5_ms = rate(lambda i: hdf5[i], idxs)
+    packed_wfs, packed_ms = rate(lambda i: packed[i], idxs)
+
+    # The batch-assembly (_stack) tax both per-sample paths pay per wf.
+    rows = [packed[i][0]["data"] for i in idxs[:batch]]
+    reps = max(1, n_reads // batch)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        pipeline._stack(rows)
+    stack_ms = (time.perf_counter() - t0) * 1e3 / (reps * batch)
+
+    # Direct ingest: memmap -> staging slab batch fills, no Event decode.
+    spec = taskspec.get_task_spec("seist_l_dpk")
+    sds = pipeline.from_task_spec(
+        spec, "packed", "train", seed=0, in_samples=in_samples,
+        augmentation=False, data_dir=packed_dir,
+    )
+    store = PackedRawStore.build(sds, batch_size=batch)
+    order = np.arange(store.n_raw)
+    chunks = [
+        order[b * batch : (b + 1) * batch]
+        for b in range(max(1, min(len(order) // batch, n_reads // batch)))
+    ]
+    store.row_batch(chunks[0])  # warm
+    t0 = time.perf_counter()
+    for c in chunks:
+        store.row_batch(c)
+    dt = time.perf_counter() - t0
+    direct_n = sum(len(c) for c in chunks)
+    direct_wfs = direct_n / dt
+    fill_ms = dt * 1e3 / direct_n
+
+    verdict = {
+        "metric": "packed_ingest_throughput",
+        "unit": "waveforms/sec/host (single-thread read lane)",
+        "hdf5_read_wfs": round(hdf5_wfs, 1),
+        "packed_read_wfs": round(packed_wfs, 1),
+        "packed_direct_wfs": round(direct_wfs, 1),
+        "speedup_packed_vs_hdf5": round(packed_wfs / hdf5_wfs, 2),
+        "speedup_direct_vs_hdf5": round(direct_wfs / hdf5_wfs, 2),
+        "stage_budget_ms_per_wf": {
+            "hdf5": {
+                "per_sample_event_decode": round(hdf5_ms, 4),
+                "_stack": round(stack_ms, 4),
+            },
+            "packed": {
+                "per_sample_event_decode": round(packed_ms, 4),
+                "_stack": round(stack_ms, 4),
+            },
+            "packed_direct": {
+                "batch_fill": round(fill_ms, 4),
+                "eliminated": ["per_sample_event_decode", "_stack"],
+            },
+        },
+        "config": {
+            "n_events": n_events,
+            "in_samples": in_samples,
+            "n_reads": n_reads,
+            "batch": batch,
+        },
+        "pass": direct_wfs >= 2.0 * hdf5_wfs,
+    }
+    line = json.dumps(verdict)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return 0 if verdict["pass"] else 1
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if "--compare" in argv:
+        out = ""
+        if "--out" in argv:
+            out = argv[argv.index("--out") + 1]
+        return compare(out)
     run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

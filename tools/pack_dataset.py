@@ -1,61 +1,123 @@
-"""Offline HDF5 -> packed-shard converter (SURVEY §7 input mitigation).
+"""Offline dataset -> packed-shard converter (the at-scale ingest format).
 
-Repacks any registered dataset into seist_tpu.data.packed's contiguous
-binary shards + columnar index, removing h5py's per-sample API cost from
-the training read path (measured ~30% of per-sample loader cost in the
-r3 stage budget). Run once per dataset; then train with
+Repacks registered datasets into seist_tpu.data.packed's contiguous
+binary shards + columnar index, removing the per-sample reader API cost
+from the training read path (measured ~30% of per-sample loader cost in
+the r3 stage budget; `python -m tools.bench_loader --compare` re-measures
+it). Run once per dataset (or dataset mixture); then train with
 ``--dataset-name packed --data-dir <out>``.
 
-    python tools/pack_dataset.py --dataset diting_light \
-        --data-dir /data/diting --out /data/diting_packed \
-        [--shard-mb 512]
+    # single source, 4 pack workers
+    python -m tools.pack_dataset --dataset diting_light \
+        --data-dir /data/diting --out /data/diting_packed --workers 4
 
-The source is constructed with ``data_split=False, shuffle=False`` so
-the packed order is the source metadata order; the packed dataset then
-applies the standard seeded shuffle/split itself — same seed => same
-split as training on the source directly.
+    # DiTing+PNW+SOS joint mixture in ONE directory (per-row source_id
+    # provenance; train with --mixture-temperature)
+    python -m tools.pack_dataset \
+        --mixture diting_light:/data/diting,pnw:/data/pnw,sos:/data/sos \
+        --out /data/joint_packed --workers 8
+
+The pack is plan-first (data/packed.py): shard boundaries are a pure
+function of the source sizes and the capacity knobs, so an N-worker pack
+is bit-identical to a serial one and an interrupted pack resumes at the
+last complete shard (re-run the same command; ``--no-resume`` forces a
+full rewrite). Sources are constructed with ``data_split=False,
+shuffle=False`` so the packed order is the source metadata order; the
+packed dataset then applies the standard seeded shuffle/split itself —
+same seed => same split as training on the source directly.
+
+Prints ONE JSON verdict line: shards, bytes, samples, wall_s, workers.
 """
 
 from __future__ import annotations
 
 import argparse
-import os
+import json
 import sys
-import time
-
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
+from typing import List
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--dataset", required=True, help="registered source dataset")
-    ap.add_argument("--data-dir", required=True)
+def _parse_mixture(spec: str) -> List[tuple]:
+    """``name:dir[,name:dir...]`` -> [(name, dir), ...]."""
+    pairs = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, data_dir = part.partition(":")
+        if not sep:
+            raise SystemExit(
+                f"--mixture entries are name:data_dir, got '{part}'"
+            )
+        pairs.append((name.strip(), data_dir.strip()))
+    if len(pairs) < 2:
+        raise SystemExit("--mixture needs at least two name:dir entries")
+    return pairs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.pack_dataset", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--dataset", help="registered source dataset")
+    src.add_argument(
+        "--mixture",
+        help="comma-separated name:data_dir pairs packed into ONE "
+        "directory with per-row source_id provenance",
+    )
+    ap.add_argument("--data-dir", default="", help="source dataset dir")
     ap.add_argument("--out", required=True)
-    ap.add_argument("--shard-mb", type=int, default=512)
-    args = ap.parse_args()
+    ap.add_argument("--shard-mb", type=float, default=512)
+    ap.add_argument(
+        "--samples-per-shard", type=int, default=0,
+        help="explicit shard capacity (overrides --shard-mb)",
+    )
+    ap.add_argument(
+        "--workers", type=int, default=0,
+        help="shard-parallel pack processes (0/1 = serial)",
+    )
+    ap.add_argument(
+        "--no-resume", action="store_true",
+        help="rewrite every shard even when complete ones exist",
+    )
+    ap.add_argument(
+        "--dataset-kwargs", default="",
+        help="JSON dict forwarded to the dataset constructor(s)",
+    )
+    args = ap.parse_args(argv)
 
     import seist_tpu
-    from seist_tpu.data.packed import pack_dataset
-    from seist_tpu.registry import DATASETS
+    from seist_tpu.data.packed import PackSource, pack_sources
 
     seist_tpu.load_all()
-    src = DATASETS.create(
-        args.dataset,
-        seed=0,
-        mode="train",
-        data_dir=args.data_dir,
-        shuffle=False,
-        data_split=False,
+    ds_kwargs = json.loads(args.dataset_kwargs) if args.dataset_kwargs else {}
+    if args.mixture:
+        sources = [
+            PackSource(name=name, data_dir=d, dataset_kwargs=ds_kwargs)
+            for name, d in _parse_mixture(args.mixture)
+        ]
+    else:
+        sources = [
+            PackSource(
+                name=args.dataset,
+                data_dir=args.data_dir,
+                dataset_kwargs=ds_kwargs,
+            )
+        ]
+    stats = pack_sources(
+        sources,
+        args.out,
+        num_workers=args.workers,
+        samples_per_shard=args.samples_per_shard or None,
+        shard_mb=args.shard_mb,
+        resume=not args.no_resume,
     )
-    t0 = time.perf_counter()
-    pack_dataset(src, args.out, shard_mb=args.shard_mb)
-    print(
-        f"packed {len(src)} events in {time.perf_counter() - t0:.1f}s "
-        f"-> {args.out}"
-    )
+    stats["workers"] = args.workers
+    print(json.dumps(stats))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
