@@ -6,7 +6,7 @@ CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
 .PHONY: native test t1 lint lint-baseline irlint-report lockgraph \
 	serve-smoke serve-chaos obs-smoke trace-smoke rollout-smoke chaos \
-	pack-smoke bench-loader clean
+	pack-smoke bench-loader repick-smoke bench-repick clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -87,6 +87,22 @@ pack-smoke:
 # Gate: direct >= 2x hdf5. The committed headline is BENCH_loader_r01.json.
 bench-loader:
 	JAX_PLATFORMS=cpu python -m tools.bench_loader --compare
+
+# Batch re-picking smoke (docs/DATA.md "Batch re-picking"): 2-worker
+# CPU map-reduce over a synthetic packed archive — one worker SIGKILL'd
+# mid-shard, relaunched at its exact segment offset — asserting the
+# merged catalog is BYTE-identical to a serial run and that every
+# worker's CompileBudget window recorded ZERO compiles after warm-up.
+# One JSON verdict line; non-zero on any violation.
+repick-smoke:
+	JAX_PLATFORMS=cpu python -m tools.repick_smoke
+
+# Batch-vs-serve throughput headline (docs/DATA.md "Batch re-picking"):
+# the repick engine and tools/bench_serve on the SAME model/window/host,
+# gated at batch >= 5x serve waveforms/sec/chip. Committed headline:
+# BENCH_repick_r01.json.
+bench-repick:
+	JAX_PLATFORMS=cpu python -m tools.bench_repick
 
 # Telemetry-plane smoke (docs/OBSERVABILITY.md): 2-step CPU train run
 # with --metrics-port, live Prometheus/JSON/flight scrape, then an

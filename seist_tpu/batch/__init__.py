@@ -1,0 +1,26 @@
+"""Batch (archive-scale) inference: the throughput-bound twin of serve/.
+
+Layout:
+
+    seist_tpu.batch.catalog    deterministic work units over a packed
+                               archive + segment-committed, resumable,
+                               byte-identical catalog output (the PR 14
+                               plan-first/sidecar-commit pattern applied
+                               to OUTPUTS)
+    seist_tpu.batch.engine     straight-line device feed: double-buffered
+                               PackedRawStore fills against ONE AOT
+                               multi-batch executable (trunk-once head
+                               fan-out for groups), batched decode ->
+                               catalog rows
+
+CLI: ``python -m tools.repick_archive`` (map-reduce driver/worker/merge);
+``make repick-smoke`` pins the kill/resume byte-identity and the
+zero-compile-after-warm-up gate. See docs/DATA.md "Batch re-picking".
+"""
+
+from seist_tpu.batch.catalog import (  # noqa: F401
+    WorkUnit,
+    merge_catalog,
+    plan_units,
+)
+from seist_tpu.batch.engine import RepickEngine  # noqa: F401

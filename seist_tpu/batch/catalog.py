@@ -1,0 +1,215 @@
+"""Repick catalog: deterministic work units + segment-committed output.
+
+The batch-inference engine (seist_tpu/batch/engine.py) is a map-reduce
+over a packed archive (data/packed.py). This module owns the MAP side's
+addressing and the REDUCE side's merge — the plan-first / sidecar-commit
+pattern PR 14 built for packing, applied to OUTPUTS:
+
+* **Work unit** = one packed shard's index rows ``[row_lo, row_hi)`` in
+  pack order. :func:`plan_units` is a pure function of the archive's
+  index — never of worker count or of what output already exists — so
+  any worker layout produces the identical unit list.
+* **Segment** = ``commit_every`` consecutive device calls of one unit
+  (a call is ``batches_per_call x batch_size`` rows). Each segment's
+  catalog rows are written to ``unit_XXXXX.seg_XXXX.jsonl`` via
+  tmp+rename: the rename is the commit point, so a SIGKILL at any
+  instant leaves either a complete segment or a resumable hole, and
+  :func:`first_missing_segment` restarts a worker at its exact offset.
+* **Plan identity** — ``repick_plan.json`` records everything that
+  determines segment boundaries and row content (batch geometry, model,
+  variant, thresholds). Workers refuse to resume into an output
+  directory whose plan differs (same rule as the packer's sidecar plan
+  identity: a geometry change must restart, never silently mix).
+* **Merge** — segments concatenated in (unit, segment) order into
+  ``catalog.jsonl``; ``catalog_meta.json`` is written LAST (a directory
+  without it is an incomplete catalog). Because every row is a pure
+  function of (archive, plan), the merged catalog is byte-identical
+  across worker counts and across kill/resume histories — ``make
+  repick-smoke`` pins this.
+
+Rows are compact JSON objects, one per waveform, sorted keys (see
+ops/results.catalog_rows and docs/DATA.md "Batch re-picking").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+_PLAN = "repick_plan.json"
+_CATALOG = "catalog.jsonl"
+_CATALOG_META = "catalog_meta.json"
+_SEG_RE = re.compile(r"^unit_(\d{5})\.seg_(\d{4})\.jsonl$")
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkUnit:
+    """One packed shard's rows ``[row_lo, row_hi)`` (pack index order)."""
+
+    unit_id: int  # == packed shard id
+    row_lo: int
+    row_hi: int
+
+    @property
+    def n(self) -> int:
+        return self.row_hi - self.row_lo
+
+
+def plan_units(shards_col: np.ndarray) -> List[WorkUnit]:
+    """The deterministic unit partition from the archive index's
+    ``shard`` column (rows of one shard are contiguous in pack order —
+    the index is merged sidecar-by-sidecar)."""
+    shards_col = np.asarray(shards_col, np.int64)
+    if shards_col.size == 0:
+        return []
+    if (np.diff(shards_col) < 0).any():
+        raise ValueError(
+            "archive index 'shard' column is not in pack order; refusing "
+            "to plan work units over a reordered index"
+        )
+    units: List[WorkUnit] = []
+    ids, starts = np.unique(shards_col, return_index=True)
+    bounds = list(starts) + [shards_col.size]
+    for i, uid in enumerate(ids):
+        units.append(WorkUnit(int(uid), int(bounds[i]), int(bounds[i + 1])))
+    return units
+
+
+# ------------------------------------------------------------- segment math
+def calls_per_unit(unit: WorkUnit, rows_per_call: int) -> int:
+    return -(-unit.n // rows_per_call)
+
+
+def segments_per_unit(
+    unit: WorkUnit, rows_per_call: int, commit_every: int
+) -> int:
+    return -(-calls_per_unit(unit, rows_per_call) // commit_every)
+
+
+def segment_path(out_dir: str, unit_id: int, seg: int) -> str:
+    return os.path.join(out_dir, f"unit_{unit_id:05d}.seg_{seg:04d}.jsonl")
+
+
+def commit_segment(
+    out_dir: str, unit_id: int, seg: int, lines: Sequence[str]
+) -> str:
+    """Atomically commit one segment's catalog rows (tmp+rename; the pid
+    suffix keeps two workers erroneously owning the same unit from
+    corrupting each other's tmp — last rename wins with identical
+    content, since rows are a pure function of the plan)."""
+    path = segment_path(out_dir, unit_id, seg)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write("".join(lines))
+    os.replace(tmp, path)
+    return path
+
+
+def first_missing_segment(
+    out_dir: str, unit: WorkUnit, rows_per_call: int, commit_every: int
+) -> int:
+    """Resume point: the first segment of ``unit`` with no committed
+    file. Returns ``segments_per_unit`` when the unit is complete.
+    Committed files are trusted (the rename only ever publishes whole
+    segments); holes after a committed segment are repacked from the
+    hole on — later segments are redundant work at worst, never wrong
+    (their content is deterministic)."""
+    total = segments_per_unit(unit, rows_per_call, commit_every)
+    for seg in range(total):
+        if not os.path.exists(segment_path(out_dir, unit.unit_id, seg)):
+            return seg
+    return total
+
+
+# --------------------------------------------------------------- plan file
+def write_or_check_plan(out_dir: str, plan: Dict[str, Any]) -> None:
+    """Create ``repick_plan.json`` (atomic) or validate the existing one
+    matches — the resume geometry guard. Two workers racing the create
+    write identical bytes, so either rename is correct."""
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, _PLAN)
+    blob = json.dumps(plan, sort_keys=True)
+    if os.path.exists(path):
+        with open(path) as f:
+            existing = f.read()
+        if existing != blob:
+            raise ValueError(
+                f"output dir {out_dir} holds a catalog built under a "
+                "different plan (batch geometry / model / variant / "
+                "thresholds changed); resume would mix incompatible "
+                "segments — use a fresh --out or delete the directory"
+            )
+        return
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+def read_plan(out_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(out_dir, _PLAN)) as f:
+        return json.load(f)
+
+
+# ------------------------------------------------------------------- merge
+def merge_catalog(
+    out_dir: str,
+    units: Sequence[WorkUnit],
+    rows_per_call: int,
+    commit_every: int,
+    *,
+    meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Reduce step: concatenate every unit's segments in (unit, segment)
+    order into ``catalog.jsonl`` (tmp+rename), then commit
+    ``catalog_meta.json`` LAST. Refuses loudly while any segment is
+    missing (a partial merge would look complete)."""
+    missing: List[str] = []
+    for unit in units:
+        total = segments_per_unit(unit, rows_per_call, commit_every)
+        for seg in range(total):
+            if not os.path.exists(segment_path(out_dir, unit.unit_id, seg)):
+                missing.append(f"unit {unit.unit_id} seg {seg}")
+    if missing:
+        raise FileNotFoundError(
+            f"catalog merge: {len(missing)} segment(s) not committed yet "
+            f"(first: {missing[0]}) — finish or resume the workers first"
+        )
+    cat_path = os.path.join(out_dir, _CATALOG)
+    tmp = f"{cat_path}.tmp.{os.getpid()}"
+    n_rows = 0
+    with open(tmp, "w") as f:
+        for unit in units:
+            total = segments_per_unit(unit, rows_per_call, commit_every)
+            for seg in range(total):
+                with open(
+                    segment_path(out_dir, unit.unit_id, seg)
+                ) as seg_f:
+                    for line in seg_f:
+                        f.write(line)
+                        n_rows += 1
+    os.replace(tmp, cat_path)
+    out_meta = dict(meta or {})
+    out_meta.update({
+        "n_rows": n_rows,
+        "n_units": len(units),
+        "catalog": _CATALOG,
+    })
+    meta_tmp = os.path.join(out_dir, _CATALOG_META + f".tmp.{os.getpid()}")
+    with open(meta_tmp, "w") as f:
+        json.dump(out_meta, f, sort_keys=True)
+    os.replace(meta_tmp, os.path.join(out_dir, _CATALOG_META))
+    return out_meta
+
+
+def catalog_paths(out_dir: str) -> Dict[str, str]:
+    return {
+        "catalog": os.path.join(out_dir, _CATALOG),
+        "meta": os.path.join(out_dir, _CATALOG_META),
+        "plan": os.path.join(out_dir, _PLAN),
+    }

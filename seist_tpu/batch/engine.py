@@ -1,0 +1,596 @@
+"""Planetary-archive batch inference: straight-line device feed.
+
+The serving path answers one trace in milliseconds; this engine answers
+the opposite traffic shape — re-pick an entire packed archive when a
+model improves (billions of windows, purely throughput-bound). It is
+deliberately NOT a client of the serving stack: no HTTP, no
+micro-batcher, no per-request decode. Per work unit (one packed shard,
+seist_tpu/batch/catalog.py) it runs the train loop's feed discipline
+against serving's AOT executables:
+
+* **fill** — :class:`~seist_tpu.data.ingest.PackedRawStore` batch fills
+  (one memcpy per sample from the shard memmap into a staging slab, the
+  PR 14 direct-ingest lane) on a producer thread, double-buffered
+  through ``pipeline._double_buffer`` so the host read overlaps the
+  device compute; io_guard fault semantics (retry / quarantine with
+  deterministic ``(seed=0, epoch=0, row)``-keyed replacement) carry
+  over unchanged, which keeps resume byte-identical even through
+  injected corruption;
+* **device** — ONE ahead-of-time-compiled executable per engine
+  (``serve/aot.aot_compile_multi``): ``batches_per_call`` full batches
+  enter with a leading step axis and ``lax.map`` runs normalize ->
+  trunk -> heads entirely in-program — the PR 10 trunk-once fan-out for
+  groups, the ``steps_per_call`` idea from the train loop for dispatch
+  — so host Python touches the critical path once per K batches and
+  post-warm-up traffic can never trigger an XLA compile
+  (``CompileBudget`` gate, ``make repick-smoke``);
+* **decode** — batched ``ops/postprocess.decode_head_batch`` (the same
+  compiled pick/detect programs eval and serve use) + ONE
+  ``jax.device_get`` per call, then ``ops/results.catalog_rows``;
+* **write** — rows committed per segment via catalog.commit_segment
+  (tmp+rename), the resume granularity.
+
+Variants: the engine compiles its program per the serving weight
+conventions (``aot.variant_compute`` / ``transform_variables``) and
+non-fp32 variants are parity-gated at load against the engine's own
+fp32 program — disable, don't re-pick wrong.
+
+Observability: ``batch_infer_batches/waveforms/bytes`` counters,
+``batch_infer_fill/device/decode/write`` spans, and prefetch
+backpressure (``batch_infer_backpressure_s``) on the obs bus; the same
+stage budget is accumulated locally for the BENCH ``step_breakdown``.
+
+Chaos: ``SEIST_FAULT_REPICK_SLOW_MS`` sleeps that long per device call
+(the smoke lane uses it to land a SIGKILL mid-shard deterministically).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from seist_tpu.batch import catalog
+from seist_tpu.ops.postprocess import decode_head_batch
+from seist_tpu.ops.results import catalog_row_lines, catalog_rows
+from seist_tpu.serve import aot
+from seist_tpu.utils.logger import logger
+
+#: Decode thresholds (serve/protocol.PredictOptions defaults, restated
+#: here so the engine does not import the serving wire layer).
+DEFAULT_DECODE = {
+    "ppk_threshold": 0.3,
+    "spk_threshold": 0.3,
+    "det_threshold": 0.5,
+    "min_peak_dist": 1.0,
+    "max_events": 8,
+}
+
+
+def _block(out: Any) -> None:
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        getattr(leaf, "block_until_ready", lambda: None)()
+
+
+class RepickEngine:
+    """One worker's archive re-picking loop: a loaded pool entry
+    (ModelEntry or MultiTaskEntry) driven at full batch straight off a
+    :class:`~seist_tpu.data.ingest.PackedRawStore`."""
+
+    def __init__(
+        self,
+        entry: Any,
+        store: Any,
+        *,
+        sampling_rate: int,
+        batch_size: int = 64,
+        batches_per_call: int = 4,
+        variant: str = "fp32",
+        decode_opts: Optional[Dict[str, Any]] = None,
+        keys: Optional[Sequence[str]] = None,
+        prefetch: int = 2,
+        tasks: Optional[Sequence[str]] = None,
+    ) -> None:
+        if entry.window != store.raw_len:
+            raise ValueError(
+                f"model window {entry.window} != archive trace length "
+                f"{store.raw_len}; the repick engine feeds one archive "
+                "row per window (load the entry with window=raw_len)"
+            )
+        if entry.in_channels != store.n_ch:
+            raise ValueError(
+                f"model wants {entry.in_channels} channels, archive has "
+                f"{store.n_ch}"
+            )
+        if variant not in aot.VARIANTS:
+            raise ValueError(
+                f"unknown variant {variant!r}; use one of {aot.VARIANTS}"
+            )
+        self.entry = entry
+        self.store = store
+        self.sampling_rate = int(sampling_rate)
+        self.batch_size = int(batch_size)
+        self.batches_per_call = int(batches_per_call)
+        self.rows_per_call = self.batch_size * self.batches_per_call
+        self.variant = variant
+        self.decode_opts = {**DEFAULT_DECODE, **(decode_opts or {})}
+        self.keys = np.asarray(keys) if keys is not None else None
+        self.prefetch = int(prefetch)
+        self.tasks = (
+            tuple(tasks)
+            if tasks is not None
+            else (tuple(entry.tasks) if entry.is_group else (entry.name,))
+        )
+        if entry.is_group:
+            unknown = [t for t in self.tasks if t not in entry.heads]
+            if unknown:
+                raise ValueError(
+                    f"group '{entry.name}' does not serve tasks {unknown}; "
+                    f"available: {list(entry.tasks)}"
+                )
+        self._program: Optional[aot.AotProgram] = None
+        self._warm = False
+        self._slow_ms = float(
+            os.environ.get("SEIST_FAULT_REPICK_SLOW_MS", "0") or 0
+        )
+        self.stage = {"fill": 0.0, "device": 0.0, "decode": 0.0, "write": 0.0}
+        self.warmup_report: Dict[str, Any] = {}
+        from seist_tpu.obs.bus import BUS
+
+        self._c_batches = BUS.counter("batch_infer_batches")
+        self._c_calls = BUS.counter("batch_infer_calls")
+        self._c_waveforms = BUS.counter("batch_infer_waveforms")
+        self._c_bytes = BUS.counter("batch_infer_bytes")
+
+    # ------------------------------------------------------------ programs
+    def _prep_fn(self):
+        """In-trace input prep: raw (B, C, L) float32 -> normalized
+        channels-last (B, L, C) — the same 'std' z-score the serving
+        path applies host-side (preprocess.normalize, zero std divides
+        by 1), moved on device so the host fill stays a pure memcpy."""
+        import jax.numpy as jnp
+
+        def prep(raw):
+            x = raw - jnp.mean(raw, axis=2, keepdims=True)
+            std = jnp.std(raw, axis=2, keepdims=True)
+            x = x / jnp.where(std == 0, 1.0, std)
+            return jnp.transpose(x, (0, 2, 1))
+
+        return prep
+
+    def _step_fn(self, variant: str):
+        """One micro-batch's full program body: prep -> forward (single
+        model) or prep -> trunk -> requested heads (group fan-out) under
+        the serving variant conventions (aot.variant_compute /
+        head_variant_compute + eager transform_variables, so the
+        executable holds the variant's weights at rest)."""
+        prep = self._prep_fn()
+        entry = self.entry
+        if not entry.is_group:
+            compute = aot.variant_compute(
+                lambda v, x: entry.model.apply(v, x, train=False), variant
+            )
+            tv = aot.transform_variables(entry.variables, variant)
+            task = self.tasks[0]
+
+            def step(raw):
+                x = prep(raw)
+                return {task: compute(tv, x)}
+
+            return step
+
+        from seist_tpu.models.seist import backbone_apply
+
+        trunk_compute = aot.variant_compute(
+            lambda v, x: backbone_apply(entry.trunk_model, v, x),
+            variant,
+            cast_outputs=False,  # bf16 features flow to bf16 heads
+        )
+        trunk_v = aot.transform_variables(entry.trunk_variables, variant)
+        head_computes = {
+            t: aot.head_variant_compute(entry.heads[t].model, variant)
+            for t in self.tasks
+        }
+        head_vs = {
+            t: aot.transform_variables(entry.heads[t].variables, variant)
+            for t in self.tasks
+        }
+
+        def step(raw):
+            x = prep(raw)
+            feats = trunk_compute(trunk_v, x)
+            return {t: head_computes[t](head_vs[t], feats, x) for t in self.tasks}
+
+        return step
+
+    def _compile(self, variant: str) -> aot.AotProgram:
+        key = (
+            f"repick/{self.entry.name}/b{self.batch_size}"
+            f"x{self.batches_per_call}/{variant}"
+        )
+        return aot.aot_compile_multi(
+            key,
+            self._step_fn(variant),
+            [((self.batch_size, self.store.n_ch, self.store.raw_len),
+              np.float32)],
+            steps=self.batches_per_call,
+            model=self.entry.name,
+        )
+
+    def warmup(self) -> Dict[str, Any]:
+        """Compile the full-batch program (parity-gating non-fp32
+        variants against the engine's own fp32 program) and push one
+        synthetic call through the COMPLETE path — forward, pick/detect
+        decode programs, device_get — so nothing compiles after this
+        returns (the CompileBudget gate's contract)."""
+        from seist_tpu.obs.bus import monotonic
+
+        t0 = monotonic()
+        program = self._compile(self.variant)
+        if self.variant != "fp32":
+            ref_prog = self._compile("fp32")
+            self._gate_variant(ref_prog, program)
+        self._program = program
+        # One call end-to-end: warms pick_peaks/detect_events at the
+        # decode shape and proves the executable answers.
+        x = np.zeros(
+            (self.batches_per_call, self.batch_size, self.store.n_ch,
+             self.store.raw_len),
+            np.float32,
+        )
+        out = program(x)
+        _block(out)
+        self._decode_call(out, n_valid=1, row_lo=0)
+        self._warm = True
+        self.stage = {k: 0.0 for k in self.stage}
+        self.warmup_report = {
+            "program": program.key,
+            "compile_ms": round(program.compile_ms, 1),
+            "flops_per_call": program.flops,
+            "warmup_s": round(monotonic() - t0, 2),
+        }
+        logger.info(
+            f"[repick] aot {program.key} ({program.compile_ms:.0f} ms, "
+            f"{program.flops:.3g} flops/call)"
+        )
+        return self.warmup_report
+
+    def _gate_variant(
+        self, ref_prog: aot.AotProgram, var_prog: aot.AotProgram
+    ) -> None:
+        """Decision-level parity of the variant program against fp32 on
+        a deterministic probe — per head for groups. A failing head
+        refuses the run (re-picking an archive wrong is strictly worse
+        than re-picking it slower)."""
+        import jax
+
+        rng = np.random.default_rng(0)
+        probe = rng.standard_normal(
+            (self.batches_per_call, self.batch_size, self.store.n_ch,
+             self.store.raw_len)
+        ).astype(np.float32)
+        ref = jax.device_get(ref_prog(probe))
+        out = jax.device_get(var_prog(probe))
+        failed = []
+        for task in self.tasks:
+            spec = (
+                self.entry.heads[task].spec
+                if self.entry.is_group
+                else self.entry.spec
+            )
+            # head_scale lives on the TaskHead for groups but on the
+            # MODEL for single-task entries (serve/pool._gate_variants
+            # reads it the same way).
+            scale_owner = (
+                self.entry.heads[task]
+                if self.entry.is_group
+                else self.entry.model
+            )
+            kind, _ = aot.parity_kind(spec)
+            scale = float(getattr(scale_owner, "head_scale", 1.0) or 1.0)
+            a = _first_leaf(ref[task])
+            b = _first_leaf(out[task])
+            ok, err = aot.variant_parity(
+                a, b, self.variant, kind=kind, scale=scale
+            )
+            logger.info(
+                f"[repick] variant gate {self.entry.name}/{task}/"
+                f"{self.variant}: {'ok' if ok else 'FAILED'} "
+                f"(err={err:.2g}, {kind})"
+            )
+            if not ok:
+                failed.append(task)
+        if failed:
+            raise RuntimeError(
+                f"variant '{self.variant}' failed the parity gate for "
+                f"task(s) {failed} — refusing to re-pick the archive "
+                "with divergent outputs (run fp32, or fix the variant)"
+            )
+
+    # -------------------------------------------------------------- decode
+    def _decode_call(
+        self, out: Any, *, n_valid: int, row_lo: int
+    ) -> List[Dict[str, Any]]:
+        import jax
+
+        n_rows = self.rows_per_call
+        flat = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_rows,) + a.shape[2:]), out
+        )
+        decoded = {}
+        for task in self.tasks:
+            spec = (
+                self.entry.heads[task].spec
+                if self.entry.is_group
+                else self.entry.spec
+            )
+            is_picker = (
+                self.entry.heads[task].is_picker
+                if self.entry.is_group
+                else self.entry.is_picker
+            )
+            decoded[task] = decode_head_batch(
+                spec,
+                flat[task],
+                is_picker=is_picker,
+                sampling_rate=self.sampling_rate,
+                **self.decode_opts,
+            )
+        # ONE device->host round trip for every head's results (the
+        # Metrics.to_dict batched-get idiom); catalog_rows then slices
+        # plain host arrays.
+        decoded = jax.device_get(decoded)
+        row_ids = np.arange(row_lo, row_lo + n_valid, dtype=np.int64)
+        keys = (
+            self.keys[row_lo : row_lo + n_valid]
+            if self.keys is not None
+            else None
+        )
+        return catalog_rows(
+            decoded, n_valid=n_valid, row_ids=row_ids, keys=keys
+        )
+
+    # ---------------------------------------------------------------- feed
+    def _fill_calls(
+        self,
+        unit: catalog.WorkUnit,
+        start_call: int,
+        stop_event: Optional[threading.Event],
+        abort: Optional[threading.Event] = None,
+    ):
+        """Producer-side call feed: one PackedRawStore staging fill per
+        device call, reshaped (free) to the program's (k, B, C, L). The
+        tail call pads by repeating the last row — padding is a pure
+        function of the plan, so resume stays byte-identical; decode
+        drops pad rows via n_valid."""
+        from seist_tpu.obs.bus import BUS, monotonic
+
+        n_calls = catalog.calls_per_unit(unit, self.rows_per_call)
+        for c in range(start_call, n_calls):
+            if stop_event is not None and stop_event.is_set():
+                return
+            if abort is not None and abort.is_set():
+                return
+            lo = unit.row_lo + c * self.rows_per_call
+            hi = min(lo + self.rows_per_call, unit.row_hi)
+            ids = np.arange(lo, hi, dtype=np.int64)
+            n_valid = ids.size
+            if n_valid < self.rows_per_call:
+                ids = np.concatenate(
+                    [ids, np.repeat(ids[-1], self.rows_per_call - n_valid)]
+                )
+            t0 = monotonic()
+            with BUS.span("batch_infer_fill"):
+                rows = self.store.row_batch_at(ids, epoch=0, idx=ids)
+                x = rows["data"].reshape(
+                    self.batches_per_call,
+                    self.batch_size,
+                    self.store.n_ch,
+                    self.store.raw_len,
+                )
+            yield c, x, n_valid, lo, monotonic() - t0
+
+    @staticmethod
+    def _put(item):
+        """Double-buffer transform: start the host->device copy of the
+        staged slab ahead of the consumer (async on accelerators; on CPU
+        device_put may alias, which is safe here because CPU staging
+        slabs are fresh per fill — ingest.py's reuse_staging auto rule)."""
+        import jax
+
+        c, x, n_valid, lo, fill_s = item
+        return c, jax.device_put(x), n_valid, lo, fill_s
+
+    # ----------------------------------------------------------------- run
+    def run_unit(
+        self,
+        unit: catalog.WorkUnit,
+        out_dir: str,
+        *,
+        commit_every: int = 4,
+        stop_event: Optional[threading.Event] = None,
+    ) -> Dict[str, Any]:
+        """Re-pick one work unit, committing a segment every
+        ``commit_every`` device calls; resumes at the first missing
+        segment. Returns per-unit stats. ``stop_event`` (SIGTERM) is
+        honored at segment boundaries — the current segment commits,
+        later ones stay holes for the resume."""
+        from seist_tpu.data.pipeline import _double_buffer
+        from seist_tpu.obs.bus import BUS, monotonic
+
+        if not self._warm:
+            self.warmup()
+        n_calls = catalog.calls_per_unit(unit, self.rows_per_call)
+        total_seg = catalog.segments_per_unit(
+            unit, self.rows_per_call, commit_every
+        )
+        start_seg = catalog.first_missing_segment(
+            out_dir, unit, self.rows_per_call, commit_every
+        )
+        stats = {
+            "unit": unit.unit_id,
+            "rows": 0,
+            "calls": 0,
+            "segments": 0,
+            "segments_skipped": start_seg,
+            "preempted": False,
+        }
+        if start_seg >= total_seg:
+            return stats
+        # The engine's own stop flag rides alongside the caller's: set
+        # in the finally-drain so a consumer-side exception halts the
+        # producer at its next fill instead of letting it read/device_put
+        # the whole remaining unit while the error waits to propagate.
+        abort = threading.Event()
+        gen = _double_buffer(
+            self._fill_calls(
+                unit, start_seg * commit_every, stop_event, abort
+            ),
+            self._put,
+            self.prefetch,
+            account="batch_infer",
+        )
+        lines: List[str] = []
+        seg = start_seg
+        try:
+            for c, x_dev, n_valid, row_lo, fill_s in gen:
+                self.stage["fill"] += fill_s
+                if self._slow_ms:
+                    time.sleep(self._slow_ms / 1e3)
+                t0 = monotonic()
+                with BUS.span("batch_infer_device"):
+                    out = self._program(x_dev)
+                    _block(out)
+                self.stage["device"] += monotonic() - t0
+                t0 = monotonic()
+                with BUS.span("batch_infer_decode"):
+                    rows = self._decode_call(
+                        out, n_valid=n_valid, row_lo=row_lo
+                    )
+                    lines.extend(catalog_row_lines(rows))
+                self.stage["decode"] += monotonic() - t0
+                self._c_calls.inc()
+                self._c_batches.inc(self.batches_per_call)
+                self._c_waveforms.inc(n_valid)
+                self._c_bytes.inc(n_valid * self.store.row_nbytes)
+                stats["rows"] += n_valid
+                stats["calls"] += 1
+                if (c + 1) == min((seg + 1) * commit_every, n_calls):
+                    t0 = monotonic()
+                    with BUS.span("batch_infer_write"):
+                        catalog.commit_segment(
+                            out_dir, unit.unit_id, seg, lines
+                        )
+                    self.stage["write"] += monotonic() - t0
+                    lines = []
+                    seg += 1
+                    stats["segments"] += 1
+                    if stop_event is not None and stop_event.is_set():
+                        stats["preempted"] = True
+                        break
+        finally:
+            # A preempted/aborted consumer must drain the bounded queue
+            # so the producer thread can observe the stop and exit (at
+            # most `prefetch` already-filled items — cheap, BECAUSE the
+            # abort flag stops further fills first).
+            abort.set()
+            for _ in gen:
+                pass
+        if (
+            stats["calls"] < n_calls - start_seg * commit_every
+            and not stats["preempted"]
+        ):
+            # The producer stopped early (stop_event raced a fill — at
+            # worst mid-segment, whose partial rows are discarded; the
+            # resume recomputes the whole segment, keeping segment
+            # content pure). The unit is NOT complete and must say so.
+            stats["preempted"] = True
+        return stats
+
+    def run_units(
+        self,
+        units: Sequence[catalog.WorkUnit],
+        out_dir: str,
+        *,
+        commit_every: int = 4,
+        stop_event: Optional[threading.Event] = None,
+        compile_gate: bool = False,
+        progress: Optional[Any] = None,  # train.checkpoint.ProgressFile
+    ) -> Dict[str, Any]:
+        """Re-pick a worker's unit list. With ``compile_gate`` the whole
+        post-warm-up loop runs inside a ``CompileBudget`` window (the
+        jaxlint runtime monitor) and the stats report how many traces /
+        XLA compiles it saw — the acceptance gate pins ZERO."""
+        from seist_tpu.obs.bus import monotonic
+
+        if not self._warm:
+            self.warmup()
+        budget = None
+        if compile_gate:
+            from tools.jaxlint.runtime import CompileBudget
+
+            budget = CompileBudget()
+        t0 = monotonic()
+        stats: Dict[str, Any] = {
+            "units": 0, "units_skipped": 0, "rows": 0, "calls": 0,
+            "segments": 0, "segments_skipped": 0, "preempted": False,
+        }
+        ctx = budget if budget is not None else _NullCtx()
+        with ctx:
+            for unit in units:
+                u = self.run_unit(
+                    unit, out_dir, commit_every=commit_every,
+                    stop_event=stop_event,
+                )
+                stats["rows"] += u["rows"]
+                stats["calls"] += u["calls"]
+                stats["segments"] += u["segments"]
+                stats["segments_skipped"] += u["segments_skipped"]
+                if u["rows"] == 0 and u["segments_skipped"]:
+                    stats["units_skipped"] += 1
+                else:
+                    stats["units"] += 1
+                if progress is not None:
+                    progress.save({
+                        "unit": unit.unit_id,
+                        "next_segment": u["segments_skipped"] + u["segments"],
+                        "preempted": u["preempted"],
+                        **{k: stats[k] for k in ("rows", "calls", "segments")},
+                    })
+                if u["preempted"]:
+                    stats["preempted"] = True
+                    break
+        wall = monotonic() - t0
+        stats["wall_s"] = round(wall, 3)
+        stats["waveforms_per_sec"] = (
+            round(stats["rows"] / wall, 2) if wall > 0 else 0.0
+        )
+        stats["stage_seconds"] = {
+            k: round(v, 3) for k, v in self.stage.items()
+        }
+        if stats["rows"]:
+            stats["stage_ms_per_wf"] = {
+                k: round(v * 1e3 / stats["rows"], 4)
+                for k, v in self.stage.items()
+            }
+        if budget is not None:
+            stats["compiles_after_warmup"] = budget.total("")
+            stats["xla_compiles_after_warmup"] = budget.backend_compiles
+        return stats
+
+
+class _NullCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _first_leaf(out: Any) -> Any:
+    return out[0] if isinstance(out, (tuple, list)) else out

@@ -91,6 +91,7 @@ class PackedRawStore(RawStore):
         batch_size: int = 0,
         prefetch: int = 2,
         reuse_staging: Optional[bool] = None,
+        storage_dtype: Optional[np.dtype] = None,
     ) -> None:
         super().__init__(
             arrays,
@@ -100,7 +101,15 @@ class PackedRawStore(RawStore):
             phase_slots=phase_slots,
         )
         self.n_ch = int(n_ch)
-        self.row_nbytes = self.n_ch * self.raw_len * 4
+        # On-disk dtype (bf16 shard variants halve the read bandwidth);
+        # fills upcast into the float32 staging slab, so everything
+        # downstream of the fill stays dtype-blind.
+        self.storage_dtype = (
+            np.dtype(storage_dtype)
+            if storage_dtype is not None
+            else np.dtype(np.float32)
+        )
+        self.row_nbytes = self.n_ch * self.raw_len * self.storage_dtype.itemsize
         self._data_dir = data_dir
         self._shards = np.asarray(shards, np.int64)
         self._offsets = np.asarray(offsets, np.int64)
@@ -273,6 +282,7 @@ class PackedRawStore(RawStore):
             batch_size=batch_size,
             prefetch=prefetch,
             reuse_staging=reuse_staging,
+            storage_dtype=ds.storage_dtype,
         )
 
     # ---------------------------------------------------------- raw read
@@ -290,7 +300,9 @@ class PackedRawStore(RawStore):
             self.row_nbytes,
             desc=f"packed.direct (sample {r})",
         )
-        out[...] = np.frombuffer(raw, np.float32).reshape(
+        # Cast-assignment upcasts bf16 shard variants in place (no
+        # intermediate copy); f32 packs keep the plain memcpy.
+        out[...] = np.frombuffer(raw, self.storage_dtype).reshape(
             self.n_ch, self.raw_len
         )
         if validate and not np.isfinite(out).all():

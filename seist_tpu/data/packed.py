@@ -78,10 +78,39 @@ _INT_FIELDS = frozenset({"ppks", "spks", "pmp", "clr"})
 # Sidecar/index column dtypes (keys excluded; they stay str).
 _INT_COLS = (
     "shard", "offset", "n_ch", "n_samp", "source_id",
-    "total_bytes", "plan_lo", "plan_hi",
+    "total_bytes", "plan_lo", "plan_hi", "storage_itemsize",
 )
 # Per-shard bookkeeping columns that never reach the merged index.
-_SIDECAR_ONLY = ("total_bytes", "plan_lo", "plan_hi")
+_SIDECAR_ONLY = ("total_bytes", "plan_lo", "plan_hi", "storage_itemsize")
+
+# On-disk waveform storage dtypes (format v2 ``meta.json["dtype"]``).
+# float32 is the training-parity default; bfloat16 halves the shard
+# bytes (and therefore read bandwidth) for inference-only archives —
+# readers upcast to float32 on fill, so every consumer downstream of the
+# read stays dtype-blind (the ROADMAP "quantized shard variants" item).
+_DTYPE_ALIASES = {"fp32": "float32", "bf16": "bfloat16"}
+
+
+def canonical_dtype(name: str) -> str:
+    name = _DTYPE_ALIASES.get(str(name).lower(), str(name).lower())
+    if name not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"unsupported packed storage dtype '{name}' "
+            "(use float32 or bfloat16)"
+        )
+    return name
+
+
+def storage_dtype(name: str) -> np.dtype:
+    """Resolve a pack's on-disk waveform dtype. bfloat16 comes from
+    ml_dtypes (a jax dependency), which registers it as a real numpy
+    dtype — memmap slices / frombuffer / cast-assignment all work."""
+    name = canonical_dtype(name)
+    if name == "float32":
+        return np.dtype(np.float32)
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
 
 
 def shard_path(out_dir: str, shard_id: int) -> str:
@@ -121,6 +150,7 @@ def plan_shards(
     *,
     samples_per_shard: Optional[int] = None,
     shard_mb: float = 512,
+    dtype: str = "float32",
 ) -> Tuple[List[ShardPlan], List[int]]:
     """The deterministic shard partition: a pure function of the source
     lengths and the capacity knobs — NEVER of worker count or of which
@@ -139,9 +169,10 @@ def plan_shards(
             caps.append(max(1, int(samples_per_shard)))
             continue
         event0, _ = src[0]
-        nbytes0 = np.ascontiguousarray(
-            event0["data"], dtype=np.float32
-        ).nbytes
+        nbytes0 = (
+            np.ascontiguousarray(event0["data"], dtype=np.float32).size
+            * storage_dtype(dtype).itemsize
+        )
         caps.append(_samples_per_shard(nbytes0, shard_mb))
     plans: List[ShardPlan] = []
     shard_id = 0
@@ -200,11 +231,14 @@ def _write_atomic_npz(path: str, cols: Dict[str, Any]) -> None:
     os.replace(tmp, path)
 
 
-def pack_shard(src, out_dir: str, plan: ShardPlan) -> Dict[str, int]:
+def pack_shard(
+    src, out_dir: str, plan: ShardPlan, *, dtype: str = "float32"
+) -> Dict[str, int]:
     """Pack ONE shard: the plan's sample range streamed into
     ``shard_XXXXX.bin`` (via a ``.tmp`` rename) followed by its sidecar —
     the sidecar rename is the shard-complete commit point, so a kill at
     any instant leaves either a complete shard or a resumable hole."""
+    store_dt = storage_dtype(dtype)
     cols = _new_cols()
     total = 0
     bin_path = shard_path(out_dir, plan.shard_id)
@@ -218,6 +252,8 @@ def pack_shard(src, out_dir: str, plan: ShardPlan) -> Dict[str, int]:
                     raise ValueError(
                         f"event {j}: data must be (C, L), got {data.shape}"
                     )
+                if store_dt != np.float32:
+                    data = data.astype(store_dt)
                 f.write(data.tobytes())
                 _append_sample(cols, event, row, j)
                 cols["offset"].append(total)
@@ -244,15 +280,21 @@ def pack_shard(src, out_dir: str, plan: ShardPlan) -> Dict[str, int]:
     # a source in place (docs/DATA.md).
     cols["plan_lo"] = [plan.lo]
     cols["plan_hi"] = [plan.hi]
+    # Storage dtype is part of the plan identity too: a resume that
+    # switches --dtype must repack, not silently mix itemsizes.
+    cols["storage_itemsize"] = [store_dt.itemsize]
     _write_atomic_npz(sidecar_path(out_dir, plan.shard_id), cols)
     return {"samples": plan.n, "bytes": total}
 
 
-def shard_complete(out_dir: str, plan: ShardPlan) -> bool:
+def shard_complete(
+    out_dir: str, plan: ShardPlan, *, dtype: str = "float32"
+) -> bool:
     """A shard is complete iff its sidecar exists, describes the plan's
-    sample count, and the ``.bin`` on disk has exactly the byte length
-    the sidecar recorded (a truncated bin from a crashed ``os.replace``
-    window or a re-plan with different capacity both fail this)."""
+    sample count AND storage dtype, and the ``.bin`` on disk has exactly
+    the byte length the sidecar recorded (a truncated bin from a crashed
+    ``os.replace`` window, a re-plan with different capacity, or a resume
+    with a different ``--dtype`` all fail this)."""
     side = sidecar_path(out_dir, plan.shard_id)
     bin_p = shard_path(out_dir, plan.shard_id)
     if not (os.path.exists(side) and os.path.exists(bin_p)):
@@ -264,6 +306,12 @@ def shard_complete(out_dir: str, plan: ShardPlan) -> bool:
             source_id = int(z["source_id"][0]) if n else plan.source_id
             lo = int(z["plan_lo"][0])
             hi = int(z["plan_hi"][0])
+            # Pre-dtype sidecars are all float32 packs.
+            itemsize = (
+                int(z["storage_itemsize"][0])
+                if "storage_itemsize" in z.files
+                else 4
+            )
     except (OSError, ValueError, KeyError, zipfile.BadZipFile):
         # A torn/garbled sidecar (np.load raises BadZipFile), or one
         # from a pre-plan-identity pack, is just an incomplete shard:
@@ -273,6 +321,7 @@ def shard_complete(out_dir: str, plan: ShardPlan) -> bool:
         n == plan.n
         and source_id == plan.source_id
         and (lo, hi) == (plan.lo, plan.hi)
+        and itemsize == storage_dtype(dtype).itemsize
         and os.path.getsize(bin_p) == total
     )
 
@@ -320,9 +369,11 @@ def _pack_pool_init(sources: List[PackSource]) -> None:
     _POOL_SOURCES = [s.create() for s in sources]
 
 
-def _pack_pool_shard(job: Tuple[str, ShardPlan]) -> Dict[str, int]:
-    out_dir, plan = job
-    return pack_shard(_POOL_SOURCES[plan.source_id], out_dir, plan)
+def _pack_pool_shard(job: Tuple[str, ShardPlan, str]) -> Dict[str, int]:
+    out_dir, plan, dtype = job
+    return pack_shard(
+        _POOL_SOURCES[plan.source_id], out_dir, plan, dtype=dtype
+    )
 
 
 def merge_index(
@@ -355,6 +406,7 @@ def pack_sources(
     samples_per_shard: Optional[int] = None,
     shard_mb: float = 512,
     resume: bool = True,
+    dtype: str = "float32",
 ) -> Dict[str, Any]:
     """Pack one or more sources into ``out_dir`` (the parallel,
     resumable, mixture-capable path behind both :func:`pack_dataset` and
@@ -362,6 +414,7 @@ def pack_sources(
     prints as its JSON verdict."""
     from seist_tpu.obs.bus import monotonic
 
+    dtype = canonical_dtype(dtype)
     t0 = monotonic()
     os.makedirs(out_dir, exist_ok=True)
     datasets = [s.create() for s in sources]
@@ -375,10 +428,12 @@ def pack_sources(
                 f"vs ({channels}, {fs})"
             )
     plans, caps = plan_shards(
-        datasets, samples_per_shard=samples_per_shard, shard_mb=shard_mb
+        datasets, samples_per_shard=samples_per_shard, shard_mb=shard_mb,
+        dtype=dtype,
     )
     todo = [
-        p for p in plans if not (resume and shard_complete(out_dir, p))
+        p for p in plans
+        if not (resume and shard_complete(out_dir, p, dtype=dtype))
     ]
     skipped = len(plans) - len(todo)
     if skipped:
@@ -414,13 +469,15 @@ def pack_sources(
                 initargs=(ship,),
             ) as pool:
                 for out in pool.map(
-                    _pack_pool_shard, [(out_dir, p) for p in todo]
+                    _pack_pool_shard, [(out_dir, p, dtype) for p in todo]
                 ):
                     stats["samples"] += out["samples"]
                     stats["bytes"] += out["bytes"]
         else:
             for plan in todo:
-                out = pack_shard(datasets[plan.source_id], out_dir, plan)
+                out = pack_shard(
+                    datasets[plan.source_id], out_dir, plan, dtype=dtype
+                )
                 stats["samples"] += out["samples"]
                 stats["bytes"] += out["bytes"]
 
@@ -437,6 +494,7 @@ def pack_sources(
         "n_events": n_total,
         "n_shards": len(plans),
         "format_version": 2,
+        "dtype": dtype,
         "samples_per_shard": caps[0] if len(set(caps)) == 1 else caps,
         "sources": [
             {
@@ -461,6 +519,7 @@ def pack_sources(
     )
     return {
         "out": out_dir,
+        "dtype": dtype,
         "shards": len(plans),
         "shards_skipped": skipped,
         "samples": n_total,
@@ -479,6 +538,7 @@ def pack_dataset(
     shard_mb: float = 512,
     samples_per_shard: Optional[int] = None,
     num_workers: int = 0,
+    dtype: str = "float32",
     log_every: int = 0,  # kept for call-site compat; progress is per shard
 ) -> str:
     """Repack ``src`` (any DatasetBase, pre-split disabled) into packed
@@ -490,6 +550,7 @@ def pack_dataset(
         num_workers=num_workers,
         samples_per_shard=samples_per_shard,
         shard_mb=shard_mb,
+        dtype=dtype,
     )
     return out_dir
 
@@ -544,6 +605,10 @@ class PackedDataset(DatasetBase):
         data_dir = kwargs.get("data_dir", "")
         with open(os.path.join(data_dir, _META)) as f:
             self._meta = json.load(f)
+        # Pre-dtype packs (and every v1 pack) stored float32.
+        self._storage_dtype = storage_dtype(
+            self._meta.get("dtype", "float32")
+        )
         self._mmaps: Dict[int, np.memmap] = {}
         super().__init__(**kwargs)
 
@@ -567,6 +632,11 @@ class PackedDataset(DatasetBase):
 
     def sampling_rate(self):  # type: ignore[override]
         return int(self._meta["sampling_rate"])
+
+    @property
+    def storage_dtype(self) -> np.dtype:
+        """On-disk waveform dtype (readers upcast to float32 on read)."""
+        return self._storage_dtype
 
     def sources(self) -> List[Dict[str, Any]]:
         """Provenance of a mixture pack (one entry per source; v1 packs
@@ -616,10 +686,16 @@ class PackedDataset(DatasetBase):
             self._data_dir,
             int(row["shard"]),
             int(row["offset"]),
-            c * length * 4,
+            c * length * self._storage_dtype.itemsize,
             desc=f"packed (sample {idx})",
         )
-        data = np.frombuffer(raw, dtype=np.float32).reshape(c, length).copy()
+        # .astype always copies — bf16 packs upcast, f32 packs keep the
+        # original copy-out-of-the-memmap semantics.
+        data = (
+            np.frombuffer(raw, dtype=self._storage_dtype)
+            .reshape(c, length)
+            .astype(np.float32)
+        )
 
         def scalar(field):
             v = row[field]

@@ -194,3 +194,56 @@ def process_outputs(
         else:
             results[label_group] = out
     return results
+
+
+#: Decision-level picker results a catalog keeps (dense per-sample
+#: probability channels like ``non``/``det+`` are decode intermediates,
+#: not catalog content).
+_CATALOG_PICK_NAMES = ("ppk", "spk", "det")
+
+
+def decode_head_batch(
+    spec: Any,
+    outputs: Any,
+    *,
+    is_picker: bool,
+    sampling_rate: int,
+    ppk_threshold: float = 0.3,
+    spk_threshold: float = 0.3,
+    det_threshold: float = 0.5,
+    min_peak_dist: float = 1.0,
+    max_events: int = 8,
+) -> Dict[str, Any]:
+    """Batched decode of ONE head's raw outputs into named result arrays
+    — device-resident; the caller makes a single batched
+    ``jax.device_get`` over every head's results (the Metrics.to_dict
+    idiom) and feeds them to :func:`seist_tpu.ops.results.catalog_rows`.
+
+    Pickers route through :func:`process_outputs` (the same compiled
+    pick/detect programs the eval loop and serve decode use), keeping
+    only the decision-level ``ppk``/``spk``/``det`` arrays. VALUE heads
+    apply the spec's results transform (e.g. baz (cos,sin)->degrees,
+    magnet mean-only) and yield one per-label array with leading dim N;
+    ONEHOT heads yield the (N, C) score matrix (argmax happens host-side
+    in ``catalog_rows``)."""
+    if is_picker:
+        res = process_outputs(
+            outputs,
+            spec.labels,
+            sampling_rate,
+            ppk_threshold=ppk_threshold,
+            spk_threshold=spk_threshold,
+            det_threshold=det_threshold,
+            min_peak_dist=min_peak_dist,
+            max_detect_event_num=max_events,
+        )
+        return {k: v for k, v in res.items() if k in _CATALOG_PICK_NAMES}
+    transform = spec.outputs_transform_for_results
+    outs = transform(outputs) if transform else outputs
+    outs_list = outs if isinstance(outs, (tuple, list)) else [outs]
+    if len(outs_list) != len(spec.labels):
+        raise ValueError(
+            f"head produced {len(outs_list)} outputs for "
+            f"{len(spec.labels)} labels"
+        )
+    return {str(name): arr for name, arr in zip(spec.labels, outs_list)}

@@ -8,6 +8,7 @@ columns — the same file contract as the reference's
 
 from __future__ import annotations
 
+import json
 import os
 from collections import defaultdict
 from typing import Any, Dict, List, Sequence, Tuple, Union
@@ -95,3 +96,70 @@ class ResultSaver:
         if sdir and not os.path.exists(sdir):
             os.makedirs(sdir, exist_ok=True)
         pd.DataFrame(self._results_dict).to_csv(path)
+
+
+def catalog_rows(
+    decoded: Dict[str, Dict[str, Any]],
+    *,
+    n_valid: int,
+    row_ids: Sequence[int],
+    keys: Union[Sequence[str], None] = None,
+) -> List[Dict[str, Any]]:
+    """Batched head results -> JSON-able catalog rows, one per waveform
+    (the repick engine's row builder; docs/DATA.md "Batch re-picking").
+
+    ``decoded`` is ``{task: {name: HOST array}}`` from
+    ``ops.postprocess.decode_head_batch`` AFTER the caller's single
+    batched ``jax.device_get``; padding rows (``>= n_valid``, the static
+    batch shape's tail fill) are dropped. Per name:
+
+    * ``ppk``/``spk`` -> the kept pick sample indices (padding stripped);
+    * ``det`` -> ``[onset, offset]`` sample pairs (empty pairs stripped);
+    * ONEHOT labels -> ``{"class": argmax, "scores": [...]}``;
+    * VALUE labels -> the scalar, rounded to 6 decimals (float repr is
+      then deterministic — the catalog byte-identity contract).
+
+    Label names are globally unique across the five task heads, so a
+    group's heads flatten into one row without collisions.
+    """
+    rows: List[Dict[str, Any]] = []
+    host = {
+        task: {k: np.asarray(v) for k, v in outs.items()}
+        for task, outs in decoded.items()
+    }
+    for j in range(int(n_valid)):
+        row: Dict[str, Any] = {"row": int(row_ids[j])}
+        if keys is not None:
+            row["key"] = str(keys[j])
+        for outs in host.values():
+            for name, arr in outs.items():
+                if name in ("ppk", "spk"):
+                    idxs = arr[j]
+                    row[name] = [int(i) for i in idxs[idxs >= 0]]
+                elif name == "det":
+                    pairs = arr[j].reshape(-1, 2)
+                    pairs = pairs[pairs[:, 1] >= pairs[:, 0]]
+                    row[name] = [[int(a), int(b)] for a, b in pairs]
+                elif (
+                    name in taskspec.IO_ITEMS
+                    and taskspec.get_kind(name) == taskspec.ONEHOT
+                ):
+                    scores = arr[j].reshape(-1)
+                    row[name] = {
+                        "class": int(np.argmax(scores)),
+                        "scores": [round(float(s), 6) for s in scores],
+                    }
+                else:
+                    row[name] = round(float(arr[j].reshape(-1)[0]), 6)
+        rows.append(row)
+    return rows
+
+
+def catalog_row_lines(rows: Sequence[Dict[str, Any]]) -> List[str]:
+    """Serialize catalog rows to canonical JSONL lines (sorted keys,
+    compact separators): the byte-identity contract's serialization half
+    — same rows => same bytes, whatever process wrote them."""
+    return [
+        json.dumps(r, sort_keys=True, separators=(",", ":")) + "\n"
+        for r in rows
+    ]

@@ -120,6 +120,33 @@ def aot_compile(
     )
 
 
+def aot_compile_multi(
+    key: str,
+    fn: Callable[..., Any],
+    arg_shapes: Sequence[Tuple[Tuple[int, ...], Any]],
+    *,
+    steps: int,
+    model: str = "",
+) -> AotProgram:
+    """AOT-compile ``steps`` applications of ``fn`` as ONE executable:
+    the compiled program takes arguments with a leading ``steps`` axis
+    and ``lax.map``s ``fn`` over it. This is the serving-side analog of
+    the train loop's ``steps_per_call`` scan — one host->device dispatch
+    feeds ``steps`` full batches, keeping host Python (and its dispatch
+    latency) off the device's critical path. The batch re-picking engine
+    (seist_tpu/batch/engine.py) compiles its full-batch program buckets
+    through this; ``arg_shapes`` are the PER-STEP shapes."""
+    import jax
+
+    def multi(*args):
+        return jax.lax.map(lambda sliced: fn(*sliced), tuple(args))
+
+    shapes = [
+        ((steps,) + tuple(shape), dtype) for shape, dtype in arg_shapes
+    ]
+    return aot_compile(key, multi, shapes, model=model)
+
+
 # ------------------------------------------------------------------ variants
 def _is_float(leaf: Any) -> bool:
     dt = getattr(leaf, "dtype", None)

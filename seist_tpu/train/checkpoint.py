@@ -112,6 +112,34 @@ def _restore_target(state, meta_defaults: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+class ProgressFile:
+    """Tiny atomic JSON progress record — the ``best.json`` tmp+rename
+    pattern generalized for flat (non-orbax) progress state. Used by the
+    batch re-picking workers (tools/repick_archive.py) to persist their
+    position between segment commits: ``load()`` returns the last saved
+    dict (or None), ``save()`` replaces it atomically, so a SIGKILL at
+    any instant leaves either the previous record or the new one —
+    never a torn file. The record is advisory (the committed segment
+    files are the authoritative resume state); it exists so a resumed
+    worker can log where it died and skip completed units in O(1)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def save(self, record: Dict[str, Any]) -> None:
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(record, f, sort_keys=True)
+        os.replace(tmp, self.path)
+
+
 class TrainCheckpointManager:
     """Step-granular async checkpointing with keep-last-K + best retention.
 

@@ -316,6 +316,140 @@ def test_injected_corrupt_sample_env_knob(tmp_path, monkeypatch):
     assert np.isfinite(rows["data"]).all()
 
 
+# -------------------------------------------------- archive-tail edge cases
+def test_partial_final_batch_fill(packed_dir, monkeypatch):
+    """A final batch smaller than the staging ring's capacity (every
+    archive tail in the repick loop): the fill slices the slab, rows
+    match full-batch reads, and a following full batch is unaffected by
+    the short fill (ring rotation stays sound)."""
+    monkeypatch.setenv("SEIST_INGEST_REUSE_STAGING", "1")
+    sds = _sds(packed_dir, augmentation=False, seed=0, shuffle=False,
+               data_split=False)
+    store = PackedRawStore.build(sds, batch_size=8)
+    assert store._reuse  # the ring path is what this test exercises
+    n = store.n_raw
+    tail = np.arange(n - 3, n)
+    short = store.row_batch_at(tail, epoch=0, idx=tail)
+    assert short["data"].shape == (3, store.n_ch, store.raw_len)
+    one_by_one = [
+        store.row_batch_at(np.array([r]), epoch=0, idx=np.array([r]))
+        for r in tail
+    ]
+    for j in range(3):
+        np.testing.assert_array_equal(
+            short["data"][j], one_by_one[j]["data"][0]
+        )
+    # Full batch straight after the short one: correct and full-shape.
+    full_idx = np.arange(8)
+    full = store.row_batch_at(full_idx, epoch=0, idx=full_idx)
+    assert full["data"].shape == (8, store.n_ch, store.raw_len)
+    ref = PackedRawStore.build(
+        _sds(packed_dir, augmentation=False, seed=0, shuffle=False,
+             data_split=False),
+        batch_size=8, reuse_staging=False,
+    ).row_batch_at(full_idx, epoch=0, idx=full_idx)
+    np.testing.assert_array_equal(full["data"], ref["data"])
+
+
+def test_empty_selection_and_untouched_shards(packed_dir):
+    """An empty index selection (a work unit with zero assigned rows)
+    fills a (0, C, L) batch without error, and shards no row ever
+    touched never open a memmap."""
+    sds = _sds(packed_dir, augmentation=False, seed=0, shuffle=False,
+               data_split=False)
+    store = PackedRawStore.build(sds, batch_size=4)
+    empty = np.empty(0, np.int64)
+    rows = store.row_batch_at(empty, epoch=0, idx=empty)
+    assert rows["data"].shape == (0, store.n_ch, store.raw_len)
+    assert rows["ppks"].shape[0] == 0
+    assert store._mmaps == {}  # nothing read -> nothing mapped
+    first_shard_rows = np.flatnonzero(store._shards == 0)[:2]
+    store.row_batch_at(first_shard_rows, epoch=0, idx=first_shard_rows)
+    assert set(store._mmaps) == {0}  # only the touched shard mapped
+
+
+def test_empty_split_refuses_build(tmp_path):
+    """A split that maps to ZERO rows (an empty archive selection from
+    the repick worker's perspective) refuses LOUDLY at pipeline
+    construction (the quarantine registry needs a positive population)
+    — nothing downstream can silently iterate over nothing. The store's
+    own 'empty packed split' refusal is the second line of defense for
+    duck-typed callers."""
+    out = _pack_synthetic(tmp_path / "pack", n_events=6, sps=4)
+    spec = taskspec.get_task_spec("seist_s_dpk")
+    # int(0.1 * 6) == 0 -> the val split holds zero rows.
+    with pytest.raises(ValueError, match="positive"):
+        pipeline.from_task_spec(
+            spec, "packed", "val", seed=0, in_samples=WINDOW,
+            data_dir=out, train_size=0.8, val_size=0.1,
+        )
+
+
+# --------------------------------------------------- bf16 shard variant
+def test_bf16_pack_read_parity(tmp_path):
+    """--dtype bf16 shard variant: half the on-disk bytes, readers
+    (PackedDataset and PackedRawStore) upcast on fill to exactly
+    float32(bfloat16(x)) of the f32 pack, labels bit-identical."""
+    import ml_dtypes
+
+    kwargs = {"num_events": 10, "trace_samples": L_TRACE, "cache": False}
+    out16 = pack_sources(
+        [PackSource(name="synthetic", dataset_kwargs=dict(kwargs))],
+        str(tmp_path / "bf16"), samples_per_shard=4, dtype="bf16",
+    )["out"]
+    out32 = pack_sources(
+        [PackSource(name="synthetic", dataset_kwargs=dict(kwargs))],
+        str(tmp_path / "f32"), samples_per_shard=4,
+    )["out"]
+    assert os.path.getsize(shard_path(out16, 0)) * 2 == os.path.getsize(
+        shard_path(out32, 0)
+    )
+    sds16 = _sds(out16, augmentation=False, seed=0, shuffle=False,
+                 data_split=False)
+    sds32 = _sds(out32, augmentation=False, seed=0, shuffle=False,
+                 data_split=False)
+    st16 = PackedRawStore.build(sds16, batch_size=4)
+    st32 = PackedRawStore.build(sds32, batch_size=4)
+    assert st16.row_nbytes * 2 == st32.row_nbytes
+    idx = np.arange(4)
+    b16 = st16.row_batch_at(idx, epoch=0, idx=idx)
+    b32 = st32.row_batch_at(idx, epoch=0, idx=idx)
+    assert b16["data"].dtype == np.float32
+    expect = b32["data"].astype(ml_dtypes.bfloat16).astype(np.float32)
+    np.testing.assert_array_equal(b16["data"], expect)
+    for k in ("ppks", "np_p", "spks", "np_s"):
+        np.testing.assert_array_equal(b16[k], b32[k])
+    # Event-reader lane upcasts identically (shared contract).
+    e16, _ = sds16._dataset[1]
+    e32, _ = sds32._dataset[1]
+    np.testing.assert_array_equal(
+        e16["data"], e32["data"].astype(ml_dtypes.bfloat16).astype(np.float32)
+    )
+
+
+def test_bf16_resume_dtype_switch_repacks(tmp_path):
+    """Resuming a pack with a different --dtype must repack every shard
+    (storage dtype is part of the sidecar plan identity), never mix
+    itemsizes inside one directory."""
+    kwargs = {"num_events": 8, "trace_samples": 128, "cache": False}
+    out = str(tmp_path / "pack")
+    pack_sources(
+        [PackSource(name="synthetic", dataset_kwargs=dict(kwargs))],
+        out, samples_per_shard=4, dtype="bf16",
+    )
+    stats = pack_sources(
+        [PackSource(name="synthetic", dataset_kwargs=dict(kwargs))],
+        out, samples_per_shard=4, dtype="float32",
+    )
+    assert stats["shards_skipped"] == 0  # dtype switch -> full repack
+    # Same dtype resumes cleanly.
+    stats = pack_sources(
+        [PackSource(name="synthetic", dataset_kwargs=dict(kwargs))],
+        out, samples_per_shard=4, dtype="float32",
+    )
+    assert stats["shards_skipped"] == stats["shards"]
+
+
 def test_non_packed_dataset_refused():
     spec = taskspec.get_task_spec("seist_s_dpk")
     sds = pipeline.from_task_spec(

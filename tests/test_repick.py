@@ -1,0 +1,426 @@
+"""Batch re-picking engine (seist_tpu/batch) + tools/repick_archive.py:
+
+* deterministic work-unit planning + segment math + resume scan;
+* plan-identity guard (geometry changes refuse to resume);
+* catalog row schema + canonical serialization;
+* engine e2e: serial == map-reduce == kill/resume, BYTE-identical;
+* zero XLA compiles after warm-up (CompileBudget gate);
+* SIGTERM-style preemption at segment boundaries + exact-offset resume;
+* variant parity gate wiring (refuse on divergence).
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import seist_tpu
+from seist_tpu.batch import catalog
+from seist_tpu.ops.results import catalog_row_lines, catalog_rows
+
+seist_tpu.load_all()
+
+TRACE = 256
+BATCH = 4
+BPC = 2  # batches per call
+ROWS_PER_CALL = BATCH * BPC
+
+
+# ----------------------------------------------------------------- planning
+def test_plan_units_contiguous_ranges():
+    shards = np.array([0, 0, 0, 2, 2, 5])
+    units = catalog.plan_units(shards)
+    assert [(u.unit_id, u.row_lo, u.row_hi) for u in units] == [
+        (0, 0, 3), (2, 3, 5), (5, 5, 6),
+    ]
+    assert catalog.plan_units(np.array([])) == []
+
+
+def test_plan_units_refuses_reordered_index():
+    with pytest.raises(ValueError, match="pack order"):
+        catalog.plan_units(np.array([1, 0, 1]))
+
+
+def test_segment_math():
+    unit = catalog.WorkUnit(0, 0, 22)  # 22 rows, 8 rows/call -> 3 calls
+    assert catalog.calls_per_unit(unit, 8) == 3
+    assert catalog.segments_per_unit(unit, 8, 2) == 2
+    assert catalog.segments_per_unit(unit, 8, 1) == 3
+    empty_tail = catalog.WorkUnit(1, 22, 24)
+    assert catalog.calls_per_unit(empty_tail, 8) == 1
+
+
+def test_resume_scan_finds_first_hole(tmp_path):
+    unit = catalog.WorkUnit(3, 0, 30)  # 4 calls at 8/call -> 4 segs at 1
+    out = str(tmp_path)
+    assert catalog.first_missing_segment(out, unit, 8, 1) == 0
+    catalog.commit_segment(out, 3, 0, ["a\n"])
+    catalog.commit_segment(out, 3, 1, ["b\n"])
+    assert catalog.first_missing_segment(out, unit, 8, 1) == 2
+    # a hole before a committed later segment resumes AT the hole
+    catalog.commit_segment(out, 3, 3, ["d\n"])
+    assert catalog.first_missing_segment(out, unit, 8, 1) == 2
+
+
+def test_plan_identity_guard(tmp_path):
+    out = str(tmp_path)
+    plan = {"batch_size": 4, "model": "phasenet", "variant": "fp32"}
+    catalog.write_or_check_plan(out, plan)
+    catalog.write_or_check_plan(out, dict(plan))  # same plan: fine
+    with pytest.raises(ValueError, match="different plan"):
+        catalog.write_or_check_plan(out, {**plan, "batch_size": 8})
+
+
+def test_merge_refuses_missing_segments(tmp_path):
+    out = str(tmp_path)
+    units = [catalog.WorkUnit(0, 0, 8), catalog.WorkUnit(1, 8, 16)]
+    catalog.commit_segment(out, 0, 0, ['{"row":0}\n'])
+    with pytest.raises(FileNotFoundError, match="unit 1 seg 0"):
+        catalog.merge_catalog(out, units, 8, 1)
+    catalog.commit_segment(out, 1, 0, ['{"row":8}\n'])
+    meta = catalog.merge_catalog(out, units, 8, 1, meta={"x": 1})
+    assert meta["n_rows"] == 2 and meta["x"] == 1
+    assert os.path.exists(os.path.join(out, "catalog_meta.json"))
+
+
+# -------------------------------------------------------------- row schema
+def test_catalog_rows_schema_and_determinism():
+    decoded = {
+        "dpk": {
+            "ppk": np.array([[5, -1, -1], [7, 9, -1]]),
+            "spk": np.array([[-1, -1, -1], [11, -1, -1]]),
+            "det": np.array([[3, 8, 1, 0], [2, 6, 7, 9]]),
+        },
+        "emg": {"emg": np.array([[1.23456789], [2.5]])},
+        "pmp": {"pmp": np.array([[0.1, 0.9], [0.8, 0.2]])},
+    }
+    rows = catalog_rows(
+        decoded, n_valid=2, row_ids=[10, 11], keys=["a", "b"]
+    )
+    assert rows[0] == {
+        "row": 10, "key": "a", "ppk": [5], "spk": [],
+        "det": [[3, 8]], "emg": 1.234568,
+        "pmp": {"class": 1, "scores": [0.1, 0.9]},
+    }
+    assert rows[1]["ppk"] == [7, 9] and rows[1]["det"] == [[2, 6], [7, 9]]
+    # Padding rows (>= n_valid) dropped.
+    assert len(catalog_rows(decoded, n_valid=1, row_ids=[10])) == 1
+    # Canonical serialization: sorted keys, compact, newline-terminated.
+    lines = catalog_row_lines(rows)
+    assert lines[0].endswith("\n")
+    assert lines == catalog_row_lines(
+        catalog_rows(decoded, n_valid=2, row_ids=[10, 11], keys=["a", "b"])
+    )
+    assert json.loads(lines[0]) == rows[0]
+
+
+def test_decode_head_batch_drops_dense_channels():
+    import jax.numpy as jnp
+
+    from seist_tpu import taskspec
+    from seist_tpu.ops.postprocess import decode_head_batch
+
+    spec = taskspec.get_task_spec("phasenet")  # labels (("non","ppk","spk"),)
+    out = jnp.zeros((2, 64, 3))
+    res = decode_head_batch(
+        spec, out, is_picker=True, sampling_rate=50
+    )
+    assert set(res) == {"ppk", "spk"}  # 'non' (dense) not catalog content
+
+    mspec = taskspec.get_task_spec("magnet")  # VALUE head w/ transform
+    vres = decode_head_batch(
+        mspec, jnp.array([[3.0, -1.0], [2.0, 0.5]]), is_picker=False,
+        sampling_rate=50,
+    )
+    assert set(vres) == {"emg"}
+    np.testing.assert_allclose(np.asarray(vres["emg"]).ravel(), [3.0, 2.0])
+
+
+# ------------------------------------------------------------- engine e2e
+N_EVENTS = 22
+SPS = 10  # 3 shards: 10 + 10 + 2 (partial tail)
+
+
+@pytest.fixture(scope="module")
+def repick_archive_dir(tmp_path_factory):
+    from seist_tpu.data.packed import PackSource, pack_sources
+
+    root = tmp_path_factory.mktemp("repick_arch")
+    return pack_sources(
+        [PackSource(
+            name="synthetic",
+            dataset_kwargs={
+                "num_events": N_EVENTS, "trace_samples": TRACE,
+                "cache": False,
+            },
+        )],
+        str(root),
+        samples_per_shard=SPS,
+    )["out"]
+
+
+def _repick(archive, out, *extra):
+    from tools.repick_archive import main
+
+    return main([
+        "--archive", archive, "--out", out, "--model", "phasenet",
+        "--batch-size", str(BATCH), "--batches-per-call", str(BPC),
+        "--commit-every", "1", *extra,
+    ])
+
+
+def _merge_only(archive, out):
+    """The model-free reduce. Deliberately passes NO geometry flags (and
+    a default --commit-every that DIFFERS from the map phase's): the
+    merge must take segment geometry from repick_plan.json, not from
+    this invocation — a flag-derived geometry under-counts segments and
+    silently drops rows (review-pinned)."""
+    from tools.repick_archive import main
+
+    return main(["--archive", archive, "--out", out, "--merge-only"])
+
+
+@pytest.fixture(scope="module")
+def serial_catalog(repick_archive_dir, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("serial"))
+    assert _repick(repick_archive_dir, out, "--compile-gate") == 0
+    with open(os.path.join(out, "catalog.jsonl"), "rb") as f:
+        return f.read()
+
+
+def test_serial_catalog_covers_archive(serial_catalog):
+    rows = [json.loads(x) for x in serial_catalog.splitlines()]
+    assert len(rows) == N_EVENTS
+    assert [r["row"] for r in rows] == list(range(N_EVENTS))
+    assert all("ppk" in r and "spk" in r and "key" in r for r in rows)
+
+
+def test_zero_compiles_after_warmup(
+    repick_archive_dir, tmp_path, capsys
+):
+    """ISSUE acceptance: CompileBudget records zero compiles after the
+    worker's warm-up — the whole unit loop runs AOT executables only."""
+    assert _repick(
+        repick_archive_dir, str(tmp_path), "--compile-gate"
+    ) == 0
+    verdicts = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    worker = next(v for v in verdicts if v.get("role") == "worker")
+    assert worker["compiles_after_warmup"] == 0
+    assert worker["xla_compiles_after_warmup"] == 0
+    assert worker["rows"] == N_EVENTS
+
+
+def test_two_worker_kill_resume_byte_identical(
+    repick_archive_dir, serial_catalog, tmp_path
+):
+    """Map-reduce over 2 workers with a simulated mid-shard kill (a
+    later segment deleted = work lost after a SIGKILL): the resumed
+    worker restarts at its exact segment offset and the merged catalog
+    is byte-identical to the serial run."""
+    out = str(tmp_path)
+    w = ["--worker-index", "0", "--num-workers", "2", "--no-merge"]
+    assert _repick(repick_archive_dir, out, *w) == 0
+    # Simulate the kill: drop worker 0's LAST committed segment.
+    segs = sorted(glob.glob(os.path.join(out, "unit_00002.seg_*.jsonl")))
+    assert segs, "expected worker 0 to own unit 2"
+    os.unlink(segs[-1])
+    assert _repick(repick_archive_dir, out, *w) == 0  # exact-offset resume
+    assert _repick(
+        repick_archive_dir, out, "--worker-index", "1",
+        "--num-workers", "2", "--no-merge",
+    ) == 0
+    assert _merge_only(repick_archive_dir, out) == 0
+    with open(os.path.join(out, "catalog.jsonl"), "rb") as f:
+        assert f.read() == serial_catalog
+    meta = json.load(open(os.path.join(out, "catalog_meta.json")))
+    # Identity/geometry in the merged meta come from the PLAN, not the
+    # merge invocation's (absent) flags.
+    assert meta["model"] == "phasenet"
+    assert meta["plan"]["commit_every"] == 1
+    assert meta["n_rows"] == N_EVENTS
+
+
+def test_resume_refuses_changed_geometry(repick_archive_dir, tmp_path):
+    out = str(tmp_path)
+    assert _repick(repick_archive_dir, out, "--no-merge") == 0
+    from tools.repick_archive import main
+
+    with pytest.raises(ValueError, match="different plan"):
+        main([
+            "--archive", repick_archive_dir, "--out", out,
+            "--model", "phasenet", "--batch-size", str(BATCH * 2),
+            "--batches-per-call", str(BPC), "--commit-every", "1",
+            "--no-merge",
+        ])
+
+
+def _make_engine(archive, **kw):
+    from seist_tpu.batch.engine import RepickEngine
+    from seist_tpu.data import pipeline
+    from seist_tpu.data.ingest import PackedRawStore, packed_dataset_of
+    from seist_tpu.serve.pool import load_model_entry
+
+    sds = pipeline.SeismicDataset(
+        "packed", "train", seed=0, data_dir=archive,
+        input_names=[], label_names=[], task_names=[],
+        in_samples=TRACE, augmentation=False, shuffle=False,
+        data_split=False,
+    )
+    store = PackedRawStore.build(sds, batch_size=ROWS_PER_CALL)
+    keys = packed_dataset_of(sds)._meta_data["key"].to_numpy()
+    entry = load_model_entry("phasenet", "", window=TRACE)
+    return RepickEngine(
+        entry, store, sampling_rate=50, batch_size=BATCH,
+        batches_per_call=BPC, keys=keys, **kw,
+    ), store
+
+
+def test_preemption_commits_segment_then_stops(
+    repick_archive_dir, serial_catalog, tmp_path
+):
+    """The SIGTERM contract at engine level: stop_event set -> the
+    in-flight segment commits, the unit reports preempted, and a resume
+    finishes from the exact offset with byte-identical output."""
+    import threading
+
+    engine, store = _make_engine(repick_archive_dir)
+    units = catalog.plan_units(store._shards)
+    out = str(tmp_path)
+    catalog.write_or_check_plan(out, {"t": 1})
+
+    # A stop that lands before ANY work: nothing commits, and the unit
+    # must still report preempted (not silently look complete).
+    pre = threading.Event()
+    pre.set()
+    stats0 = engine.run_units(units, out, commit_every=1, stop_event=pre)
+    assert stats0["preempted"] is True and stats0["segments"] == 0
+
+    # A stop raised at the first segment commit: that segment lands,
+    # everything after stays a hole.
+    stop = threading.Event()
+    real_commit = catalog.commit_segment
+
+    def commit_then_stop(*a, **k):
+        path = real_commit(*a, **k)
+        stop.set()
+        return path
+
+    import seist_tpu.batch.engine as engine_mod
+
+    orig = engine_mod.catalog.commit_segment
+    engine_mod.catalog.commit_segment = commit_then_stop
+    try:
+        stats = engine.run_units(
+            units, out, commit_every=1, stop_event=stop
+        )
+    finally:
+        engine_mod.catalog.commit_segment = orig
+    assert stats["preempted"] is True
+    assert stats["segments"] == 1
+    # Resume with a fresh engine: finishes every unit.
+    stats2 = engine.run_units(units, out, commit_every=1)
+    assert stats2["preempted"] is False
+    total_segs = sum(
+        catalog.segments_per_unit(u, ROWS_PER_CALL, 1) for u in units
+    )
+    assert stats["segments"] + stats2["segments"] + stats2[
+        "segments_skipped"
+    ] >= total_segs
+    merged = catalog.merge_catalog(out, units, ROWS_PER_CALL, 1)
+    assert merged["n_rows"] == N_EVENTS
+    with open(os.path.join(out, "catalog.jsonl"), "rb") as f:
+        assert f.read() == serial_catalog
+
+
+def test_variant_gate_refuses_divergence(
+    repick_archive_dir, monkeypatch
+):
+    from seist_tpu.serve import aot
+
+    engine, _ = _make_engine(repick_archive_dir, variant="bf16")
+    monkeypatch.setattr(
+        aot, "variant_parity", lambda *a, **k: (False, 1.0)
+    )
+    with pytest.raises(RuntimeError, match="parity gate"):
+        engine.warmup()
+
+
+def test_variant_gate_pass_runs_variant_program(
+    repick_archive_dir, tmp_path, monkeypatch
+):
+    from seist_tpu.serve import aot
+
+    monkeypatch.setattr(
+        aot, "variant_parity", lambda *a, **k: (True, 0.0)
+    )
+    engine, store = _make_engine(repick_archive_dir, variant="bf16")
+    engine.warmup()
+    assert engine.warmup_report["program"].endswith("/bf16")
+    out = str(tmp_path)
+    catalog.write_or_check_plan(out, {"t": "bf16"})
+    units = catalog.plan_units(store._shards)
+    stats = engine.run_units(units[:1], out, commit_every=1)
+    assert stats["rows"] == SPS
+
+
+def test_variant_gate_uses_model_head_scale(
+    repick_archive_dir, monkeypatch
+):
+    """Single-task entries carry head_scale on the MODEL (groups on the
+    TaskHead); the gate must normalize VALUE-head error by it
+    (review-pinned: the entry itself has no head_scale attribute, so a
+    naive getattr silently used 1.0)."""
+    from seist_tpu.batch.engine import RepickEngine
+    from seist_tpu.data import pipeline
+    from seist_tpu.data.ingest import PackedRawStore
+    from seist_tpu.serve import aot
+    from seist_tpu.serve.pool import load_model_entry
+
+    sds = pipeline.SeismicDataset(
+        "packed", "train", seed=0, data_dir=repick_archive_dir,
+        input_names=[], label_names=[], task_names=[],
+        in_samples=TRACE, augmentation=False, shuffle=False,
+        data_split=False,
+    )
+    store = PackedRawStore.build(sds, batch_size=ROWS_PER_CALL)
+    entry = load_model_entry("seist_s_emg", "", window=TRACE)
+    expected = float(getattr(entry.model, "head_scale", 1.0) or 1.0)
+    assert expected != 1.0, "test needs a scaled regression head"
+    seen = {}
+
+    def spy(ref, out, variant, *, kind, scale=1.0):
+        seen["kind"], seen["scale"] = kind, scale
+        return True, 0.0
+
+    monkeypatch.setattr(aot, "variant_parity", spy)
+    engine = RepickEngine(
+        entry, store, sampling_rate=50, batch_size=BATCH,
+        batches_per_call=BPC, variant="bf16",
+    )
+    engine.warmup()
+    assert seen["kind"] == "value"
+    assert seen["scale"] == expected
+
+
+def test_engine_refuses_window_mismatch(repick_archive_dir):
+    from seist_tpu.batch.engine import RepickEngine
+    from seist_tpu.data import pipeline
+    from seist_tpu.data.ingest import PackedRawStore
+    from seist_tpu.serve.pool import load_model_entry
+
+    sds = pipeline.SeismicDataset(
+        "packed", "train", seed=0, data_dir=repick_archive_dir,
+        input_names=[], label_names=[], task_names=[],
+        in_samples=TRACE, augmentation=False, shuffle=False,
+        data_split=False,
+    )
+    store = PackedRawStore.build(sds, batch_size=8)
+    entry = load_model_entry("phasenet", "", window=TRACE * 2)
+    with pytest.raises(ValueError, match="window"):
+        RepickEngine(entry, store, sampling_rate=50)

@@ -83,6 +83,13 @@ def main(argv=None) -> int:
         help="rewrite every shard even when complete ones exist",
     )
     ap.add_argument(
+        "--dtype", default="float32",
+        choices=("float32", "fp32", "bfloat16", "bf16"),
+        help="on-disk waveform dtype; bf16 halves shard bytes (and read "
+        "bandwidth) for INFERENCE-ONLY archives — readers upcast to "
+        "float32 on fill (docs/DATA.md)",
+    )
+    ap.add_argument(
         "--dataset-kwargs", default="",
         help="JSON dict forwarded to the dataset constructor(s)",
     )
@@ -113,6 +120,7 @@ def main(argv=None) -> int:
         samples_per_shard=args.samples_per_shard or None,
         shard_mb=args.shard_mb,
         resume=not args.no_resume,
+        dtype=args.dtype,
     )
     stats["workers"] = args.workers
     print(json.dumps(stats))
