@@ -6,7 +6,8 @@ CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
 .PHONY: native test t1 lint lint-baseline irlint-report lockgraph \
 	serve-smoke serve-chaos obs-smoke trace-smoke rollout-smoke chaos \
-	pack-smoke bench-loader repick-smoke bench-repick clean
+	pack-smoke bench-loader repick-smoke bench-repick stream-smoke \
+	twin-smoke clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -132,6 +133,26 @@ serve-smoke:
 # GET /fleet/metrics.json must aggregate router + both replicas.
 trace-smoke:
 	JAX_PLATFORMS=cpu python tools/trace_smoke.py
+
+# Streaming smoke (docs/SERVING.md "Streaming inference"): a real
+# phasenet replica driven over HTTP by a 50-station network, 30 s of
+# waveform per station through POST /stream — gates zero dropped
+# alert-tier windows (no 429/503, no degraded sessions) and
+# streaming<->offline pick parity vs POST /annotate on 3 sampled
+# stations. One JSON verdict line.
+stream-smoke:
+	JAX_PLATFORMS=cpu python tools/stream_smoke.py
+
+# Network digital twin (docs/SERVING.md "Streaming inference"): a
+# deterministic mainshock + Omori-aftershock scenario over 50 simulated
+# stations (noise stations, dropouts, late bursts, duplicate packets)
+# driven through the full serve+stream+association plane — gates zero
+# missed mainshock alerts, zero alert-tier sheds/dropped windows, and a
+# pinned p99 sample->alert latency; writes the BENCH_stream_r01.json
+# lane with the per-stage latency breakdown.
+twin-smoke:
+	JAX_PLATFORMS=cpu python tools/twin.py --smoke \
+		--output BENCH_stream_r01.json
 
 # Live-rollout smoke (docs/SERVING.md "Live rollout"): a real 2-replica
 # phasenet fleet rolled to a new model version (SIGHUP + --rollout-file)

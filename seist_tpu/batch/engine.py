@@ -93,6 +93,7 @@ class RepickEngine:
         variant: str = "fp32",
         decode_opts: Optional[Dict[str, Any]] = None,
         keys: Optional[Sequence[str]] = None,
+        stations: Optional[Dict[str, Dict[str, Any]]] = None,
         prefetch: int = 2,
         tasks: Optional[Sequence[str]] = None,
     ) -> None:
@@ -120,6 +121,8 @@ class RepickEngine:
         self.variant = variant
         self.decode_opts = {**DEFAULT_DECODE, **(decode_opts or {})}
         self.keys = np.asarray(keys) if keys is not None else None
+        # {key: station metadata} for catalog provenance (catalog_rows).
+        self.stations = dict(stations) if stations else None
         self.prefetch = int(prefetch)
         self.tasks = (
             tuple(tasks)
@@ -352,7 +355,8 @@ class RepickEngine:
             else None
         )
         return catalog_rows(
-            decoded, n_valid=n_valid, row_ids=row_ids, keys=keys
+            decoded, n_valid=n_valid, row_ids=row_ids, keys=keys,
+            stations=self.stations,
         )
 
     # ---------------------------------------------------------------- feed

@@ -160,9 +160,17 @@ class FlightRecorder:
                 f"_{os.getpid()}_{seq}.json",
             )
         try:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            with open(path, "w") as f:
+            d = os.path.dirname(path) or "."
+            os.makedirs(d, exist_ok=True)
+            # Atomic publish: serialize into a dotfile (invisible to
+            # flight_* globs) and rename into place — a watcher polling
+            # for the dump must never read a half-written payload, and
+            # the snapshot can be large enough late in a long run for
+            # that window to be real.
+            tmp = os.path.join(d, "." + os.path.basename(path) + ".tmp")
+            with open(tmp, "w") as f:
                 json.dump(self.payload(reason, **fields), f, default=str)
+            os.replace(tmp, path)
         except OSError as e:
             try:
                 logger.error(f"[obs] flight-recorder dump failed: {e!r}")

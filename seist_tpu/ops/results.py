@@ -104,6 +104,7 @@ def catalog_rows(
     n_valid: int,
     row_ids: Sequence[int],
     keys: Union[Sequence[str], None] = None,
+    stations: Union[Dict[str, Dict[str, Any]], None] = None,
 ) -> List[Dict[str, Any]]:
     """Batched head results -> JSON-able catalog rows, one per waveform
     (the repick engine's row builder; docs/DATA.md "Batch re-picking").
@@ -121,6 +122,13 @@ def catalog_rows(
 
     Label names are globally unique across the five task heads, so a
     group's heads flatten into one row without collisions.
+
+    ``stations`` (optional ``{key: {"id", "network", "lat", "lon"}}``):
+    station provenance looked up by each row's ``key`` and embedded as
+    the row's ``station`` field — the same metadata block /predict and
+    /stream carry, so a repick catalog can feed cross-station
+    association without a sidecar join. Keys with no entry simply get
+    no field (byte-identity for rows is preserved either way).
     """
     rows: List[Dict[str, Any]] = []
     host = {
@@ -131,6 +139,10 @@ def catalog_rows(
         row: Dict[str, Any] = {"row": int(row_ids[j])}
         if keys is not None:
             row["key"] = str(keys[j])
+            if stations is not None:
+                st = stations.get(row["key"])
+                if st is not None:
+                    row["station"] = st
         for outs in host.values():
             for name, arr in outs.items():
                 if name in ("ppk", "spk"):

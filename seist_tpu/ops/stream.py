@@ -142,6 +142,22 @@ def annotate(
     if channel0 not in ("non", "det"):
         raise ValueError(f"channel0 must be 'non' or 'det', got {channel0!r}")
     record = np.asarray(record, np.float32)
+    if record.shape[0] == 0:
+        raise ValueError("empty record")
+    # Edge contract (pad-and-trim): a record SHORTER than one window is
+    # zero right-padded to exactly one window (the pad joins the window's
+    # z-normalization), scored, then trimmed — picks inside the pad are
+    # dropped, detection intervals are clipped to the true last sample,
+    # and "prob" is returned at the true record length. Non-stride-
+    # multiple tails were already defined (right-aligned final window,
+    # window_offsets). StreamSession.finish() replays this contract
+    # bit-for-bit — the streaming parity pin needs it pinned here.
+    true_len = record.shape[0]
+    if true_len < window:
+        record = np.concatenate(
+            [record, np.zeros((window - true_len, record.shape[1]), np.float32)],
+            axis=0,
+        )
     stride = stride or window // 2
     offsets = window_offsets(record.shape[0], window, stride)
     if max_events is None:
@@ -204,11 +220,19 @@ def annotate(
     det = np.asarray(
         detect_events(det_strength[None, :], det_threshold, max_events)
     )[0].reshape(-1, 2)
+    ppk = ppk[ppk >= 0]
+    spk = spk[spk >= 0]
+    # >= keeps real single-sample events (on == off); the [1, 0]
+    # padding pair has off < on and is stripped.
+    det = det[det[:, 1] >= det[:, 0]]
+    if true_len < record.shape[0]:  # trim the short-record pad back off
+        ppk = ppk[ppk < true_len]
+        spk = spk[spk < true_len]
+        det = det[det[:, 0] < true_len]
+        det = np.minimum(det, true_len - 1)
     return {
-        "ppk": ppk[ppk >= 0],
-        "spk": spk[spk >= 0],
-        # >= keeps real single-sample events (on == off); the [1, 0]
-        # padding pair has off < on and is stripped.
-        "det": det[det[:, 1] >= det[:, 0]],
-        "prob": np.asarray(curve),
+        "ppk": ppk,
+        "spk": spk,
+        "det": det,
+        "prob": np.asarray(curve)[:true_len],
     }

@@ -303,6 +303,52 @@ def parse_waveform(obj: Any, in_channels: int) -> np.ndarray:
     return arr
 
 
+_STATION_FIELDS = {"id", "network", "lat", "lon"}
+
+
+def parse_station(obj: Any, required: bool = False) -> Optional[Dict[str, Any]]:
+    """Validate a request's ``station`` metadata block: ``{"id": str,
+    "network": str?, "lat": float?, "lon": float?}``. ``id`` is
+    mandatory inside the block; ``lat``/``lon`` must come together (a
+    lone coordinate cannot place a station, and the associator needs
+    both or neither). Returns a normalized dict, or None when the block
+    is absent and not required."""
+    if obj is None:
+        if required:
+            raise BadRequest("'station' metadata is required: {'id': ...}")
+        return None
+    if not isinstance(obj, dict):
+        raise BadRequest(
+            f"'station' must be an object, got {type(obj).__name__}"
+        )
+    unknown = set(obj) - _STATION_FIELDS
+    if unknown:
+        raise BadRequest(f"unknown station fields: {sorted(unknown)}")
+    sid = obj.get("id")
+    if not isinstance(sid, str) or not sid:
+        raise BadRequest("'station.id' must be a non-empty string")
+    out: Dict[str, Any] = {"id": sid, "network": ""}
+    net = obj.get("network")
+    if net is not None:
+        if not isinstance(net, str):
+            raise BadRequest("'station.network' must be a string")
+        out["network"] = net
+    lat, lon = obj.get("lat"), obj.get("lon")
+    if (lat is None) != (lon is None):
+        raise BadRequest("'station.lat' and 'station.lon' must come together")
+    if lat is not None:
+        for key, val in (("lat", lat), ("lon", lon)):
+            if isinstance(val, bool) or not isinstance(val, (int, float)) \
+                    or not math.isfinite(val):
+                raise BadRequest(f"'station.{key}' must be a finite number")
+        if not -90.0 <= float(lat) <= 90.0:
+            raise BadRequest("'station.lat' out of range [-90, 90]")
+        if not -180.0 <= float(lon) <= 360.0:
+            raise BadRequest("'station.lon' out of range [-180, 360]")
+        out["lat"], out["lon"] = float(lat), float(lon)
+    return out
+
+
 def json_bytes(payload: Dict[str, Any]) -> bytes:
     return json.dumps(payload, default=_jsonable).encode("utf-8")
 

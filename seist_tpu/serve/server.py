@@ -19,10 +19,15 @@ Endpoints::
 
     POST /predict       one (window, C) trace -> picks / regression / class
     POST /annotate      one (L >= window, C) record -> picks over the record
+    POST /stream        one station packet into a long-lived StreamSession;
+                        picks stream out as they become final, network
+                        alerts ride along (docs/SERVING.md "Streaming
+                        inference")
     POST /admin/reload  hot-swap a new checkpoint behind the full gate
                         ladder (docs/SERVING.md "Live rollout")
     GET  /healthz       liveness + model list + per-entry version/variants
     GET  /metrics       queue depth, batch-fill ratio, latency histograms
+    GET  /stream/alerts recent cross-station association alerts + mux stats
 
 CLI: ``python main.py serve --model seist_s_dpk=CKPT --port 8080 ...``
 (see ``main()``); ``make serve-smoke`` runs the no-checkpoint smoke.
@@ -49,11 +54,13 @@ from seist_tpu.serve.protocol import (
     DeadlineExceeded,
     Overloaded,
     PredictOptions,
+    QueueFull,
     ReloadFailed,
     ServeError,
     ShuttingDown,
     json_bytes,
     parse_body,
+    parse_station,
     parse_tasks,
     parse_waveform,
 )
@@ -103,6 +110,7 @@ class ServeService:
         shed_config: Optional[ShedConfig] = None,
         event_log: Optional[Any] = None,  # obs.EventLog
         faults: Optional[ServeFaultInjector] = None,
+        stream_config: Optional[Dict[str, Any]] = None,
     ):
         self.pool = pool
         self.config = batcher_config or BatcherConfig()
@@ -149,9 +157,15 @@ class ServeService:
                 model=name,
             )
         self._annotate_locks = {n: threading.Lock() for n in pool.names()}
+        # /stream: one StationMux (sessions + associator) per picking
+        # model, created lazily on the first stream request for that
+        # model — see _stream_mux_for for the config-freeze contract.
+        self._stream_config = dict(stream_config or {})
+        self._stream_muxes: Dict[str, Any] = {}
+        self._stream_lock = threading.Lock()
         self.annotate_latency_ms = LatencyHistogram()
         self._lock = threading.Lock()
-        self._requests = {"predict": 0, "annotate": 0}
+        self._requests = {"predict": 0, "annotate": 0, "stream": 0}
         self._annotate_windows = 0
         # monotonic: _started_at only ever feeds uptime_s intervals, and a
         # wall-clock step must not make uptime jump (or go negative).
@@ -289,9 +303,15 @@ class ServeService:
         model: Optional[str] = None,
         options: Optional[Dict[str, Any]] = None,
         tasks: Optional[Any] = None,
+        station: Optional[Any] = None,
         trace: Optional[obs_trace.RequestTrace] = None,
     ) -> Dict[str, Any]:
         """One fixed-window trace through the micro-batcher.
+
+        ``station`` (optional ``{"id", "network", "lat", "lon"}``):
+        provenance metadata, validated and echoed back verbatim so a
+        caller fanning one response out into a catalog keeps the trace's
+        origin without a side channel (the same block /stream requires).
 
         ``tasks`` (multi-task groups only): which heads to answer with —
         the shared trunk runs ONCE and fans out to all of them
@@ -311,6 +331,7 @@ class ServeService:
         version = int(getattr(entry, "version", 0) or 0)
         opts = PredictOptions.from_dict(options)
         req_tasks = entry.resolve_tasks(parse_tasks(tasks))
+        station_meta = parse_station(station)
         self._check_variant(entry, opts.variant, req_tasks)
         t.annotate(model=entry.name, variant=opts.variant,
                    tier=opts.priority, version=version)
@@ -372,7 +393,7 @@ class ServeService:
                     if n_real < entry.window:
                         _clip_picks(r, n_real, fs)
                     per_task[tk] = r
-            return {
+            out = {
                 "model": entry.name,
                 # Which checkpoint generation answered — the rollout
                 # acceptance signal (bench_serve by_version accounting).
@@ -383,6 +404,9 @@ class ServeService:
                 "trunk_runs": 1,
                 "variant": opts.variant,
             }
+            if station_meta is not None:
+                out["station"] = station_meta
+            return out
         with t.span("decode"):
             result = decode_outputs(entry, raw, opts)
         if n_real < entry.window:
@@ -391,6 +415,8 @@ class ServeService:
             _clip_picks(result, n_real, fs)
         result["model"] = entry.name
         result["model_version"] = version
+        if station_meta is not None:
+            result["station"] = station_meta
         return result
 
     # ---------------------------------------------------------- annotate
@@ -512,6 +538,198 @@ class ServeService:
                  "offset_s": round(int(b) / fs, 6)}
                 for a, b in picks["det"]
             ],
+        }
+
+    # ------------------------------------------------------------- stream
+    def _stream_mux_for(self, entry: Any, opts: PredictOptions) -> Any:
+        """Lazy per-model StationMux (seist_tpu/stream). The mux — and
+        every session it will ever open — is configured from the FIRST
+        stream request's options plus the server-level stream_config, and
+        frozen: a model's streaming tenant is one coherent pick/stitch
+        config shared by the whole network (per-request knobs belong to
+        /predict and /annotate). Later requests' session options are
+        ignored."""
+        name = entry.name
+        with self._stream_lock:
+            mux = self._stream_muxes.get(name)
+            if mux is None:
+                from seist_tpu.stream.assoc import AssocConfig, Associator
+                from seist_tpu.stream.mux import MuxConfig, StationMux
+                from seist_tpu.stream.session import SessionConfig
+
+                sc = self._stream_config
+                session = SessionConfig(
+                    window=entry.window,
+                    stride=opts.stride or entry.window // 2,
+                    in_channels=entry.in_channels,
+                    channel0=entry.channel0,
+                    combine=opts.combine,
+                    sampling_rate=opts.sampling_rate,
+                    ppk_threshold=opts.ppk_threshold,
+                    spk_threshold=opts.spk_threshold,
+                    det_threshold=opts.det_threshold,
+                    min_peak_dist=opts.min_peak_dist,
+                )
+                assoc = Associator(AssocConfig(
+                    window_s=float(sc.get("assoc_window_s", 30.0)),
+                    min_stations=int(sc.get("assoc_min_stations", 4)),
+                    velocity_kms=float(sc.get("assoc_velocity_kms", 6.0)),
+                    tolerance_s=float(sc.get("assoc_tolerance_s", 2.0)),
+                    grid_step_deg=float(
+                        sc.get("assoc_grid_step_deg", 0.25)
+                    ),
+                ))
+                batcher = self._batcher_for(name, "fp32")
+                timeout_ms = float(opts.timeout_ms)
+
+                def submit(x, _b=batcher, _t=timeout_ms):
+                    # Due windows ride the SAME warm fp32 bucket programs
+                    # /predict runs, at alert rank — thousands of
+                    # stations coalesce in the batcher's flushes with
+                    # zero new compiles (tests/test_stream_mux.py pin).
+                    return _b.submit(x, timeout_ms=_t,
+                                     rank=PRIORITIES["alert"])
+
+                mux = StationMux(
+                    submit,
+                    MuxConfig(
+                        session=session,
+                        max_stations=int(sc.get("max_stations", 4096)),
+                        idle_timeout_s=float(
+                            sc.get("idle_timeout_s", 900.0)
+                        ),
+                        model=name,
+                    ),
+                    assoc=assoc,
+                )
+                self._stream_muxes[name] = mux
+            return mux
+
+    def stream(
+        self,
+        body: Dict[str, Any],
+        trace: Optional[obs_trace.RequestTrace] = None,
+    ) -> Dict[str, Any]:
+        """One station packet into the long-lived streaming plane (``POST
+        /stream``): route it to the station's StreamSession, run whatever
+        windows fell due through the micro-batcher at alert rank, and
+        return the picks that just became final plus any network alerts
+        the associator raised. ``end=true`` flushes the tail window and
+        closes the session. Packets are raw counts — the session applies
+        the same per-window normalization /annotate uses, which is what
+        makes its picks bit-identical to offline re-annotation."""
+        if self._draining:
+            raise ShuttingDown("service is draining")
+        t = obs_trace.ensure(trace)
+        entry = self.pool.get(body.get("model"))
+        if not entry.is_picker:
+            raise BadRequest(
+                f"model '{entry.name}' is not a picking model; /stream "
+                "needs (non|det, ppk, spk) outputs"
+            )
+        if getattr(entry, "is_group", False):
+            raise BadRequest(
+                f"model '{entry.name}' is a multi-task group; /stream "
+                "serves single-task picking models"
+            )
+        options = dict(body.get("options") or {})
+        # Streaming IS the early-warning path: default to the alert tier
+        # (shed last, ride to the 429 bound) unless the caller says so.
+        options.setdefault("priority", "alert")
+        opts = PredictOptions.from_dict(options)
+        if opts.variant != "fp32":
+            raise BadRequest(
+                "variant selection is /predict-only; /stream always "
+                "runs fp32"
+            )
+        station = parse_station(body.get("station"), required=True)
+        end = bool(body.get("end", False))
+        seq = body.get("seq")
+        if seq is not None and (isinstance(seq, bool)
+                                or not isinstance(seq, int)):
+            raise BadRequest("'seq' must be an integer")
+        version = int(getattr(entry, "version", 0) or 0)
+        t.annotate(model=entry.name, tier=opts.priority,
+                   station=station["id"], version=version)
+        with self._lock:
+            self._requests["stream"] += 1
+            n_request = self._requests["stream"]
+        with t.span("admission", tier=opts.priority) as sp:
+            try:
+                self._shedders[entry.name].admit(opts.priority)
+            except Overloaded as e:
+                sp.annotate(verdict="shed",
+                            retry_after_s=round(e.retry_after_s, 3))
+                t.flag("shed")
+                raise
+            sp.annotate(verdict="admitted")
+        with t.span("parse"):
+            if body.get("data") is None:
+                if not end:
+                    raise BadRequest(
+                        "'data' is required unless end=true (a bare "
+                        "end=true flushes and closes the session)"
+                    )
+                x = np.zeros((0, entry.in_channels), np.float32)
+            else:
+                x = parse_waveform(body.get("data"), entry.in_channels)
+        mux = self._stream_mux_for(entry, opts)
+        if n_request % 64 == 0:
+            # Amortized housekeeping: sessions whose station went quiet
+            # past idle_timeout_s are reaped on the request path itself.
+            mux.reap_idle()
+        from seist_tpu.stream.mux import StationLimit
+
+        try:
+            with t.span("stream_feed", station=station["id"],
+                        packet_samples=int(x.shape[0])):
+                result = mux.feed(station, x, seq=seq, end=end)
+        except StationLimit as e:
+            # Same backpressure contract as a full queue: 429, back off.
+            raise QueueFull(str(e)) from None
+        fs = float(mux.config.session.sampling_rate)
+        picks = result["picks"]
+        return {
+            "model": entry.name,
+            "model_version": version,
+            "station": station,
+            "n_samples": int(result["n_samples"]),
+            "windows": int(result["windows"]),
+            "duplicate": bool(result["duplicate"]),
+            "closed": bool(result["closed"]),
+            "degraded": bool(result["degraded"]),
+            "dropped_windows": int(result["dropped_windows"]),
+            "ppk": [
+                {"sample": int(i), "time_s": round(int(i) / fs, 6)}
+                for i in picks["ppk"]
+            ],
+            "spk": [
+                {"sample": int(i), "time_s": round(int(i) / fs, 6)}
+                for i in picks["spk"]
+            ],
+            "det": [
+                {"onset": int(a), "offset": int(b),
+                 "onset_s": round(int(a) / fs, 6),
+                 "offset_s": round(int(b) / fs, 6)}
+                for a, b in picks["det"]
+            ],
+            "alerts": result["alerts"],
+        }
+
+    def stream_alerts(self, n: int = 50) -> Dict[str, Any]:
+        """``GET /stream/alerts``: recent association alerts + mux stats
+        per streaming model — the downstream (alerting UI, twin gate)
+        poll surface."""
+        with self._stream_lock:
+            muxes = dict(self._stream_muxes)
+        return {
+            "models": {
+                name: {
+                    "alerts": mux.assoc.recent_alerts(n),
+                    "stats": mux.stats(),
+                }
+                for name, mux in muxes.items()
+            },
         }
 
     # ------------------------------------------------------------- reload
@@ -664,6 +882,11 @@ class ServeService:
         with self._lock:
             requests = dict(self._requests)
             annotate_windows = self._annotate_windows
+        with self._stream_lock:
+            stream_stats = {
+                name: mux.stats()
+                for name, mux in self._stream_muxes.items()
+            }
         return {
             "uptime_s": round(time.monotonic() - self._started_at, 3),
             "requests": requests,
@@ -679,6 +902,10 @@ class ServeService:
                 name: shedder.stats()
                 for name, shedder in self._shedders.items()
             },
+            # Streaming plane: per-model session/window/pick/alert
+            # accounting (stream_* / assoc_* counters mirror these on
+            # the bus, labeled — docs/OBSERVABILITY.md).
+            "stream": stream_stats,
             # Multi-task groups: trunk-once accounting (trunk_runs,
             # per-head runs, amortized trunk FLOPs, variant gates).
             "fanout": {
@@ -694,6 +921,7 @@ class ServeService:
         m = self.metrics()
         m.pop("models", None)
         m.pop("shed", None)  # AdmissionControllers publish their own
+        m.pop("stream", None)  # StationMux counters publish their own
         return m
 
     def metrics_prometheus(self) -> str:
@@ -717,6 +945,12 @@ class ServeService:
         """Refuse new work, then (with ``drain``) serve what's queued."""
         self._draining = True
         self.publish_state("shutdown")
+        # Streaming sessions close before their batchers stop: a mux
+        # submit into a shut-down batcher would only error anyway.
+        with self._stream_lock:
+            muxes, self._stream_muxes = dict(self._stream_muxes), {}
+        for mux in muxes.values():
+            mux.close_all()
         for batcher in self._batchers.values():
             batcher.shutdown(drain=drain)
         for shedder in self._shedders.values():
@@ -834,6 +1068,8 @@ class _Handler(BaseHTTPRequestHandler):
                 from seist_tpu.obs.bus import BUS
 
                 self._reply(200, BUS.snapshot())
+            elif self.path.split("?", 1)[0] == "/stream/alerts":
+                self._reply(200, self.service.stream_alerts())
             elif self.path.split("?", 1)[0].startswith("/traces"):
                 routed = obs_trace.handle_traces_path(self.path)
                 if routed is None:
@@ -899,7 +1135,7 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 return
             raw = self.rfile.read(length)
-            if self.path in ("/predict", "/annotate"):
+            if self.path in ("/predict", "/annotate", "/stream"):
                 # Continue the upstream trace (bench client / router) or
                 # mint here — the replica is the last possible edge.
                 rt = obs_trace.RequestTrace(
@@ -913,6 +1149,7 @@ class _Handler(BaseHTTPRequestHandler):
                     model=body.get("model"),
                     options=body.get("options"),
                     tasks=body.get("tasks"),
+                    station=body.get("station"),
                     trace=rt,
                 )
             elif self.path == "/annotate":
@@ -922,6 +1159,8 @@ class _Handler(BaseHTTPRequestHandler):
                     options=body.get("options"),
                     trace=rt,
                 )
+            elif self.path == "/stream":
+                result = self.service.stream(body, trace=rt)
             elif self.path == "/admin/reload":
                 # Hot checkpoint rollout (docs/SERVING.md "Live
                 # rollout"): load-gate-swap, incumbent serves throughout;
@@ -1036,6 +1275,25 @@ def get_serve_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     default=float("inf"),
                     help="shed 'alert' tier above this queue delay "
                     "(default: never — alerts ride to the 429 bound)")
+    # Streaming plane (/stream): station mux capacity + cross-station
+    # association (docs/SERVING.md "Streaming inference").
+    ap.add_argument("--stream-max-stations", type=int, default=4096,
+                    help="concurrent streaming sessions per model; new "
+                    "stations past this get 429")
+    ap.add_argument("--stream-idle-timeout-s", type=float, default=900.0,
+                    help="reap a station's session after this much "
+                    "feed silence")
+    ap.add_argument("--assoc-min-stations", type=int, default=4,
+                    help="distinct co-detecting stations to raise a "
+                    "network alert")
+    ap.add_argument("--assoc-window-s", type=float, default=30.0,
+                    help="cross-station co-detection window")
+    ap.add_argument("--assoc-velocity-kms", type=float, default=6.0,
+                    help="P moveout velocity for origin back-projection")
+    ap.add_argument("--assoc-tolerance-s", type=float, default=2.0,
+                    help="origin-time coherence tolerance")
+    ap.add_argument("--assoc-grid-step-deg", type=float, default=0.25,
+                    help="origin grid-search resolution")
     return ap.parse_args(argv)
 
 
@@ -1169,6 +1427,15 @@ def main(argv: Optional[List[str]] = None) -> None:
     service = ServeService(
         pool, config, warmup_async=True, shed_config=shed_config,
         event_log=events,
+        stream_config={
+            "max_stations": args.stream_max_stations,
+            "idle_timeout_s": args.stream_idle_timeout_s,
+            "assoc_min_stations": args.assoc_min_stations,
+            "assoc_window_s": args.assoc_window_s,
+            "assoc_velocity_kms": args.assoc_velocity_kms,
+            "assoc_tolerance_s": args.assoc_tolerance_s,
+            "assoc_grid_step_deg": args.assoc_grid_step_deg,
+        },
     )
     server = start_http_server(service, args.host, args.port)
     host, port = server.server_address[:2]

@@ -1,0 +1,34 @@
+"""Continuous-stream early-warning engine.
+
+Three layers on top of the offline ``ops/stream.annotate`` path:
+
+* :mod:`seist_tpu.stream.session` — per-station :class:`StreamSession`
+  carrying overlap context between packets so each packet costs one
+  stride of model compute, with picks provably identical to offline
+  ``annotate`` on the concatenated record (the parity pin).
+* :mod:`seist_tpu.stream.mux` — :class:`StationMux` funnels thousands
+  of sessions' due windows through the serve replica's MicroBatcher/AOT
+  pool as one tenant (zero new compiles).
+* :mod:`seist_tpu.stream.assoc` — :class:`Associator` clusters
+  co-detections across stations into event hypotheses and emits alerts
+  with per-stage latency stamps.
+
+Serve endpoint: ``POST /stream`` (seist_tpu/serve/server.py).
+Acceptance harness: ``tools/twin.py`` (the network digital twin) and
+``tools/stream_smoke.py``; see docs/SERVING.md "Streaming inference".
+"""
+
+from seist_tpu.stream.assoc import Alert, Associator, AssocConfig
+from seist_tpu.stream.mux import MuxConfig, StationMux
+from seist_tpu.stream.session import DueWindow, SessionConfig, StreamSession
+
+__all__ = [
+    "Alert",
+    "Associator",
+    "AssocConfig",
+    "DueWindow",
+    "MuxConfig",
+    "SessionConfig",
+    "StationMux",
+    "StreamSession",
+]

@@ -146,6 +146,8 @@ _SMOKE_FILES = {
     "test_collectives.py",
     "test_visualization.py",
     "test_stream.py",
+    "test_stream_session.py",
+    "test_stream_mux.py",
     "test_supervise.py",
     "test_native.py",
     "test_bench_unit.py",

@@ -116,6 +116,25 @@ def test_catalog_rows_schema_and_determinism():
     assert json.loads(lines[0]) == rows[0]
 
 
+def test_catalog_rows_station_provenance():
+    """--station-meta passthrough: rows whose key has station metadata
+    carry it verbatim; rows without stay byte-identical to before."""
+    decoded = {"dpk": {"ppk": np.array([[5, -1], [7, -1]])}}
+    stations = {"a": {"id": "CI.ABC", "network": "CI",
+                      "lat": 35.0, "lon": -117.0}}
+    rows = catalog_rows(
+        decoded, n_valid=2, row_ids=[0, 1], keys=["a", "b"],
+        stations=stations,
+    )
+    assert rows[0]["station"] == stations["a"]
+    assert "station" not in rows[1]
+    # No keys -> stations ignored (nothing to join on).
+    plain = catalog_rows(decoded, n_valid=2, row_ids=[0, 1],
+                         stations=stations)
+    assert all("station" not in r for r in plain)
+    json.loads(catalog_row_lines(rows)[0])  # still canonical JSONL
+
+
 def test_decode_head_batch_drops_dense_channels():
     import jax.numpy as jnp
 

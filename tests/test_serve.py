@@ -1073,9 +1073,13 @@ class TestServeFlightDumps:
             raise RuntimeError("flush machinery broke")
 
         b._run_batch = boom
+        # Generous timing margins: the flush thread must get scheduled
+        # to die AND serialize the dump (a full bus snapshot, sizable
+        # late in a suite run) — under full-suite contention on a
+        # 1-core host either can overshoot a tight budget.
         with pytest.raises(ServeError, match="flush thread died"):
-            b.submit(np.zeros((2,), np.float32), timeout_ms=2000)
-        deadline = time.monotonic() + 5
+            b.submit(np.zeros((2,), np.float32), timeout_ms=10_000)
+        deadline = time.monotonic() + 30
         while not self._dumps(recorder, "batcher_flush_death"):
             if time.monotonic() > deadline:
                 raise AssertionError("no batcher_flush_death flight dump")

@@ -136,10 +136,13 @@ def _predict(host, port, timeout=5.0):
         conn.close()
 
 
-def _wait_ready(host, port, n, timeout_s=20.0):
+def _wait_ready(host, port, n, timeout_s=60.0):
     """Wait for n replicas with a PROBED-ok state (a just-registered
     replica is optimistically routable before its process has even bound
-    the port, so /healthz ready_replicas alone races the spawn)."""
+    the port, so /healthz ready_replicas alone races the spawn). The
+    budget is deliberately generous: under full-suite contention on a
+    1-core host, spawning N interpreters that each import jax can
+    overshoot 20 s without anything being wrong."""
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         try:

@@ -1,0 +1,292 @@
+"""POST /stream serve integration (seist_tpu/serve/server.py): the
+long-lived streaming plane against a REAL phasenet pool — session
+lifecycle over the wire shape, streaming<->/annotate parity through the
+actual micro-batcher, station metadata validation + /predict echo, and
+the metrics/alerts surfaces."""
+
+import json
+
+import numpy as np
+import pytest
+
+from seist_tpu.serve.protocol import BadRequest, parse_station
+
+WINDOW = 256
+
+
+@pytest.fixture(scope="module")
+def service():
+    from seist_tpu.serve import BatcherConfig as BC
+    from seist_tpu.serve import ModelPool, ServeService
+
+    pool = ModelPool([("phasenet", "")], window=WINDOW)
+    svc = ServeService(
+        pool,
+        BC(max_batch=4, max_delay_ms=5.0, max_queue=64),
+        stream_config={
+            "assoc_min_stations": 3,
+            "assoc_window_s": 60.0,
+            "assoc_tolerance_s": 3.0,
+            "max_stations": 64,
+        },
+    )
+    yield svc
+    svc.shutdown()
+
+
+@pytest.fixture(scope="module")
+def fake_service():
+    """ServeService over a deterministic batch-invariant picker entry:
+    probabilities depend only on each window's own samples, so bucket-1
+    (stream) and bucket-4 (annotate) programs are bitwise identical and
+    the serve-plane parity pin can be EXACT. (The real-model fixture's
+    bucket programs differ in float fusion order — borderline threshold
+    crossers flip; real-model parity is tolerance-gated in the stream
+    smoke instead.)"""
+    from types import SimpleNamespace
+
+    from seist_tpu.serve import BatcherConfig as BC
+    from seist_tpu.serve import ServeService
+
+    def run(x, variant="fp32"):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        a = jnp.abs(x[..., 0])
+        p = a / (a.max(axis=1, keepdims=True) + 1e-9)
+        s = jnp.clip(jnp.abs(x[..., 1]) / 3.0, 0.0, 1.0)
+        return jnp.stack([1.0 - p, p, s], axis=-1)
+
+    entry = SimpleNamespace(
+        name="envpick", window=WINDOW, in_channels=3, channel0="non",
+        is_picker=True, is_group=False, version=1, variants=("fp32",),
+        run=run,
+    )
+
+    class Pool:
+        warmup_report = []
+
+        def names(self):
+            return ["envpick"]
+
+        def get(self, name=None):
+            return entry
+
+        def warmup(self, buckets):
+            pass
+
+    svc = ServeService(Pool(), BC(max_batch=4, max_delay_ms=5.0,
+                                  max_queue=64))
+    yield svc
+    svc.shutdown()
+
+
+# All /stream requests in this module share one options set: the mux
+# (and its session config) freezes on the FIRST stream request.
+# record_max_events keeps /annotate's pick capacity from binding (the
+# session side is unbounded — parity holds modulo that cap, see
+# seist_tpu/stream/session.py).
+OPTS = {"ppk_threshold": 0.05, "spk_threshold": 0.05, "det_threshold": 0.05,
+        "combine": "max", "record_max_events": 350}
+
+
+def _record(length, seed=0):
+    return np.random.default_rng(seed).standard_normal(
+        (length, 3)).astype(np.float32)
+
+
+def _stream_record(service, station, rec, packet=97, model="phasenet"):
+    """Feed one record through /stream in packets; return merged picks +
+    the per-request responses."""
+    out = {"ppk": [], "spk": [], "det": []}
+    responses = []
+    pos = 0
+    seq = 0
+    while pos < len(rec):
+        seq += 1
+        r = service.stream({
+            "model": model,
+            "station": station,
+            "data": rec[pos : pos + packet].tolist(),
+            "seq": seq,
+            "options": OPTS,
+        })
+        responses.append(r)
+        out["ppk"] += [p["sample"] for p in r["ppk"]]
+        out["spk"] += [p["sample"] for p in r["spk"]]
+        out["det"] += [(d["onset"], d["offset"]) for d in r["det"]]
+        pos += packet
+    r = service.stream({
+        "model": model, "station": station, "end": True,
+        "seq": seq + 1, "options": OPTS,
+    })
+    responses.append(r)
+    out["ppk"] += [p["sample"] for p in r["ppk"]]
+    out["spk"] += [p["sample"] for p in r["spk"]]
+    out["det"] += [(d["onset"], d["offset"]) for d in r["det"]]
+    assert r["closed"] is True
+    return out, responses
+
+
+class TestStreamEndpoint:
+    def test_stream_matches_annotate(self, fake_service):
+        """The serve-plane parity pin: a record streamed in packets
+        through the real batcher yields the same picks as one POST
+        /annotate of the concatenated record."""
+        rec = _record(700, seed=1)
+        got, responses = _stream_record(
+            fake_service, {"id": "PAR1"}, rec, packet=97,
+            model="envpick",
+        )
+        offline = fake_service.annotate(rec.tolist(), options=OPTS)
+        assert sorted(got["ppk"]) == sorted(
+            p["sample"] for p in offline["ppk"]
+        )
+        assert sorted(got["spk"]) == sorted(
+            p["sample"] for p in offline["spk"]
+        )
+        assert sorted(got["det"]) == sorted(
+            (d["onset"], d["offset"]) for d in offline["det"]
+        )
+        # Total windows match the offline count; picks came out along
+        # the way, not all in the final flush.
+        assert sum(r["windows"] for r in responses) == offline["windows"]
+        assert responses[-1]["n_samples"] == 700
+
+    def test_duplicate_packet_dropped(self, service):
+        st = {"id": "DUP1"}
+        rec = _record(WINDOW, seed=2)
+        service.stream({"model": "phasenet", "station": st,
+                        "data": rec.tolist(), "seq": 7, "options": OPTS})
+        r = service.stream({"model": "phasenet", "station": st,
+                           "data": rec.tolist(), "seq": 7, "options": OPTS})
+        assert r["duplicate"] is True and r["windows"] == 0
+        service.stream({"model": "phasenet", "station": st, "end": True,
+                        "seq": 8, "options": OPTS})
+
+    def test_station_required_and_validated(self, service):
+        rec = _record(32, seed=3)
+        with pytest.raises(BadRequest, match="station"):
+            service.stream({"model": "phasenet", "data": rec.tolist(),
+                            "options": OPTS})
+        with pytest.raises(BadRequest, match="lat"):
+            service.stream({
+                "model": "phasenet",
+                "station": {"id": "X", "lat": 35.0},  # lon missing
+                "data": rec.tolist(), "options": OPTS,
+            })
+        with pytest.raises(BadRequest, match="seq"):
+            service.stream({
+                "model": "phasenet", "station": {"id": "X"},
+                "data": rec.tolist(), "seq": "one", "options": OPTS,
+            })
+        with pytest.raises(BadRequest, match="data"):
+            service.stream({"model": "phasenet", "station": {"id": "X"},
+                            "options": OPTS})
+
+    def test_network_codetection_alerts(self, service):
+        """Co-located stations streaming the SAME record pick the same
+        times -> the associator must raise exactly one network alert."""
+        rec = _record(600, seed=4)
+        geometry = [
+            {"id": "EW1", "network": "CI", "lat": 35.00, "lon": -117.00},
+            {"id": "EW2", "network": "CI", "lat": 35.05, "lon": -117.05},
+            {"id": "EW3", "network": "CI", "lat": 35.02, "lon": -116.95},
+        ]
+        alerts = []
+        for st in geometry:
+            _, responses = _stream_record(service, st, rec, packet=200)
+            for r in responses:
+                alerts.extend(r["alerts"])
+        assert len(alerts) >= 1
+        assert alerts[0]["n_stations"] >= 3
+        assert "sample_to_alert" in alerts[0]["latency_ms"]
+        recent = service.stream_alerts()
+        assert recent["models"]["phasenet"]["alerts"], (
+            "alert must be retained for GET /stream/alerts"
+        )
+
+    def test_metrics_surface(self, service):
+        m = service.metrics()
+        assert m["requests"]["stream"] > 0
+        s = m["stream"]["phasenet"]
+        assert s["windows"] > 0 and s["packets"] > 0
+        # Bus collector half must not double-publish mux counters.
+        assert "stream" not in service._bus_metrics()
+
+
+class TestPredictStationEcho:
+    def test_predict_echoes_station(self, service):
+        trace = _record(WINDOW, seed=5)
+        st = {"id": "CI.ABC", "network": "CI", "lat": 35.0, "lon": -117.0}
+        r = service.predict(trace.tolist(), station=st,
+                            options={"ppk_threshold": 0.05})
+        assert r["station"] == st
+
+    def test_predict_without_station_unchanged(self, service):
+        trace = _record(WINDOW, seed=6)
+        r = service.predict(trace.tolist(), options={"ppk_threshold": 0.05})
+        assert "station" not in r
+
+
+class TestParseStation:
+    def test_normalizes(self):
+        got = parse_station({"id": "A", "lat": 1, "lon": 2.5})
+        assert got == {"id": "A", "network": "", "lat": 1.0, "lon": 2.5}
+
+    def test_absent_ok_unless_required(self):
+        assert parse_station(None) is None
+        with pytest.raises(BadRequest):
+            parse_station(None, required=True)
+
+    @pytest.mark.parametrize("bad", [
+        {"id": ""}, {"id": 3}, {"network": "CI"},
+        {"id": "A", "lat": 95.0, "lon": 0.0},
+        {"id": "A", "lat": float("nan"), "lon": 0.0},
+        {"id": "A", "lat": True, "lon": 0.0},
+        {"id": "A", "unknown": 1}, "CI.ABC",
+    ])
+    def test_rejects(self, bad):
+        with pytest.raises(BadRequest):
+            parse_station(bad)
+
+
+class TestStreamBench:
+    """tools/bench_serve.py --stream-stations: the high-fan-in client."""
+
+    def test_stream_bench_json_contract(self, tmp_path):
+        import tools.bench_serve as bench_serve
+
+        out = tmp_path / "bench.json"
+        rc = bench_serve.main([
+            "--model-name", "phasenet", "--window", "256",
+            "--stream-stations", "6", "--concurrency", "3",
+            "--duration-s", "1.0", "--stream-cadence-s", "0.2",
+            "--output", str(out),
+        ])
+        assert rc == 0
+        got = json.loads(out.read_text())
+        assert got["metric"] == "serve_stream_latency"
+        assert got["mode"] == "stream-open-loop"
+        assert got["stations"] == 6
+        assert got["errors"] == 0 and got["ok"] > 0
+        assert got["p99_ms"] > 0 and got["windows"] > 0
+        # Per-station accounting: every station reported, worst list
+        # is real station ids.
+        assert got["stations_reporting"] == 6
+        assert got["station_mean_ms"]["max"] >= got["station_mean_ms"]["p50"]
+        assert all(w["id"].startswith("BN") for w in got["worst_stations"])
+        # The service-side counters rode along.
+        assert got["stream_stats"]["sessions_opened"] == 6.0
+        assert got["stream_stats"]["windows_dropped"] == 0.0
+
+    def test_stream_bench_slo_gate_trips(self, tmp_path):
+        import tools.bench_serve as bench_serve
+
+        rc = bench_serve.main([
+            "--model-name", "phasenet", "--window", "256",
+            "--stream-stations", "2", "--concurrency", "2",
+            "--duration-s", "0.6", "--stream-cadence-s", "0.2",
+            "--slo-p99-ms", "0.001",
+        ])
+        assert rc == bench_serve.SLO_EXIT_CODE

@@ -161,6 +161,75 @@ class TestMaxNonChannelSemantics:
         assert on == off == 10
 
 
+class TestShortRecordPadAndTrim:
+    """annotate's edge contract: a record shorter than one window is zero
+    right-padded to exactly one window, scored, and trimmed back — picks
+    in the pad dropped, detections clipped, prob at the true length."""
+
+    @staticmethod
+    def _spike_apply(x):
+        import jax.numpy as jnp
+
+        a = jnp.abs(x[..., 0])
+        p = a / (a.max(axis=1, keepdims=True) + 1e-9)
+        return jnp.stack([1.0 - p, p, jnp.zeros_like(p)], axis=-1)
+
+    def test_short_record_scores_and_trims(self):
+        rec = np.zeros((40, 3), np.float32)
+        rec[10:13, 0] = 30.0
+        out = annotate(
+            self._spike_apply, rec, window=64, stride=32, batch_size=1,
+            ppk_threshold=0.5, min_peak_dist=0.1, channel0="non",
+        )
+        assert out["prob"].shape == (40, 3)  # trimmed to the true length
+        assert len(out["ppk"]) >= 1
+        assert all(0 <= p < 40 for p in out["ppk"])
+        assert all(on < 40 and off < 40 for on, off in out["det"])
+
+    def test_pad_region_pick_dropped(self):
+        """A peak the padded tail manufactures must not escape the trim."""
+        rec = np.zeros((20, 3), np.float32)
+        rec[-3:, 0] = 25.0  # ramp ends AT the pad boundary
+        out = annotate(
+            self._spike_apply, rec, window=64, stride=32, batch_size=1,
+            ppk_threshold=0.3, min_peak_dist=0.1, channel0="non",
+        )
+        assert all(p < 20 for p in out["ppk"])
+        assert all(off <= 19 for _, off in out["det"])
+
+    def test_empty_record_raises(self):
+        with pytest.raises(ValueError):
+            annotate(
+                self._spike_apply, np.zeros((0, 3), np.float32),
+                window=64, channel0="non",
+            )
+
+    def test_exact_window_unaffected(self):
+        """L == window takes the normal path (no pad, no trim)."""
+        rng = np.random.default_rng(4)
+        rec = rng.standard_normal((64, 3)).astype(np.float32)
+        out = annotate(
+            self._spike_apply, rec, window=64, stride=32, batch_size=1,
+            channel0="non",
+        )
+        assert out["prob"].shape == (64, 3)
+
+    def test_nonmultiple_tail_right_aligned(self):
+        """Non-stride-multiple tails: the final window is right-aligned
+        (window_offsets clamps to L - window) — pinned explicitly as the
+        tail half of the edge contract."""
+        from seist_tpu.ops.stream import window_offsets
+
+        offs = list(window_offsets(150, 64, 32))
+        assert offs == [0, 32, 64, 86]  # 86 == 150 - 64, not 96
+        out = annotate(
+            self._spike_apply,
+            np.zeros((150, 3), np.float32), window=64, stride=32,
+            batch_size=2, channel0="non",
+        )
+        assert out["prob"].shape == (150, 3)
+
+
 class TestDetChannelSemantics:
     def test_det_channel0(self):
         """seist-dpk/eqtransformer convention: channel 0 IS event prob

@@ -1,0 +1,244 @@
+"""StationMux + Associator (seist_tpu/stream/mux.py, assoc.py): dedup,
+backpressure accounting, association geometry, and the thousand-station
+zero-compile pin — sessions are host state; the device sees only the
+same warm bucketed forward regardless of how many stations stream.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from seist_tpu.serve.batcher import BatcherConfig, MicroBatcher
+from seist_tpu.serve.protocol import QueueFull
+from seist_tpu.stream.assoc import AssocConfig, Associator, StationPick
+from seist_tpu.stream.mux import MuxConfig, StationLimit, StationMux
+from seist_tpu.stream.session import SessionConfig
+
+W = 32  # tiny window keeps these tests fast
+SESS = SessionConfig(window=W, stride=16, channel0="non",
+                     sampling_rate=50, min_peak_dist=0.1)
+
+
+def _direct_submit(x):
+    """Synchronous fake forward: P prob = normalized |ch0| envelope."""
+    a = np.abs(x[:, 0])
+    p = (a / (a.max() + 1e-9)).astype(np.float32)
+    out = np.stack([1.0 - p, p, np.zeros_like(p)], axis=-1)
+    return out
+
+
+def _spiky(n=W, at=None):
+    rec = np.random.default_rng(0).standard_normal((n, 3)).astype(np.float32) * 0.01
+    if at is not None:
+        rec[at : at + 3, 0] += 50.0
+    return rec
+
+
+class TestMux:
+    def test_feed_runs_windows_and_picks(self):
+        mux = StationMux(_direct_submit, MuxConfig(session=SESS))
+        out = mux.feed({"id": "ST01"}, _spiky(64, at=10))
+        assert out["windows"] == 3  # offsets 0, 16, 32
+        assert out["picks"]["ppk"], "interior spike must surface mid-stream"
+        assert mux.stats()["sessions"] == 1.0
+
+    def test_duplicate_and_gap_accounting(self):
+        mux = StationMux(_direct_submit, MuxConfig(session=SESS))
+        st = {"id": "ST01"}
+        mux.feed(st, _spiky(16), seq=1)
+        dup = mux.feed(st, _spiky(16), seq=1)  # replayed packet
+        assert dup["duplicate"] is True
+        assert dup["windows"] == 0
+        mux.feed(st, _spiky(16), seq=5)  # jumped 2..4
+        s = mux.stats()
+        assert s["duplicates"] == 1.0 and s["gaps"] == 1.0
+
+    def test_end_closes_session(self):
+        mux = StationMux(_direct_submit, MuxConfig(session=SESS))
+        out = mux.feed({"id": "ST01"}, _spiky(40, at=5), end=True)
+        assert out["closed"] is True
+        assert mux.n_sessions == 0
+        # tail window (offset 8) ran: 40 samples -> regular 0 + tail
+        assert out["windows"] == 2
+
+    def test_station_capacity(self):
+        mux = StationMux(_direct_submit, MuxConfig(session=SESS, max_stations=2))
+        mux.feed({"id": "A"}, _spiky(8))
+        mux.feed({"id": "B"}, _spiky(8))
+        with pytest.raises(StationLimit):
+            mux.feed({"id": "C"}, _spiky(8))
+
+    def test_backpressure_marks_degraded(self):
+        calls = {"n": 0}
+
+        def flaky(x):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise QueueFull("stream window", "queue full")
+            return _direct_submit(x)
+
+        mux = StationMux(flaky, MuxConfig(session=SESS))
+        st = {"id": "ST01"}
+        mux.feed(st, _spiky(32))
+        with pytest.raises(QueueFull):
+            mux.feed(st, _spiky(32))  # second window refused
+        s = mux.stats()
+        assert s["windows_dropped"] == 1.0
+        assert s["degraded_sessions"] == 1.0
+        # The stream survives: later packets keep working on the holey curve.
+        out = mux.feed(st, _spiky(32))
+        assert out["degraded"] is True and out["windows"] >= 1
+
+    def test_reap_idle(self):
+        t = [0.0]
+        mux = StationMux(
+            _direct_submit,
+            MuxConfig(session=SESS, idle_timeout_s=10.0),
+            clock=lambda: t[0],
+        )
+        mux.feed({"id": "A"}, _spiky(8))
+        t[0] = 11.0
+        assert mux.reap_idle() == 1
+        assert mux.n_sessions == 0
+
+
+class TestAssociator:
+    GEOM = [("S1", 35.0, -117.0), ("S2", 35.2, -117.1), ("S3", 35.1, -116.8),
+            ("S4", 34.9, -117.2), ("N1", 36.5, -118.5)]
+
+    def _pick(self, sid, lat, lon, t, stamps=None):
+        return StationPick(station_id=sid, network="CI", lat=lat, lon=lon,
+                           t_s=t, stamps=stamps or {})
+
+    def test_coherent_picks_alert_once(self):
+        cfg = AssocConfig(min_stations=4, window_s=30.0, tolerance_s=2.0)
+        a = Associator(cfg, clock=lambda: 123.0)
+        # Event at (35.05, -117.05), t0=100: arrivals = t0 + dist/v.
+        from seist_tpu.stream.assoc import _dist_km
+
+        alerts = []
+        for sid, lat, lon in self.GEOM[:4]:
+            t = 100.0 + _dist_km(35.05, -117.05, lat, lon) / cfg.velocity_kms
+            got = a.add(self._pick(sid, lat, lon, t))
+            if got:
+                alerts.append(got)
+        assert len(alerts) == 1
+        al = alerts[0]
+        assert al.n_stations == 4
+        assert abs(al.origin_t_s - 100.0) < 2.0
+        assert abs(al.origin_lat - 35.05) < 0.5
+        # Contributing picks consumed: the same event doesn't re-alert.
+        assert a.stats()["pending_picks"] == 0.0
+
+    def test_incoherent_noise_never_alerts(self):
+        cfg = AssocConfig(min_stations=4, window_s=30.0, tolerance_s=1.0)
+        a = Associator(cfg)
+        # Same 4 stations but wildly incompatible arrival times.
+        for i, (sid, lat, lon) in enumerate(self.GEOM[:4]):
+            assert a.add(self._pick(sid, lat, lon, 100.0 + i * 20.0)) is None
+
+    def test_distant_noise_station_excluded(self):
+        cfg = AssocConfig(min_stations=4, window_s=30.0, tolerance_s=2.0)
+        a = Associator(cfg, clock=lambda: 0.0)
+        from seist_tpu.stream.assoc import _dist_km
+
+        a.add(self._pick("N1", 36.5, -118.5, 101.0))  # incompatible outlier
+        got = None
+        for sid, lat, lon in self.GEOM[:4]:
+            t = 100.0 + _dist_km(35.05, -117.05, lat, lon) / cfg.velocity_kms
+            got = a.add(self._pick(sid, lat, lon, t)) or got
+        assert got is not None
+        assert all(p.station_id != "N1" for p in got.picks)
+
+    def test_latency_stamps_flow_to_alert(self):
+        cfg = AssocConfig(min_stations=2, window_s=30.0, tolerance_s=2.0)
+        a = Associator(cfg, clock=lambda: 10.0)
+        stamps = {"arrival": 1.0, "due": 1.1, "submitted": 1.2,
+                  "returned": 1.5, "picked": 1.6}
+        a.add(self._pick("S1", 35.0, -117.0, 100.0, stamps=stamps))
+        al = a.add(self._pick("S2", 35.1, -117.1, 100.5, stamps=stamps))
+        assert al is not None
+        lm = al.latency_ms
+        assert lm["sample_to_alert"] == pytest.approx((10.0 - 1.0) * 1000.0)
+        assert lm["queue_device"] == pytest.approx(300.0)
+        assert "association" in lm
+
+
+class TestMuxAssociation:
+    def test_network_codetection_alerts_through_mux(self):
+        cfg = MuxConfig(session=SESS)
+        assoc = Associator(AssocConfig(min_stations=3, window_s=60.0,
+                                       tolerance_s=3.0))
+        mux = StationMux(_direct_submit, cfg, assoc=assoc)
+        stations = [
+            {"id": "S1", "network": "CI", "lat": 35.0, "lon": -117.0},
+            {"id": "S2", "network": "CI", "lat": 35.1, "lon": -117.1},
+            {"id": "S3", "network": "CI", "lat": 35.05, "lon": -116.9},
+        ]
+        alerts = []
+        for st in stations:
+            out = mux.feed(st, _spiky(64, at=20))  # same spike position
+            alerts.extend(out["alerts"])
+        assert len(alerts) == 1
+        assert alerts[0]["n_stations"] == 3
+        assert mux.stats()["alerts"] == 1.0
+
+
+@pytest.mark.slow  # ~1000 sessions x several packets through a real batcher
+def test_thousand_station_mux_zero_post_warmup_compiles():
+    """The acceptance pin: >= 1000 concurrent sessions multiplex through
+    ONE jitted bucketed forward with ZERO XLA compiles after warmup —
+    sessions are host-side state, invisible to the device."""
+    import jax
+    import jax.numpy as jnp
+
+    from tools.jaxlint.runtime import CompileBudget
+
+    buckets = (1, 2, 4, 8)
+
+    @jax.jit
+    def fwd(x):
+        a = jnp.abs(x[..., 0])
+        p = a / (a.max(axis=1, keepdims=True) + 1e-9)
+        return jnp.stack([1.0 - p, p, jnp.zeros_like(p)], axis=-1)
+
+    def forward(batch):
+        return np.asarray(fwd(jnp.asarray(batch)))
+
+    batcher = MicroBatcher(
+        forward,
+        BatcherConfig(max_batch=8, max_delay_ms=2.0, buckets=buckets,
+                      max_queue=4096),
+        name="stream-test",
+    )
+
+    def submit(x):
+        return batcher.submit(x, timeout_ms=30_000.0)[0]
+
+    n_stations = 1000
+    mux = StationMux(submit, MuxConfig(session=SESS, max_stations=2048))
+    rng = np.random.default_rng(0)
+    packets = {
+        f"T{i:04d}": rng.standard_normal((3, W + 8, 3)).astype(np.float32)
+        for i in range(n_stations)
+    }
+
+    # Warmup: every bucket shape compiles once outside the budget.
+    for b in buckets:
+        forward(np.zeros((b, W, 3), np.float32))
+
+    with CompileBudget() as budget:
+        with ThreadPoolExecutor(16) as ex:
+            for round_i in range(3):
+                list(ex.map(
+                    lambda kv: mux.feed({"id": kv[0]}, kv[1][round_i]),
+                    packets.items(),
+                ))
+    assert mux.n_sessions == n_stations
+    assert mux.stats()["windows"] >= n_stations  # windows actually flowed
+    assert budget.total() == 0, (
+        f"post-warmup compiles: {budget.signatures()}"
+    )
+    batcher.shutdown()

@@ -1,0 +1,230 @@
+"""Streaming <-> offline parity pin (seist_tpu/stream/session.py).
+
+A StreamSession fed one record in ANY packet schedule must emit exactly
+the picks offline ``ops/stream.annotate`` produces on the concatenated
+record — same P/S indices, same detection intervals (order-insensitive
+for det: annotate returns duration-sorted rows, the session emits in
+positional order). The property holds across packet sizes (1 sample,
+primes, whole windows), both combine modes, both channel0 conventions,
+tail/no-tail record lengths, and the short-record pad-and-trim edge.
+"""
+
+import numpy as np
+import pytest
+
+from seist_tpu.ops.stream import annotate
+from seist_tpu.stream.session import SessionConfig, StreamSession
+
+
+def _fake_apply(x):
+    """Deterministic per-window 'model': P prob from the normalized |z|
+    envelope. Elementwise per window -> batch-size invariant, so offline
+    (batched) and streaming (one window at a time) forwards are bitwise
+    identical — isolating the parity pin to the session's own math."""
+    import jax.numpy as jnp
+
+    a = jnp.abs(x[..., 0])
+    p = a / (a.max(axis=1, keepdims=True) + 1e-9)
+    s = jnp.clip(jnp.abs(x[..., 1]) / 3.0, 0.0, 1.0)
+    return jnp.stack([1.0 - p, p, s], axis=-1)
+
+
+def _det_apply(x):
+    """'det' convention model: channel 0 IS event probability."""
+    import jax.numpy as jnp
+
+    a = jnp.abs(x[..., 0])
+    p = a / (a.max(axis=1, keepdims=True) + 1e-9)
+    d = jnp.clip(p * 1.5, 0.0, 1.0)
+    return jnp.stack([d, p, jnp.zeros_like(p)], axis=-1)
+
+
+def _record(length, seed=0, events=()):
+    rng = np.random.default_rng(seed)
+    rec = (rng.standard_normal((length, 3)) * 0.1).astype(np.float32)
+    for e in events:
+        rec[e : e + 4, 0] += 40.0
+        rec[min(e + 30, length - 1), 1] += 6.0
+    return rec
+
+
+def _stream_picks(apply_fn, rec, cfg, packets):
+    """Drive a session with the given packet schedule; return the union
+    of emitted picks plus emission bookkeeping."""
+    import jax.numpy as jnp
+
+    sess = StreamSession(cfg)
+    emitted_before_finish = {"ppk": 0, "spk": 0, "det": 0}
+    pos = 0
+    for size in packets:
+        for w in sess.push(rec[pos : pos + size]):
+            probs = np.asarray(apply_fn(jnp.asarray(w.data[None])))[0]
+            got = sess.integrate(w.offset, probs)
+            for k in emitted_before_finish:
+                emitted_before_finish[k] += len(got[k])
+        pos += size
+    assert pos == len(rec)
+    for w in sess.finish():
+        probs = np.asarray(apply_fn(jnp.asarray(w.data[None])))[0]
+        sess.integrate(w.offset, probs)
+    sess.finalize()
+    return sess, emitted_before_finish
+
+
+def _schedules(length):
+    return {
+        "single-sample": [1] * length,
+        "prime-7": [7] * (length // 7) + ([length % 7] if length % 7 else []),
+        "prime-13": [13] * (length // 13) + ([length % 13] if length % 13 else []),
+        "whole-window": [64] * (length // 64) + ([length % 64] if length % 64 else []),
+        "one-shot": [length],
+    }
+
+
+def _assert_parity(sess, offline):
+    got = sess.picks
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(got["ppk"], np.int64)), np.sort(np.asarray(offline["ppk"]))
+    )
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(got["spk"], np.int64)), np.sort(np.asarray(offline["spk"]))
+    )
+    mine = sorted((int(a), int(b)) for a, b in got["det"])
+    theirs = sorted((int(a), int(b)) for a, b in np.asarray(offline["det"]))
+    assert mine == theirs
+
+
+CFG = dict(window=64, stride=32, sampling_rate=50, min_peak_dist=0.1)
+
+
+class TestParity:
+    @pytest.mark.parametrize("schedule", ["single-sample", "prime-7", "prime-13",
+                                          "whole-window", "one-shot"])
+    @pytest.mark.parametrize("length", [64, 200, 256, 331])
+    def test_non_mean(self, schedule, length):
+        rec = _record(length, seed=length, events=[40, length // 2])
+        offline = annotate(
+            _fake_apply, rec, window=64, stride=32, batch_size=4,
+            sampling_rate=50, min_peak_dist=0.1, channel0="non",
+            max_events=min(length // 2, 512),
+        )
+        cfg = SessionConfig(channel0="non", combine="mean", **CFG)
+        sess, _ = _stream_picks(_fake_apply, rec, cfg, _schedules(length)[schedule])
+        _assert_parity(sess, offline)
+
+    @pytest.mark.parametrize("schedule", ["single-sample", "prime-13", "one-shot"])
+    def test_non_max_combine(self, schedule):
+        length = 300
+        rec = _record(length, seed=3, events=[50, 180])
+        offline = annotate(
+            _fake_apply, rec, window=64, stride=32, batch_size=4,
+            sampling_rate=50, min_peak_dist=0.1, combine="max", channel0="non",
+            max_events=min(length // 2, 512),
+        )
+        cfg = SessionConfig(channel0="non", combine="max", **CFG)
+        sess, _ = _stream_picks(_fake_apply, rec, cfg, _schedules(length)[schedule])
+        _assert_parity(sess, offline)
+
+    @pytest.mark.parametrize("combine", ["mean", "max"])
+    def test_det_channel0(self, combine):
+        length = 220
+        rec = _record(length, seed=9, events=[70])
+        offline = annotate(
+            _det_apply, rec, window=64, stride=32, batch_size=4,
+            sampling_rate=50, min_peak_dist=0.1, combine=combine, channel0="det",
+            max_events=min(length // 2, 512),
+        )
+        cfg = SessionConfig(channel0="det", combine=combine, **CFG)
+        sess, _ = _stream_picks(_det_apply, rec, cfg, _schedules(length)["prime-7"])
+        _assert_parity(sess, offline)
+
+    def test_nms_adversarial_chain(self):
+        """A comb of near-threshold peaks mpd apart exercises the greedy
+        NMS component closure — the hardest part of incremental parity."""
+        length = 400
+        rng = np.random.default_rng(11)
+        rec = (rng.standard_normal((length, 3)) * 0.05).astype(np.float32)
+        for i, p in enumerate(range(30, 370, 9)):
+            rec[p, 0] = 20.0 + (7.0 if i % 3 else -3.0) + 0.3 * i
+        offline = annotate(
+            _fake_apply, rec, window=64, stride=32, batch_size=4,
+            sampling_rate=50, min_peak_dist=0.2, channel0="non",  # mpd=10 > 9
+            max_events=min(length // 2, 512),
+        )
+        cfg = SessionConfig(window=64, stride=32, sampling_rate=50,
+                            min_peak_dist=0.2, channel0="non")
+        for schedule in ("single-sample", "prime-7", "one-shot"):
+            sess, _ = _stream_picks(_fake_apply, rec, cfg,
+                                    _schedules(length)[schedule])
+            _assert_parity(sess, offline)
+
+    def test_short_record_pad_and_trim(self):
+        """Records shorter than one window: both sides pad to one window,
+        score, and trim — and still agree."""
+        for length in (5, 33, 63):
+            rec = _record(length, seed=length, events=[min(10, length - 4)])
+            offline = annotate(
+                _fake_apply, rec, window=64, stride=32, batch_size=1,
+                sampling_rate=50, min_peak_dist=0.1, channel0="non",
+                max_events=32,  # <= detect_events capacity of the padded window
+            )
+            assert offline["prob"].shape == (length, 3)
+            cfg = SessionConfig(channel0="non", **CFG)
+            sess, _ = _stream_picks(_fake_apply, rec, cfg, [length])
+            _assert_parity(sess, offline)
+            assert all(p < length for p in sess.picks["ppk"])
+            assert all(off <= length - 1 for _, off in sess.picks["det"])
+
+
+class TestLiveness:
+    def test_emits_before_finish(self):
+        """Picks in the interior must come out mid-stream (alert latency),
+        not be hoarded until finish()."""
+        length = 1024
+        rec = _record(length, seed=2, events=[100, 400, 700])
+        cfg = SessionConfig(channel0="non", **CFG)
+        sess, before = _stream_picks(
+            _fake_apply, rec, cfg, _schedules(length)["whole-window"]
+        )
+        assert before["ppk"] >= 2  # interior events emitted mid-stream
+        assert len(sess.picks["ppk"]) >= 3
+
+    def test_state_is_bounded(self):
+        """Ring buffer and curve stay O(window + stride) on a long quiet
+        stream — the whole point of a *streaming* session."""
+        cfg = SessionConfig(channel0="non", **CFG)
+        sess = StreamSession(cfg)
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            for w in sess.push(rng.standard_normal((97, 3)).astype(np.float32)):
+                probs = np.zeros((cfg.window, 3), np.float32)
+                probs[:, 0] = 1.0
+                sess.integrate(w.offset, probs)
+        assert sess.context_samples <= cfg.window + cfg.stride
+        assert sess._hits.shape[0] <= 8 * cfg.window  # trimmed, not O(stream)
+
+    def test_push_after_finish_raises(self):
+        sess = StreamSession(SessionConfig(channel0="non", **CFG))
+        sess.finish()
+        with pytest.raises(RuntimeError):
+            sess.push(np.zeros((1, 3), np.float32))
+
+    def test_empty_stream(self):
+        sess = StreamSession(SessionConfig(channel0="non", **CFG))
+        assert sess.finish() == []
+        assert sess.finalize() == {"ppk": [], "spk": [], "det": []}
+
+
+class TestConfig:
+    def test_bad_channel0(self):
+        with pytest.raises(ValueError):
+            SessionConfig(channel0="noise")
+
+    def test_bad_stride(self):
+        with pytest.raises(ValueError):
+            SessionConfig(window=64, stride=0)
+
+    def test_bad_packet_shape(self):
+        sess = StreamSession(SessionConfig(channel0="non", **CFG))
+        with pytest.raises(ValueError):
+            sess.push(np.zeros((4, 2), np.float32))

@@ -116,6 +116,18 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     "for stitching ANY request with tools/trace_report.py "
                     "(the output JSON always carries the slowest-N and "
                     "failed exemplars)")
+    # High-fan-in streaming mode (POST /stream): N stations on an
+    # open-loop packet cadence, per-station latency accounting.
+    ap.add_argument("--stream-stations", type=int, default=0,
+                    help="streaming bench: drive this many stations "
+                    "through POST /stream on an open-loop per-station "
+                    "packet cadence (0 = normal /predict bench)")
+    ap.add_argument("--stream-cadence-s", type=float, default=0.0,
+                    help="seconds between one station's packets "
+                    "(0 = real time: packet_samples / 50 Hz)")
+    ap.add_argument("--stream-packet-samples", type=int, default=0,
+                    help="samples per packet (0 = window // 2, one "
+                    "stride per packet at the default session stride)")
     return ap.parse_args(argv)
 
 
@@ -313,6 +325,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         from seist_tpu.utils.platform import honor_jax_platforms
 
         honor_jax_platforms()
+
+    if args.stream_stations > 0:
+        return _run_stream_bench(args)
 
     import numpy as np
 
@@ -634,6 +649,255 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             result["slo_violations"] = []
 
+    line = json.dumps(result)
+    print(line)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+    return rc
+
+
+def _run_stream_bench(args) -> int:
+    """``--stream-stations N``: the high-fan-in streaming client. N
+    stations each POST /stream packets on their own open-loop cadence
+    (launch at t0 + k*cadence regardless of completions — the production
+    telemetry model: a seismic network does not slow down because the
+    server is busy). ``--concurrency`` workers each OWN stations
+    ``w::W``, preserving per-station packet ordering (a station's seq
+    numbers must arrive in order; different stations are independent).
+
+    The JSON carries aggregate packet-latency percentiles PLUS
+    per-station accounting — percentiles over station mean latencies and
+    the worst stations by mean — so one hot station can't hide in (or
+    masquerade as) a fleet-wide tail. ``--slo-p99-ms`` gates the
+    aggregate p99 exactly like the /predict bench."""
+    import numpy as np
+
+    n_st = int(args.stream_stations)
+    duration = args.duration_s or 10.0
+    pkt = args.stream_packet_samples or args.window // 2
+    cadence = args.stream_cadence_s or pkt / 50.0
+    options: Dict[str, Any] = {"timeout_ms": args.timeout_ms}
+    if args.priority:
+        options["priority"] = args.priority
+
+    service = None
+    if args.url:
+        import http.client
+
+        from seist_tpu.serve.router import _http_request
+
+        def send(body: Dict[str, Any]):
+            raw = json.dumps(body).encode()
+            try:
+                status, _, resp = _http_request(
+                    args.url, "POST", "/stream", raw,
+                    timeout_s=args.timeout_ms / 1000.0 + 5.0,
+                )
+            except (OSError, http.client.HTTPException) as e:
+                return 0, {"error": "unreachable", "message": str(e)}
+            try:
+                out = json.loads(resp)
+            except ValueError:
+                out = {}
+            return status, out if isinstance(out, dict) else {}
+
+    else:
+        from seist_tpu.serve import BatcherConfig, ModelPool, ServeService
+        from seist_tpu.serve.protocol import ServeError
+
+        pool = ModelPool(
+            [(args.model_name, args.checkpoint)], window=args.window,
+            seed=args.seed,
+        )
+        service = ServeService(
+            pool,
+            BatcherConfig(
+                max_batch=args.max_batch,
+                max_delay_ms=args.max_delay_ms,
+                max_queue=args.max_queue,
+            ),
+            stream_config={"max_stations": max(4096, 2 * n_st)},
+        )
+        options.update(ppk_threshold=0.05, spk_threshold=0.05)
+
+        def send(body: Dict[str, Any]):
+            try:
+                return 200, service.stream(body)
+            except ServeError as e:
+                return e.status, e.payload()
+
+    rng = np.random.default_rng(args.seed)
+    # A small shared packet pool: per-station payload identity doesn't
+    # matter for latency, and N_stations x duration packets would not
+    # fit memory at thousand-station scale.
+    packets = [
+        rng.standard_normal((pkt, args.in_channels))
+        .astype(np.float32).tolist()
+        for _ in range(16)
+    ]
+    # Station grid over ~2 deg so coordinates are plausible and the
+    # association path runs (alerts on synthetic noise are fine — the
+    # bench measures the pipeline, not seismology).
+    side = max(1, int(np.ceil(np.sqrt(n_st))))
+    stations = [
+        {"id": f"BN{i:05d}", "network": "BN",
+         "lat": round(34.0 + 2.0 * (i // side) / side, 4),
+         "lon": round(-118.0 + 2.0 * (i % side) / side, 4)}
+        for i in range(n_st)
+    ]
+
+    lock = threading.Lock()
+    agg = {"ok": 0, "errors": 0, "windows": 0, "picks": 0, "alerts": 0,
+           "dropped_windows": 0, "by_status": {}}
+    latencies: List[float] = []
+    per_station: Dict[str, List[float]] = {s["id"]: [] for s in stations}
+    n_workers = max(1, min(args.concurrency, n_st))
+    t0 = time.monotonic()
+    deadline = t0 + duration
+
+    def worker(w: int) -> None:
+        # Whole body under try: (threadlint thread-target-raises).
+        try:
+            mine = stations[w::n_workers]
+            seqs = {s["id"]: 0 for s in mine}
+            rounds = 0
+            while True:
+                for st in mine:
+                    seqs[st["id"]] += 1
+                    body = {
+                        "station": st,
+                        "data": packets[
+                            (rounds + hash(st["id"])) % len(packets)
+                        ],
+                        "seq": seqs[st["id"]],
+                        "options": options,
+                    }
+                    if args.model_name:
+                        body["model"] = args.model_name
+                    t_send = time.monotonic()
+                    status, resp = send(body)
+                    lat_ms = (time.monotonic() - t_send) * 1000.0
+                    with lock:
+                        agg["by_status"][status] = (
+                            agg["by_status"].get(status, 0) + 1
+                        )
+                        if status == 200:
+                            agg["ok"] += 1
+                            latencies.append(lat_ms)
+                            per_station[st["id"]].append(lat_ms)
+                            agg["windows"] += resp.get("windows", 0)
+                            agg["picks"] += (
+                                len(resp.get("ppk", []))
+                                + len(resp.get("spk", []))
+                                + len(resp.get("det", []))
+                            )
+                            agg["alerts"] += len(resp.get("alerts", []))
+                            agg["dropped_windows"] = max(
+                                agg["dropped_windows"],
+                                resp.get("dropped_windows", 0),
+                            )
+                        else:
+                            agg["errors"] += 1
+                rounds += 1
+                # Open loop: the next round launches on the cadence
+                # clock, not after completions.
+                target = t0 + rounds * cadence
+                now = time.monotonic()
+                if now >= deadline:
+                    return
+                if target > now:
+                    time.sleep(min(target, deadline) - now)
+        except BaseException as e:  # noqa: BLE001
+            print(f"[bench_serve] stream worker {w} died: {e!r}",
+                  file=sys.stderr, flush=True)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.monotonic() - t0
+
+    stream_stats: Dict[str, Any] = {}
+    if service is not None:
+        stream_stats = service.metrics()["stream"].get(args.model_name, {})
+        service.shutdown()
+
+    lat = np.asarray(latencies) if latencies else None
+
+    def pct(a, q):
+        return round(float(np.percentile(a, q)), 3) if a is not None and len(a) else -1.0
+
+    means = {
+        sid: float(np.mean(v)) for sid, v in per_station.items() if v
+    }
+    mean_arr = np.asarray(list(means.values())) if means else None
+    worst = sorted(means.items(), key=lambda kv: -kv[1])[:5]
+    total = agg["ok"] + agg["errors"]
+    result = {
+        "metric": "serve_stream_latency",
+        "model": args.model_name,
+        "target": args.url or "in-process",
+        "mode": "stream-open-loop",
+        "stations": n_st,
+        "concurrency": n_workers,
+        "cadence_s": round(cadence, 4),
+        "packet_samples": pkt,
+        "duration_s": round(wall_s, 3),
+        "packets": total,
+        "ok": agg["ok"],
+        "errors": agg["errors"],
+        "error_rate": round(agg["errors"] / total, 4) if total else 0.0,
+        "by_status": dict(sorted(agg["by_status"].items())),
+        "windows": agg["windows"],
+        "picks": agg["picks"],
+        "alerts": agg["alerts"],
+        "p50_ms": pct(lat, 50),
+        "p90_ms": pct(lat, 90),
+        "p99_ms": pct(lat, 99),
+        "mean_ms": round(float(lat.mean()), 3) if lat is not None else -1.0,
+        "packets_per_s": round(agg["ok"] / wall_s, 2) if wall_s else 0.0,
+        # Per-station accounting: a single hot station must be visible.
+        "station_mean_ms": {
+            "p50": pct(mean_arr, 50),
+            "p99": pct(mean_arr, 99),
+            "max": round(float(mean_arr.max()), 3) if mean_arr is not None else -1.0,
+        },
+        "worst_stations": [
+            {"id": sid, "mean_ms": round(m, 3)} for sid, m in worst
+        ],
+        "stations_reporting": len(means),
+        "stream_stats": stream_stats,
+        "measured_at": datetime.now(timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ"
+        ),
+    }
+    rc = 0
+    if args.slo_p99_ms > 0:
+        violations = []
+        if lat is None:
+            violations.append("no successful packets")
+        elif result["p99_ms"] > args.slo_p99_ms:
+            violations.append(
+                f"p99 {result['p99_ms']:.1f} ms > SLO "
+                f"{args.slo_p99_ms:.1f} ms"
+            )
+        if result["error_rate"] > args.max_error_rate:
+            violations.append(
+                f"error_rate {result['error_rate']:.4f} > "
+                f"{args.max_error_rate:.4f}"
+            )
+        result["slo_violations"] = violations
+        if violations:
+            print(
+                f"[bench_serve] SLO GATE FAILED: {'; '.join(violations)}",
+                file=sys.stderr, flush=True,
+            )
+            rc = SLO_EXIT_CODE
     line = json.dumps(result)
     print(line)
     if args.output:

@@ -102,6 +102,11 @@ def get_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--det-threshold", type=float, default=0.5)
     ap.add_argument("--min-peak-dist", type=float, default=1.0)
     ap.add_argument("--max-events", type=int, default=8)
+    ap.add_argument("--station-meta", default="", metavar="FILE",
+                    help="JSON file mapping waveform key -> station "
+                    "metadata {'id', 'network', 'lat', 'lon'}; matched "
+                    "rows carry a 'station' field in the catalog "
+                    "(the /predict //stream provenance block)")
     args = ap.parse_args(argv)
     if args.merge_only:
         # The reduce is model-free: identity comes from repick_plan.json.
@@ -268,6 +273,7 @@ def run_worker(args, worker_index: int, num_workers: int) -> int:
             "max_events": args.max_events,
         },
         keys=keys,
+        stations=_load_station_meta(args.station_meta),
         prefetch=args.prefetch,
         tasks=[t for t in args.tasks.split(",") if t] or None,
     )
@@ -304,6 +310,28 @@ def run_worker(args, worker_index: int, num_workers: int) -> int:
     return 0
 
 
+def _load_station_meta(path: str):
+    """--station-meta FILE -> {key: normalized station dict} or None.
+    Validated through the same parse_station the serve plane uses, so a
+    catalog's 'station' blocks and a /stream request's are one schema."""
+    if not path:
+        return None
+    from seist_tpu.serve.protocol import BadRequest, parse_station
+
+    with open(path) as f:
+        raw = json.load(f)
+    if not isinstance(raw, dict):
+        raise SystemExit(f"--station-meta {path}: want a JSON object "
+                         "mapping waveform key -> station metadata")
+    out = {}
+    for key, st in raw.items():
+        try:
+            out[str(key)] = parse_station(st, required=True)
+        except BadRequest as e:
+            raise SystemExit(f"--station-meta {path}: key {key!r}: {e}")
+    return out
+
+
 def _units_from_cols(cols):
     from seist_tpu.batch import catalog
 
@@ -337,6 +365,8 @@ def _worker_cmd(args, worker_index: int) -> List[str]:
         cmd += ["--tasks", args.tasks]
     if args.compile_gate:
         cmd += ["--compile-gate"]
+    if args.station_meta:
+        cmd += ["--station-meta", args.station_meta]
     return cmd
 
 

@@ -1,0 +1,268 @@
+"""Stream smoke (`make stream-smoke`): a REAL phasenet serve replica is
+driven over HTTP by a 50-station streaming network for 30 s of waveform
+per station, then audited on the two invariants the streaming plane
+sells (docs/SERVING.md "Streaming inference"):
+
+* **zero dropped alert-tier windows** — every due window of every
+  session rode the alert tier through the batcher; no 429/503, no
+  coverage holes, no degraded sessions;
+* **streaming<->offline parity on sampled stations** — 3 stations'
+  full records are re-picked through ``POST /annotate`` with the same
+  options and the pick sets must agree. The gate is tolerance-based,
+  not exact: the offline path batches windows into the largest warm
+  bucket while the mux submits singles, and XLA fuses the two batch
+  shapes differently, so a pick whose peak probability sits within
+  float-rounding of the threshold can legitimately appear on one side
+  only (the EXACT serve-plane pin lives in tests/test_serve_stream.py
+  against a batch-invariant model). Each side may strand at most 10%
+  of the union, and matched picks must land within +-2 samples.
+
+Prints one JSON verdict line; exit 0/1.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+WINDOW = 256
+STATIONS = 50
+RECORD_S = 30.0
+FS = 50
+PACKET = WINDOW // 2
+WORKERS = 8
+SAMPLED = 3  # stations re-picked offline for the parity gate
+OPTS = {"ppk_threshold": 0.3, "spk_threshold": 0.3, "det_threshold": 0.3,
+        "record_max_events": 700}
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _drain(pipe, buf):
+    try:
+        for line in pipe:
+            buf.append(line)
+    except Exception as e:  # noqa: BLE001
+        buf.append(f"[stream_smoke] pipe drain died: {e!r}\n")
+
+
+def _post(url, path, body, timeout_s=60.0):
+    from seist_tpu.serve.router import _http_request
+
+    status, _, resp = _http_request(
+        url, "POST", path, json.dumps(body).encode(), timeout_s=timeout_s
+    )
+    return status, json.loads(resp)
+
+
+def _match(a, b, tol=2):
+    """Greedy one-to-one matching of two ascending pick lists within
+    ``tol`` samples; returns the number matched."""
+    n, i, j = 0, 0, 0
+    a, b = sorted(a), sorted(b)
+    while i < len(a) and j < len(b):
+        if abs(a[i] - b[j]) <= tol:
+            n += 1
+            i += 1
+            j += 1
+        elif a[i] < b[j]:
+            i += 1
+        else:
+            j += 1
+    return n
+
+
+def _parity(stream_picks, offline, verdict_rows, sid):
+    """Tolerance gate for one station (module docstring)."""
+    ok = True
+    for phase in ("ppk", "spk"):
+        s = stream_picks[phase]
+        o = [p["sample"] for p in offline[phase]]
+        matched = _match(s, o)
+        union = len(s) + len(o) - matched
+        stranded = union - matched
+        row_ok = union == 0 or stranded <= max(1, int(0.1 * union))
+        verdict_rows.append({
+            "station": sid, "phase": phase, "stream": len(s),
+            "offline": len(o), "matched": matched, "ok": row_ok,
+        })
+        ok = ok and row_ok
+    return ok
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    port = _free_port()
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.join(REPO, "main.py"), "serve",
+            "--model", "phasenet=",
+            "--window", str(WINDOW),
+            "--port", str(port),
+            "--max-batch", "8",
+            "--max-delay-ms", "5",
+            "--max-queue", "512",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
+    )
+    out_buf, err_buf = [], []
+    threading.Thread(target=_drain, args=(proc.stdout, out_buf),
+                     daemon=True).start()
+    threading.Thread(target=_drain, args=(proc.stderr, err_buf),
+                     daemon=True).start()
+    url = f"http://127.0.0.1:{port}"
+    verdict = {"metric": "stream_smoke", "ok": False}
+    try:
+        from seist_tpu.serve.router import _http_request
+
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            try:
+                status, _, _ = _http_request(
+                    url, "GET", "/healthz/ready", timeout_s=3.0
+                )
+                if status == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.3)
+        else:
+            verdict["error"] = "replica never became ready"
+            return _finish(proc, err_buf, verdict)
+
+        L = int(RECORD_S * FS)
+        rng = np.random.default_rng(0)
+        waves = {
+            f"SM{i:03d}": rng.standard_normal((L, 3)).astype(np.float32)
+            for i in range(STATIONS)
+        }
+        sids = list(waves)
+        lock = threading.Lock()
+        tally = {"packets": 0, "rejects": 0, "dropped": 0, "degraded": 0}
+        stream_picks = {
+            sid: {"ppk": [], "spk": []} for sid in sids[:SAMPLED]
+        }
+
+        def worker(w):
+            # Whole body under try: (threadlint thread-target-raises).
+            try:
+                mine = sids[w::WORKERS]
+                n_rounds = (L + PACKET - 1) // PACKET
+                for r in range(n_rounds + 1):
+                    for sid in mine:
+                        body = {
+                            "model": "phasenet",
+                            "station": {"id": sid, "network": "SM"},
+                            "seq": r + 1,
+                            "options": OPTS,
+                        }
+                        if r < n_rounds:
+                            body["data"] = (
+                                waves[sid][r * PACKET : (r + 1) * PACKET].tolist()
+                            )
+                        else:
+                            body["end"] = True
+                        try:
+                            status, resp = _post(url, "/stream", body)
+                        except Exception as e:  # noqa: BLE001
+                            with lock:
+                                tally["rejects"] += 1
+                            sys.stderr.write(f"[stream_smoke] {sid}: {e!r}\n")
+                            continue
+                        with lock:
+                            tally["packets"] += 1
+                            if status != 200:
+                                tally["rejects"] += 1
+                                continue
+                            tally["dropped"] = max(
+                                tally["dropped"], resp["dropped_windows"]
+                            )
+                            tally["degraded"] += bool(resp["degraded"])
+                            if sid in stream_picks:
+                                for ph in ("ppk", "spk"):
+                                    stream_picks[sid][ph] += [
+                                        p["sample"] for p in resp[ph]
+                                    ]
+            except BaseException as e:  # noqa: BLE001
+                with lock:
+                    tally["rejects"] += 1
+                sys.stderr.write(f"[stream_smoke] worker {w} died: {e!r}\n")
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(WORKERS)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        verdict["stream_wall_s"] = round(time.monotonic() - t0, 3)
+        verdict.update(tally)
+
+        status, _, body = _http_request(url, "GET", "/metrics", timeout_s=10.0)
+        stream_stats = json.loads(body).get("stream", {}).get("phasenet", {})
+        verdict["stream_stats"] = stream_stats
+
+        rows = []
+        parity_ok = True
+        for sid in sids[:SAMPLED]:
+            status, offline = _post(url, "/annotate", {
+                "model": "phasenet",
+                "data": waves[sid].tolist(),
+                "options": OPTS,
+            }, timeout_s=120.0)
+            if status != 200:
+                rows.append({"station": sid, "error": offline})
+                parity_ok = False
+                continue
+            parity_ok = _parity(
+                stream_picks[sid], offline, rows, sid
+            ) and parity_ok
+        verdict["parity"] = rows
+
+        verdict["ok"] = bool(
+            tally["rejects"] == 0
+            and tally["dropped"] == 0
+            and tally["degraded"] == 0
+            and stream_stats.get("windows_dropped") == 0.0
+            and stream_stats.get("sessions_closed") == float(STATIONS)
+            and parity_ok
+        )
+        return _finish(proc, err_buf, verdict)
+    except BaseException:
+        _finish(proc, err_buf, verdict)
+        raise
+
+
+def _finish(proc, err_buf, verdict) -> int:
+    proc.send_signal(signal.SIGINT)
+    try:
+        proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    print(json.dumps(verdict), flush=True)
+    if not verdict["ok"]:
+        sys.stderr.write("".join(err_buf)[-4000:])
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
