@@ -7,7 +7,7 @@ CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 .PHONY: native test t1 lint lint-baseline irlint-report lockgraph \
 	serve-smoke serve-chaos obs-smoke trace-smoke rollout-smoke chaos \
 	pack-smoke bench-loader repick-smoke bench-repick stream-smoke \
-	twin-smoke clean
+	twin-smoke stream-chaos clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -175,6 +175,18 @@ rollout-smoke:
 serve-chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serve_chaos.py \
 	  tests/test_serve_fleet.py tests/test_router.py -q \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+# Streaming chaos lane (docs/FAULT_TOLERANCE.md "Streaming faults"): the
+# twin's exported mainshock schedule replayed against a REAL 3-replica
+# twin_replica fleet — SIGKILL on the station-heavy replica mid-
+# mainshock (journal restore + router re-home, exactly-once alerts at
+# the consumer) and a drop/dup/reorder packet-fault run. Each test
+# prints a `[stream-chaos] VERDICT {json}` line. Subset of `make chaos`
+# (the tests carry the chaos marker), runnable alone when iterating on
+# stream/.
+stream-chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_stream_chaos.py -q \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
 
 clean:

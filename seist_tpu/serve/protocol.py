@@ -82,6 +82,14 @@ class DeadlineExceeded(ServeError):
 
 
 class ShuttingDown(ServeError):
+    """The replica is draining (SIGTERM latch) or its stream mux has
+    been closed (``MuxClosed``) — nothing here is wrong with the
+    request. 503 is deliberate: the router treats it as retryable, so
+    an in-flight ``/stream`` packet re-routes to a surviving replica,
+    which restores the station's session from its journal (or
+    gap-stitches a fresh one). The failover handoff IS this status
+    code."""
+
     status = 503
     code = "shutting_down"
 
@@ -327,6 +335,11 @@ def parse_station(obj: Any, required: bool = False) -> Optional[Dict[str, Any]]:
     sid = obj.get("id")
     if not isinstance(sid, str) or not sid:
         raise BadRequest("'station.id' must be a non-empty string")
+    if len(sid) > 64:
+        # Journal filenames slug the id (stream/journal.py) and router
+        # affinity hashes it; a bounded id keeps slugs collision-free
+        # and is far beyond any real SEED/FDSN station code.
+        raise BadRequest("'station.id' must be <= 64 characters")
     out: Dict[str, Any] = {"id": sid, "network": ""}
     net = obj.get("network")
     if net is not None:

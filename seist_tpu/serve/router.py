@@ -50,11 +50,27 @@ Error classification (drives retry + breaker):
 
 Counters land on the PR 6 metrics bus (``seist_router_*``), scraped from
 the router's own ``GET /metrics``.
+
+**Streaming (``POST /stream``) routes differently.** A stream packet is
+not stateless: the replica holds the station's session (ring buffer,
+picker cursors), so round-robin would shatter every session across the
+fleet. :class:`StationAffinity` pins each station to one replica by
+rendezvous hash over the *currently routable* set — deterministic (every
+router instance computes the same placement, no coordination state),
+minimally disruptive (a replica leaving re-homes only ITS stations;
+survivors keep theirs). When a replica dies (breaker open, probe-down,
+``mark_down``), the next packet's rendezvous simply lands on the
+station's highest-ranked survivor, which restores the session from the
+shared journal (seist_tpu/stream/journal.py) or re-warms through the
+gap — ``seist_stream_rehome_total`` counts each adoption. Stream packets
+are never hedged or shadow-mirrored: duplicating a stateful packet to a
+second replica would fork the session.
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import http.client
 import json
 import re
@@ -351,6 +367,60 @@ class ReplicaRegistry:
         return [r.snapshot() for r in self.replicas()]
 
 
+class StationAffinity:
+    """Rendezvous-hash station -> replica placement (for ``/stream``).
+
+    Stateless where it can be: the hash ranks every (station, replica)
+    pair deterministically, so placement is a pure function of the
+    routable set — no placement table to replicate, no rebalance storm
+    when a replica bounces. The only state kept is the last observed
+    home per station, purely for *accounting*: when a packet lands on a
+    different replica than its predecessor, that is a re-home (failover
+    or fleet change) and ``seist_stream_rehome_total`` counts it."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._homes: Dict[str, str] = {}
+        self.rehomes = 0
+
+    @staticmethod
+    def score(station_id: str, url: str) -> int:
+        """Deterministic rendezvous weight (highest wins)."""
+        digest = hashlib.sha1(f"{station_id}|{url}".encode()).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    def rank(self, station_id: str, urls) -> List[str]:
+        """Replica urls best-first for ``station_id`` (ties by url)."""
+        return sorted(
+            urls, key=lambda u: (-self.score(station_id, u), u)
+        )
+
+    def note(self, station_id: str, url: str) -> Optional[str]:
+        """Record that ``station_id``'s packet was answered by ``url``;
+        returns the PREVIOUS home iff it changed (a re-home)."""
+        with self._lock:
+            prev = self._homes.get(station_id)
+            self._homes[station_id] = url
+            if prev is not None and prev != url:
+                self.rehomes += 1
+                return prev
+        return None
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Placement summary published under ``/router/replicas`` — the
+        chaos lane reads ``by_replica`` to find the station-heavy
+        replica worth killing."""
+        with self._lock:
+            by_replica: Dict[str, int] = {}
+            for url in self._homes.values():
+                by_replica[url] = by_replica.get(url, 0) + 1
+            return {
+                "stations": len(self._homes),
+                "rehomes": self.rehomes,
+                "by_replica": by_replica,
+            }
+
+
 # --------------------------------------------------------------- outcomes
 class _Outcome:
     """One attempt's result. ``status=0`` means a network-level failure
@@ -447,6 +517,8 @@ class Router:
         # candidate must not accumulate one blocked thread per mirrored
         # request (overflow is dropped and counted skipped_busy).
         self._mirror_slots = threading.Semaphore(8)
+        # Station -> replica placement for the stateful /stream path.
+        self.affinity = StationAffinity()
         self._prober: Optional[threading.Thread] = None
         self._stop = threading.Event()
         bus.register_collector("router", self._collect)
@@ -543,14 +615,20 @@ class Router:
         rt = obs_trace.RequestTrace(
             traceparent, name=f"router:{path}", process="router"
         )
-        status, headers, payload = self._forward_routed(path, body, rt)
+        if path == "/stream":
+            status, headers, payload = self._forward_stream(path, body, rt)
+        else:
+            status, headers, payload = self._forward_routed(path, body, rt)
         if self._rollback_to_flag:
             # The canary auto-rollback fired during this request's
             # routing: flag its trace (tail-retained) so the event is
             # findable from /traces, not just the bus counter.
             self._rollback_to_flag = False
             rt.flag("canary_rollback")
-        self._maybe_mirror(path, body, status, payload, rt.trace_id)
+        if path != "/stream":
+            # Never mirror a stream packet: a shadow copy would open a
+            # phantom session on the candidate and fork station state.
+            self._maybe_mirror(path, body, status, payload, rt.trace_id)
         total_ms = rt.finish(status)
         headers = dict(headers)
         upstream_timing = headers.pop("Server-Timing", None)
@@ -609,6 +687,107 @@ class Router:
                     # A relayed shed verdict is deliberate policy, not a
                     # router failure — its own retention flag.
                     rt.flag("shed")
+                return self._relay(outcome)
+            last = outcome
+        if last is not None:
+            return self._relay(last)
+        self._bus.counter("router_no_replica").inc()
+        rt.annotate(no_replica=True)
+        return (
+            503,
+            {},
+            json.dumps(
+                {"error": "no_replica",
+                 "message": "no routable replica in the registry"}
+            ).encode(),
+        )
+
+    # --------------------------------------------------- stream affinity
+    # Routing heuristic only (the replica re-validates): pull station.id
+    # out of the raw packet without JSON-decoding the waveform body —
+    # same contract as _budget_s. The station object is flat (protocol
+    # parse_station fields), so a brace-free inner match suffices.
+    _STATION_OBJ_RE = re.compile(rb'"station"\s*:\s*\{([^{}]*)\}')
+    _STATION_ID_RE = re.compile(rb'"id"\s*:\s*"((?:[^"\\]|\\.)*)"')
+
+    @classmethod
+    def _station_id(cls, body: bytes) -> Optional[str]:
+        m = cls._STATION_OBJ_RE.search(body)
+        if m is None:
+            return None
+        m2 = cls._STATION_ID_RE.search(m.group(1))
+        if m2 is None:
+            return None
+        try:
+            # json.loads on the quoted token resolves \-escapes exactly
+            # the way the replica's real parser will.
+            sid = json.loads((b'"' + m2.group(1) + b'"').decode())
+        except (ValueError, UnicodeDecodeError):
+            return None
+        return str(sid) or None
+
+    def _pick_station(
+        self, station_id: str, tried: Set[str]
+    ) -> Optional[Replica]:
+        """Rendezvous pick: the station's highest-ranked routable
+        replica whose breaker admits the request. ``allow()`` is asked
+        in rank order only until one admits (it may consume the single
+        half-open probe slot, so never poll it speculatively). Canary
+        cohorts are deliberately ignored — a session cannot be split
+        across versions mid-record."""
+        replicas = {
+            r.url: r
+            for r in self.registry.replicas()
+            if r.probe_ready and r.url not in tried
+        }
+        for url in self.affinity.rank(station_id, replicas):
+            if replicas[url].breaker.allow():
+                return replicas[url]
+        return None
+
+    def _forward_stream(
+        self, path: str, body: bytes, rt: obs_trace.RequestTrace
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        """Affinity-routed /stream: pick by rendezvous hash, retry down
+        the station's rank order (the failover re-home), never hedge."""
+        self._bus.counter("router_requests", path="stream").inc()
+        sid = self._station_id(body)
+        if sid is None:
+            # No parsable station id: fall back to the stateless loop —
+            # the replica will answer 400 with the protocol's message.
+            return self._forward_routed(path, body, rt)
+        deadline = time.monotonic() + self._budget_s(body)
+        tried: Set[str] = set()
+        attempts_left = 1 + max(0, int(self.config.retries))
+        last: Optional[_Outcome] = None
+        while attempts_left > 0 and time.monotonic() < deadline:
+            replica = self._pick_station(sid, tried)
+            if replica is None and tried:
+                replica = self._pick_station(sid, frozenset())
+            if replica is None:
+                break
+            attempts_left -= 1
+            if tried:
+                self._bus.counter("router_retries").inc()
+                rt.flag("retried")
+            tried.add(replica.url)
+            outcome = self._attempt(replica, path, body, deadline, rt=rt)
+            _, retryable = self._settle(replica, outcome)
+            if not retryable:
+                if (
+                    outcome.status == 503
+                    and outcome.error_code() == "shed"
+                ):
+                    rt.flag("shed")
+                if outcome.status < 500:
+                    # This replica owns the station now (it answered the
+                    # packet); a changed home is a re-home — the
+                    # failover event the chaos lane gates on.
+                    prev = self.affinity.note(sid, replica.url)
+                    if prev is not None:
+                        self._bus.counter("stream_rehome").inc()
+                        rt.flag("rehomed")
+                        rt.annotate(rehome_from=prev, station=sid)
                 return self._relay(outcome)
             last = outcome
         if last is not None:
@@ -1030,9 +1209,12 @@ class Router:
 
     def _collect(self) -> Dict[str, Any]:
         replicas = self.registry.snapshot()
+        affinity = self.affinity.snapshot()
         return {
             "replicas": len(replicas),
             "replicas_ready": sum(1 for r in replicas if r["ready"]),
+            "stream_stations": affinity["stations"],
+            "stream_rehomes": affinity["rehomes"],
             "breakers_open": sum(
                 1 for r in replicas if r["breaker"]["state"] != CLOSED
             ),
@@ -1046,6 +1228,7 @@ class Router:
         return {
             "replicas": self.registry.snapshot(),
             "ready": self.registry.ready_count(),
+            "stream": self.affinity.snapshot(),
             "canary": self.canary.status(),
             "shadow": self.shadow.status(),
             "config": {
@@ -1201,7 +1384,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 return
             body = self.rfile.read(length)
             path = self.path.split("?", 1)[0]
-            if path in ("/predict", "/annotate"):
+            if path in ("/predict", "/annotate", "/stream"):
                 status, headers, payload = self.router.forward(
                     path, body,
                     traceparent=self.headers.get(

@@ -65,7 +65,7 @@ from seist_tpu.serve.protocol import (
     parse_waveform,
 )
 from seist_tpu.serve.shed import AdmissionController, ShedConfig
-from seist_tpu.utils.faults import ServeFaultInjector
+from seist_tpu.utils.faults import ServeFaultInjector, stream_faults
 from seist_tpu.utils.logger import logger
 from seist_tpu.utils.meters import LatencyHistogram
 
@@ -163,6 +163,12 @@ class ServeService:
         self._stream_config = dict(stream_config or {})
         self._stream_muxes: Dict[str, Any] = {}
         self._stream_lock = threading.Lock()
+        # Streaming-plane fault injection (SEIST_FAULT_STREAM_*): the
+        # module singleton so journal.py's corrupt hook and the /stream
+        # kill share one stamp. Reorder faults hold a packet here until
+        # the station's next one arrives (delivered late -> stale seq).
+        self._stream_faults = stream_faults()
+        self._held_packets: Dict[Any, Any] = {}
         self.annotate_latency_ms = LatencyHistogram()
         self._lock = threading.Lock()
         self._requests = {"predict": 0, "annotate": 0, "stream": 0}
@@ -570,6 +576,31 @@ class ServeService:
                     det_threshold=opts.det_threshold,
                     min_peak_dist=opts.min_peak_dist,
                 )
+                # Durability plane (docs/FAULT_TOLERANCE.md "Streaming
+                # faults"): a shared journal_dir turns this replica into
+                # a crash-survivable stream home — sessions journal
+                # every journal_every_s, the associator WALs each alert
+                # before a consumer can see it, and a restart (or a
+                # failover survivor pointed at the same dir) seeds its
+                # dedup window from the WAL so nothing double-alerts.
+                journal_dir = sc.get("journal_dir") or None
+                journal = None
+                wal = None
+                if journal_dir:
+                    from seist_tpu.obs.trace import replica_suffix
+                    from seist_tpu.stream.journal import (
+                        AlertWAL,
+                        StationJournal,
+                    )
+
+                    journal = StationJournal(str(journal_dir), model=name)
+                    # Per-replica WAL file (the journal dir is shared by
+                    # the fleet; alerts are per-associator and must not
+                    # interleave across writers).
+                    wal = AlertWAL(os.path.join(
+                        str(journal_dir), name,
+                        f"alerts{replica_suffix()}.wal",
+                    ))
                 assoc = Associator(AssocConfig(
                     window_s=float(sc.get("assoc_window_s", 30.0)),
                     min_stations=int(sc.get("assoc_min_stations", 4)),
@@ -578,7 +609,17 @@ class ServeService:
                     grid_step_deg=float(
                         sc.get("assoc_grid_step_deg", 0.25)
                     ),
-                ))
+                    dedup_window_s=float(
+                        sc.get("assoc_dedup_window_s", 2.0)
+                    ),
+                ), wal=wal)
+                if wal is not None:
+                    seeded = assoc.seed_from_wal()
+                    if seeded:
+                        logger.info(
+                            f"[serve] stream '{name}': seeded "
+                            f"{seeded} WAL alerts into dedup window"
+                        )
                 batcher = self._batcher_for(name, "fp32")
                 timeout_ms = float(opts.timeout_ms)
 
@@ -598,12 +639,32 @@ class ServeService:
                         idle_timeout_s=float(
                             sc.get("idle_timeout_s", 900.0)
                         ),
+                        journal_every_s=float(
+                            sc.get("journal_every_s", 5.0)
+                        ),
                         model=name,
                     ),
                     assoc=assoc,
+                    journal=journal,
                 )
                 self._stream_muxes[name] = mux
             return mux
+
+    @staticmethod
+    def _synthetic_stream_result() -> Dict[str, Any]:
+        """Feed-shaped success for a faulted (dropped/held) packet: the
+        client sees a 200 with no picks, exactly what a swallowed packet
+        looks like from outside."""
+        return {
+            "n_samples": 0,
+            "windows": 0,
+            "duplicate": False,
+            "closed": False,
+            "degraded": False,
+            "dropped_windows": 0,
+            "picks": {"ppk": [], "spk": [], "det": []},
+            "alerts": [],
+        }
 
     def stream(
         self,
@@ -654,9 +715,15 @@ class ServeService:
         with self._lock:
             self._requests["stream"] += 1
             n_request = self._requests["stream"]
+        # Packet arrival: fire any scheduled stream fault (SIGKILL at
+        # packet k) before admission — a mid-mainshock crash must not be
+        # dodged by the shedder.
+        self._stream_faults.on_packet(n_request)
         with t.span("admission", tier=opts.priority) as sp:
             try:
-                self._shedders[entry.name].admit(opts.priority)
+                # end=true RELEASES a station slot — always admitted
+                # (serve/shed.py final-exemption contract).
+                self._shedders[entry.name].admit(opts.priority, final=end)
             except Overloaded as e:
                 sp.annotate(verdict="shed",
                             retry_after_s=round(e.retry_after_s, 3))
@@ -678,15 +745,60 @@ class ServeService:
             # Amortized housekeeping: sessions whose station went quiet
             # past idle_timeout_s are reaped on the request path itself.
             mux.reap_idle()
-        from seist_tpu.stream.mux import StationLimit
+        from seist_tpu.stream.mux import MuxClosed, StationLimit
 
+        # Packet fate (SEIST_FAULT_STREAM_{DROP,DUP,REORDER}_P): 'ok'
+        # unless the chaos lane scheduled faults for this replica. A
+        # dropped packet is swallowed server-side AFTER the client got
+        # its 200 — the failure mode a transport ack cannot see, which
+        # the session's gap-stitch must absorb. A reordered packet is
+        # held and delivered after the station's next one; the plane
+        # does not reassemble, so it arrives stale and degrades to
+        # gap+duplicate (the documented semantics, now exercised).
+        fate = "ok"
+        if not end:
+            fate = self._stream_faults.packet_fate(station["id"], seq)
+        held_key = (entry.name, station["id"])
         try:
             with t.span("stream_feed", station=station["id"],
-                        packet_samples=int(x.shape[0])):
-                result = mux.feed(station, x, seq=seq, end=end)
+                        packet_samples=int(x.shape[0]), fate=fate):
+                if fate == "drop":
+                    t.flag("fault_drop")
+                    result = self._synthetic_stream_result()
+                elif fate == "reorder":
+                    t.flag("fault_reorder")
+                    with self._stream_lock:
+                        prev_held = self._held_packets.pop(held_key, None)
+                        self._held_packets[held_key] = (station, x, seq)
+                    if prev_held is not None:
+                        # Two holds in a row: deliver the older one now
+                        # (still late) instead of losing it outright.
+                        mux.feed(prev_held[0], prev_held[1],
+                                 seq=prev_held[2], end=False)
+                    result = self._synthetic_stream_result()
+                else:
+                    with self._stream_lock:
+                        held = self._held_packets.pop(held_key, None)
+                    if held is not None and end:
+                        # Flush the held packet before the closing feed;
+                        # after end the session is gone.
+                        mux.feed(held[0], held[1], seq=held[2], end=False)
+                        held = None
+                    result = mux.feed(station, x, seq=seq, end=end)
+                    if held is not None:
+                        # Late delivery: stale seq -> idempotent drop.
+                        mux.feed(held[0], held[1], seq=held[2], end=False)
+                    if fate == "dup":
+                        t.flag("fault_dup")
+                        mux.feed(station, x, seq=seq, end=False)
         except StationLimit as e:
             # Same backpressure contract as a full queue: 429, back off.
             raise QueueFull(str(e)) from None
+        except MuxClosed as e:
+            # close_all() latched (SIGTERM drain): 503 so the router
+            # retries this packet on a surviving replica, which restores
+            # the station from its journal.
+            raise ShuttingDown(str(e)) from None
         fs = float(mux.config.session.sampling_rate)
         picks = result["picks"]
         return {
@@ -1294,6 +1406,17 @@ def get_serve_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     help="origin-time coherence tolerance")
     ap.add_argument("--assoc-grid-step-deg", type=float, default=0.25,
                     help="origin grid-search resolution")
+    ap.add_argument("--assoc-dedup-window-s", type=float, default=2.0,
+                    help="suppress a network alert whose origin sits "
+                    "within this many seconds (and dedup_dist_deg) of an "
+                    "already-emitted one — the exactly-once half of the "
+                    "alert WAL contract")
+    ap.add_argument("--stream-journal-dir", default=None,
+                    help="directory for per-station session journals + "
+                    "the alert WAL; share it across a fleet to enable "
+                    "failover re-homing (unset = no journaling)")
+    ap.add_argument("--stream-journal-every-s", type=float, default=5.0,
+                    help="min seconds between journal writes per station")
     return ap.parse_args(argv)
 
 
@@ -1435,6 +1558,9 @@ def main(argv: Optional[List[str]] = None) -> None:
             "assoc_velocity_kms": args.assoc_velocity_kms,
             "assoc_tolerance_s": args.assoc_tolerance_s,
             "assoc_grid_step_deg": args.assoc_grid_step_deg,
+            "assoc_dedup_window_s": args.assoc_dedup_window_s,
+            "journal_dir": args.stream_journal_dir,
+            "journal_every_s": args.stream_journal_every_s,
         },
     )
     server = start_http_server(service, args.host, args.port)

@@ -71,6 +71,7 @@ class _TierState:
     shedding: bool = False
     admitted: int = 0
     shed: int = 0
+    final_exempt: int = 0  # releasing requests admitted through a shed
 
 
 class AdmissionController:
@@ -106,13 +107,22 @@ class AdmissionController:
         )
 
     # ------------------------------------------------------------- admit
-    def admit(self, priority: str = DEFAULT_PRIORITY) -> None:
+    def admit(
+        self, priority: str = DEFAULT_PRIORITY, final: bool = False
+    ) -> None:
         """Admit or shed one request of tier ``priority``.
 
         Raises :class:`Overloaded` (503 + Retry-After) when the tier is
         shedding. The shed decision per tier is sticky (hysteresis): it
         flips on above ``threshold`` and off below ``threshold *
-        hysteresis``, so one noisy estimate doesn't flap admission."""
+        hysteresis``, so one noisy estimate doesn't flap admission.
+
+        ``final=True`` marks a request that RELEASES capacity (a
+        stream's ``end=true`` close packet: one tail flush, then the
+        station slot frees). Shedding those is counterproductive — the
+        retry storm holds sessions open through the very overload the
+        shedder is fighting — so finals update the tier's shed state but
+        are always admitted."""
         if priority not in PRIORITIES:
             # Protocol validation rejects these before we're called;
             # guard against programmatic callers all the same.
@@ -126,7 +136,9 @@ class AdmissionController:
                     state.shedding = False
             elif delay_ms > threshold:
                 state.shedding = True
-            if state.shedding:
+            if state.shedding and final:
+                state.final_exempt += 1
+            elif state.shedding:
                 state.shed += 1
                 retry_after_s = max(
                     self.config.min_retry_after_s, 2.0 * delay_ms / 1e3
@@ -159,6 +171,7 @@ class AdmissionController:
                         "shedding": s.shedding,
                         "admitted": s.admitted,
                         "shed": s.shed,
+                        "final_exempt": s.final_exempt,
                     }
                     for t, s in self._tiers.items()
                 },

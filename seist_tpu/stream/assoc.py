@@ -44,6 +44,17 @@ class AssocConfig:
     margin_deg: float = 0.5  # search bbox margin past the footprint
     tolerance_s: float = 2.0  # origin-time coherence tolerance
     max_recent_alerts: int = 256  # alert ring retained for GET /stream/alerts
+    # Exactly-once surface (docs/SERVING.md "Alert dedup"): a new
+    # hypothesis within dedup_window_s AND one id grid cell of a recent
+    # alert is the SAME event re-forming (failover replay, late phases
+    # after a WAL'd emit) and is suppressed. Deliberately smaller than
+    # any plausible inter-event time at one location — the digital
+    # twin's aftershock refractory is 3 s, so distinct events never
+    # fall inside the default window.
+    dedup_window_s: float = 2.0
+    dedup_dist_deg: float = 0.5  # spatial slack: subsets shift the origin
+    id_grid_deg: float = 0.25  # alert-id origin cell size
+    id_time_bucket_s: float = 5.0  # alert-id origin-time bucket
 
 
 @dataclass(frozen=True)
@@ -67,10 +78,19 @@ class Alert:
     picks: List[StationPick] = field(default_factory=list)
     t_alert: float = 0.0  # wall-clock emission time
     latency_ms: Dict[str, float] = field(default_factory=dict)
+    # Deterministic content-derived id, "ev-<cell>-<bucket>-<hash8>":
+    # origin grid cell + origin-time bucket + station-set hash. A
+    # failover replay that re-forms the event from the same picks mints
+    # the SAME id (a consumer deduping on alert_id counts it once); two
+    # replicas alerting on disjoint station subsets share the
+    # cell+bucket prefix, which is what consumers group on to count
+    # distinct events.
+    alert_id: str = ""
 
     def to_dict(self) -> Dict:
         return {
             "event_id": self.event_id,
+            "alert_id": self.alert_id,
             "origin": {
                 "lat": round(self.origin_lat, 4),
                 "lon": round(self.origin_lon, 4),
@@ -101,9 +121,30 @@ def _dist_km(lat1: float, lon1: float, lat2: float, lon2: float) -> float:
 
 class Associator:
     """Thread-safe pick buffer + grid origin scorer. ``add`` returns the
-    alert it triggered, if any."""
+    alert it triggered, if any.
 
-    def __init__(self, config: Optional[AssocConfig] = None, clock=None) -> None:
+    Exactly-once surface ("never double-counts, never misses"): a
+    hypothesis proximate to a recently emitted (or WAL-replayed) alert
+    — within ``dedup_window_s`` and ``dedup_dist_deg`` — whose station
+    set adds NOTHING over what those alerts already reported is a
+    re-emission (the failover-replay signature) and is suppressed: its
+    picks are consumed, ``on_dedup`` fires (the mux counts it into
+    ``seist_alert_dedup_total``), but no second alert reaches any
+    consumer. A proximate hypothesis that carries at least one NEW
+    station is a genuine follow-up (a later moveout wave cohering) and
+    is emitted — suppressing those would trade a duplicate for a missed
+    detection, the wrong side of the alert-tier bargain. With a ``wal``
+    attached, every alert is fsync'd to the WAL BEFORE ``add`` returns
+    it (durable-before-visible); :meth:`seed_from_wal` replays the log
+    after a restart so the dedup window survives the process."""
+
+    def __init__(
+        self,
+        config: Optional[AssocConfig] = None,
+        clock=None,
+        wal=None,
+        on_dedup=None,
+    ) -> None:
         import time
 
         self.config = config or AssocConfig()
@@ -113,6 +154,12 @@ class Associator:
         self._alerts: List[Alert] = []
         self._next_event_id = 1
         self.alerts_total = 0
+        self.alerts_deduped = 0
+        self.wal = wal  # journal.AlertWAL-shaped: .append(dict), .replay()
+        self.on_dedup = on_dedup  # called (no args) per suppressed alert
+        # (lat, lon, t0, alert_id, station_ids) of recent emissions,
+        # newest last; station_ids accumulate the dedup subset check.
+        self._recent_events: List[tuple] = []
 
     # ------------------------------------------------------------- feed
     def add(self, pick: StationPick) -> Optional[Alert]:
@@ -129,10 +176,19 @@ class Associator:
             lat, lon, t0, coherent = hypo
             if len({p.station_id for p in coherent}) < c.min_stations:
                 return None
-            alert = self._emit(lat, lon, t0, coherent)
+            # Consume the coherent picks either way: a suppressed
+            # duplicate must not leave its picks around to re-form the
+            # same hypothesis on the very next add().
             consumed = set(id(p) for p in coherent)
             self._picks = [p for p in self._picks if id(p) not in consumed]
-            return alert
+            sids = {p.station_id for p in coherent}
+            if self._is_duplicate(lat, lon, t0, sids):
+                self.alerts_deduped += 1
+                hook = self.on_dedup
+                if hook is not None:
+                    hook()
+                return None
+            return self._emit(lat, lon, t0, coherent)
 
     def recent_alerts(self, n: int = 50) -> List[Dict]:
         with self._lock:
@@ -142,8 +198,78 @@ class Associator:
         with self._lock:
             return {
                 "alerts": float(self.alerts_total),
+                "alerts_deduped": float(self.alerts_deduped),
                 "pending_picks": float(len(self._picks)),
             }
+
+    # ------------------------------------------------------ exactly-once
+    def alert_id_for(self, lat: float, lon: float, t0: float,
+                     station_ids) -> str:
+        """Deterministic alert id — see :class:`Alert`. Public so the
+        chaos lane and consumers can recompute/group ids."""
+        import hashlib
+
+        c = self.config
+        ci = int(round(lat / c.id_grid_deg))
+        cj = int(round(lon / c.id_grid_deg))
+        bt = int(math.floor(t0 / c.id_time_bucket_s))
+        sids = ",".join(sorted(set(str(s) for s in station_ids)))
+        h = hashlib.sha1(sids.encode()).hexdigest()[:8]
+        return f"ev-{ci}:{cj}-{bt}-{h}"
+
+    def _is_duplicate(self, lat: float, lon: float, t0: float,
+                      sids) -> bool:
+        """True iff the hypothesis is proximate to recent emissions AND
+        its stations are all already reported by them (union over every
+        proximate entry: an event whose picks arrived in two waves has
+        two entries, and a replay re-forming from their union must still
+        dedup)."""
+        c = self.config
+        seen: set = set()
+        proximate = False
+        for rlat, rlon, rt0, _rid, rsids in self._recent_events:
+            if (
+                abs(t0 - rt0) <= c.dedup_window_s
+                and abs(lat - rlat) <= c.dedup_dist_deg
+                and abs(lon - rlon) <= c.dedup_dist_deg
+            ):
+                proximate = True
+                seen |= rsids
+        return proximate and set(sids) <= seen
+
+    def _note_recent(self, lat: float, lon: float, t0: float,
+                     alert_id: str, sids) -> None:
+        self._recent_events.append((lat, lon, t0, alert_id,
+                                    frozenset(sids)))
+        if len(self._recent_events) > 4 * self.config.max_recent_alerts:
+            self._recent_events = self._recent_events[
+                -self.config.max_recent_alerts :
+            ]
+
+    def seed_from_wal(self) -> int:
+        """Replay the attached WAL into the dedup window (restart path).
+        Returns the number of records seeded. Does not touch
+        ``alerts_total`` — these alerts were already counted by the
+        process that emitted them."""
+        if self.wal is None:
+            return 0
+        n = 0
+        with self._lock:
+            for rec in self.wal.replay():
+                origin = rec.get("origin") or {}
+                try:
+                    self._note_recent(
+                        float(origin["lat"]),
+                        float(origin["lon"]),
+                        float(origin["t_s"]),
+                        str(rec.get("alert_id") or ""),
+                        {str(pk["station"])
+                         for pk in rec.get("picks") or []},
+                    )
+                except (KeyError, TypeError, ValueError):
+                    continue
+                n += 1
+        return n
 
     # ---------------------------------------------------------- scoring
     def _slack_s(self, step_deg: float) -> float:
@@ -261,10 +387,22 @@ class Associator:
             picks=sorted(coherent, key=lambda p: (p.t_s, p.station_id)),
             t_alert=now,
             latency_ms=latency,
+            alert_id=self.alert_id_for(
+                lat, lon, t0, (p.station_id for p in coherent)
+            ),
         )
         self._next_event_id += 1
         self.alerts_total += 1
         self._alerts.append(alert)
         if len(self._alerts) > self.config.max_recent_alerts:
             self._alerts = self._alerts[-self.config.max_recent_alerts :]
+        self._note_recent(lat, lon, t0, alert.alert_id,
+                          (p.station_id for p in coherent))
+        if self.wal is not None:
+            # Durable-before-visible: the WAL line lands (fsync) before
+            # any caller can observe the alert. A crash right here
+            # re-forms and re-suppresses on replay; a crash after is a
+            # delivered alert that replay dedups. Either way the
+            # consumer sees exactly one.
+            self.wal.append(alert.to_dict())
         return alert

@@ -54,12 +54,17 @@ record so far.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Mapping, Optional
 
 import numpy as np
 
-__all__ = ["DueWindow", "SessionConfig", "StreamSession"]
+__all__ = ["DueWindow", "SessionConfig", "StreamSession", "STATE_VERSION"]
+
+#: Version tag carried in every snapshot; bump on ANY layout change to the
+#: state dict so a restore from an older journal fails loud (fresh session
+#: + gap-stitch re-warm) instead of resurrecting subtly-wrong state.
+STATE_VERSION = 1
 
 
 @dataclass(frozen=True)
@@ -330,6 +335,24 @@ class StreamSession:
             )
         return self._advance()
 
+    def abandon(self, offset: int) -> Dict[str, list]:
+        """Drop a handed-out window whose forward failed (shed, queue
+        full, replica dying). The slot leaves ``_pending`` so the
+        finality frontier can keep advancing — without this, one dropped
+        window wedges the frontier forever and the station never emits
+        another pick. The un-stitched span becomes a coverage hole
+        (rendered as pure noise by :meth:`_curve`); newly final picks on
+        either side are returned exactly like :meth:`integrate`."""
+        try:
+            self._pending.remove(offset)
+        except ValueError:
+            raise ValueError(f"no window pending at offset {offset}") from None
+        # Zero-fill the accumulators across the hole: the frontier may
+        # now advance past territory no integrate() ever grew the curve
+        # for, and pickers must see explicit zeros, not a short slice.
+        self._ensure_curve(offset + self.config.window)
+        return self._advance()
+
     def finalize(self) -> Dict[str, list]:
         """After integrating :meth:`finish`'s windows: flush the pickers
         over the (now fully final) record tail."""
@@ -353,6 +376,99 @@ class StreamSession:
     def context_samples(self) -> int:
         """Raw samples currently retained (the ring buffer)."""
         return self._ring.shape[0]
+
+    # -------------------------------------------------- snapshot/restore
+    def snapshot(self) -> Dict[str, object]:
+        """Serializable session state: ``{"meta": <JSON-able dict>,
+        "arrays": <name -> ndarray>}``. Bounded by design: the ring and
+        retained curve are already trimmed to O(window), so a journal
+        entry costs the same regardless of stream length.
+
+        Only quiescent sessions snapshot — ``_pending`` must be empty
+        (the mux journals between feeds, under the entry lock, where
+        every handed-out window has been integrated or abandoned). A
+        mid-flight snapshot would need the un-integrated window replayed
+        on restore, which nothing can do after the process died."""
+        if self._pending:
+            raise RuntimeError(
+                f"snapshot with {len(self._pending)} in-flight windows"
+            )
+        c = self.config
+        meta: Dict[str, object] = {
+            "version": STATE_VERSION,
+            "config": asdict(c),
+            "n_samples": self.n_samples,
+            "n_windows": self.n_windows,
+            "next_offset": self._next_offset,
+            "base": self._base,
+            "curve_base": self._curve_base,
+            "final_upto": self._final_upto,
+            "finished": self._finished,
+            "finalized": self._finalized,
+            "total_len": self._total_len,
+            "ppk": {"comp": self._ppk._comp, "scanned": self._ppk._scanned},
+            "spk": {"comp": self._spk._comp, "scanned": self._spk._scanned},
+            "det": {
+                "on": self._det._on,
+                "off": self._det._off,
+                "scanned": self._det._scanned,
+            },
+        }
+        arrays: Dict[str, np.ndarray] = {"ring": self._ring.copy()}
+        if c.combine == "mean":
+            arrays["acc"] = self._acc.copy()
+            arrays["hits"] = self._hits.copy()
+        else:
+            arrays["evmax"] = self._evmax.copy()
+        return {"meta": meta, "arrays": arrays}
+
+    @classmethod
+    def restore(cls, state: Mapping[str, object]) -> "StreamSession":
+        """Rebuild a session from :meth:`snapshot` output. Parity-pinned:
+        restore at any packet boundary then feed the remaining packets
+        and the emitted pick stream is bit-identical to the session that
+        never died (tests/test_stream_session.py). Raises ``ValueError``
+        on version/shape mismatch — callers treat that as journal loss
+        and fall back to a fresh session (gap-stitch re-warm)."""
+        meta = state["meta"]
+        arrays = state["arrays"]
+        if meta.get("version") != STATE_VERSION:
+            raise ValueError(
+                f"session state version {meta.get('version')!r}, "
+                f"want {STATE_VERSION}"
+            )
+        cfg = SessionConfig(**dict(meta["config"]))
+        sess = cls(cfg)
+        sess.n_samples = int(meta["n_samples"])
+        sess.n_windows = int(meta["n_windows"])
+        sess._next_offset = int(meta["next_offset"])
+        sess._base = int(meta["base"])
+        sess._curve_base = int(meta["curve_base"])
+        sess._final_upto = int(meta["final_upto"])
+        sess._finished = bool(meta["finished"])
+        sess._finalized = bool(meta["finalized"])
+        tl = meta["total_len"]
+        sess._total_len = None if tl is None else int(tl)
+        ring = np.asarray(arrays["ring"], np.float32)
+        if ring.ndim != 2 or ring.shape[1] != cfg.in_channels:
+            raise ValueError(f"ring shape {ring.shape} != (*, {cfg.in_channels})")
+        sess._ring = ring.copy()
+        if cfg.combine == "mean":
+            sess._acc = np.asarray(arrays["acc"], np.float32).copy()
+            sess._hits = np.asarray(arrays["hits"], np.float32).copy()
+            if sess._acc.shape != (sess._hits.shape[0], 3):
+                raise ValueError("acc/hits shape mismatch")
+        else:
+            sess._evmax = np.asarray(arrays["evmax"], np.float32).copy()
+        for picker, key in ((sess._ppk, "ppk"), (sess._spk, "spk")):
+            pm = meta[key]
+            picker._comp = [(int(p), float(h)) for p, h in pm["comp"]]
+            picker._scanned = int(pm["scanned"])
+        dm = meta["det"]
+        sess._det._on = None if dm["on"] is None else int(dm["on"])
+        sess._det._off = int(dm["off"])
+        sess._det._scanned = int(dm["scanned"])
+        return sess
 
     # ---------------------------------------------------------- plumbing
     def _normalized(self, offset: int, length: int) -> np.ndarray:
@@ -408,6 +524,16 @@ class StreamSession:
         lo, hi = a - self._curve_base, b - self._curve_base
         if c.combine == "mean":
             cur = self._acc[lo:hi] / np.maximum(self._hits[lo:hi], 1.0)[:, None]
+            if c.channel0 == "non":
+                # Coverage holes (abandoned windows) have zero hits, so
+                # the raw quotient reads noise=0 -> strength 1-0 = 1.0:
+                # a phantom full-strength detection spanning the hole.
+                # Render holes as pure noise instead. Non-degraded
+                # sessions never have zero-hit final samples, so the
+                # offline-parity pin is untouched.
+                hole = self._hits[lo:hi] == 0.0
+                if hole.any():
+                    cur[hole, 0] = 1.0
         else:
             cur = self._evmax[lo:hi].copy()
             if c.channel0 == "non":

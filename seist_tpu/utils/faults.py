@@ -87,6 +87,42 @@ k" is deterministic under any client concurrency)::
                                       serve kill fires at most once
                                       across replica relaunches
 
+Streaming-plane knobs (consumed by ``serve/server.py``'s /stream path
+and ``stream/journal.py``; packet fates are deterministic per
+(station, seq) hash, so a dropped packet is dropped on every replay of
+the same schedule — chaos runs are reproducible)::
+
+    SEIST_FAULT_STREAM_DROP_P       probability a packet is silently
+                                    swallowed server-side (the client
+                                    sees success; the session sees a
+                                    sequence gap on the next packet)
+    SEIST_FAULT_STREAM_DUP_P        probability a packet is fed twice
+                                    (the second feed is a duplicate seq
+                                    — the mux must drop it idempotently)
+    SEIST_FAULT_STREAM_REORDER_P    probability a packet is held and
+                                    delivered after the station's NEXT
+                                    packet. The stream plane does not
+                                    reassemble: the late packet arrives
+                                    as a stale seq and is dropped, so
+                                    reorder degrades to gap+duplicate —
+                                    the documented semantics, now
+                                    exercised
+    SEIST_FAULT_STREAM_KILL_PACKET  SIGKILL the replica when its k-th
+                                    (1-based) /stream packet arrives —
+                                    the mid-mainshock crash the journal
+                                    + re-home + WAL machinery exists
+                                    for; scoped by
+                                    SEIST_FAULT_SERVE_REPLICA, stamped
+                                    once via SEIST_FAULT_STAMP
+    SEIST_FAULT_STREAM_JOURNAL_CORRUPT_P
+                                    probability (per station, one
+                                    verdict per station id) that every
+                                    journal write for that station is
+                                    truncated mid-blob — restore must
+                                    detect the torn file and fall back
+                                    to a fresh session (gap-stitch
+                                    re-warm), never resurrect garbage
+
 The injector is deliberately dependency-free above numpy/jax tree utils:
 it must be importable (and inert) in every entry point that might train.
 """
@@ -490,3 +526,162 @@ class ServeFaultInjector:
             and self.plan.bad_candidate_version >= 0
             and int(version) == self.plan.bad_candidate_version
         )
+
+
+# -------------------------------------------------------------- stream plane
+@dataclass(frozen=True)
+class StreamFaultPlan:
+    """Parsed streaming-plane fault schedule (inert by default). Packet
+    ordinals are 1-based per-process /stream counts; per-packet fates
+    hash (station_id, seq) so a schedule replays identically."""
+
+    drop_p: float = 0.0
+    dup_p: float = 0.0
+    reorder_p: float = 0.0
+    kill_packet: int = -1
+    journal_corrupt_p: float = 0.0
+    replica: int = -1  # only fire in this SEIST_SERVE_REPLICA; -1 = any
+    stamp_path: str = ""
+
+    @classmethod
+    def from_env(
+        cls, env: Optional[Mapping[str, str]] = None
+    ) -> "StreamFaultPlan":
+        env = os.environ if env is None else env
+        return cls(
+            drop_p=_env_float(env, "SEIST_FAULT_STREAM_DROP_P", 0.0),
+            dup_p=_env_float(env, "SEIST_FAULT_STREAM_DUP_P", 0.0),
+            reorder_p=_env_float(env, "SEIST_FAULT_STREAM_REORDER_P", 0.0),
+            kill_packet=_env_int(
+                env, "SEIST_FAULT_STREAM_KILL_PACKET", -1
+            ),
+            journal_corrupt_p=_env_float(
+                env, "SEIST_FAULT_STREAM_JOURNAL_CORRUPT_P", 0.0
+            ),
+            replica=_env_int(env, "SEIST_FAULT_SERVE_REPLICA", -1),
+            stamp_path=env.get("SEIST_FAULT_STAMP", ""),
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return (
+            self.drop_p > 0
+            or self.dup_p > 0
+            or self.reorder_p > 0
+            or self.kill_packet >= 0
+            or self.journal_corrupt_p > 0
+        )
+
+
+class StreamFaultInjector:
+    """Streaming-plane fault driver.
+
+    ``ServeService.stream`` consults :meth:`on_packet` (kill) and
+    :meth:`packet_fate` (drop / dup / reorder) per arriving packet;
+    ``stream/journal.py`` consults :meth:`corrupt_journal` per journal
+    write. Fates are deterministic: ``packet_fate`` hashes
+    (station_id, seq) and ``corrupt_journal`` hashes the station id, so
+    the same scenario schedule produces the same faults on every run —
+    the chaos lane's gates can be exact, not statistical. Replica
+    scoping rides SEIST_FAULT_SERVE_REPLICA exactly like the serve
+    plane."""
+
+    def __init__(
+        self,
+        plan: Optional[StreamFaultPlan] = None,
+        replica_index: Optional[int] = None,
+    ):
+        self.plan = plan or StreamFaultPlan()
+        if replica_index is None:
+            replica_index = _env_int(os.environ, "SEIST_SERVE_REPLICA", -1)
+        self.replica_index = replica_index
+        self._stamps = _Stamps(self.plan.stamp_path)
+
+    @classmethod
+    def from_env(
+        cls, env: Optional[Mapping[str, str]] = None
+    ) -> "StreamFaultInjector":
+        return cls(StreamFaultPlan.from_env(env))
+
+    @property
+    def enabled(self) -> bool:
+        """True when any fault is scheduled AND targets this replica."""
+        if not self.plan.enabled:
+            return False
+        return self.plan.replica < 0 or self.plan.replica == self.replica_index
+
+    @staticmethod
+    def _uniform(*key: int) -> float:
+        return float(
+            np.random.default_rng(
+                np.random.SeedSequence([0x57F4_17, *[int(k) for k in key]])
+            ).random()
+        )
+
+    @staticmethod
+    def _station_key(station_id: str) -> int:
+        import hashlib
+
+        digest = hashlib.sha1(str(station_id).encode()).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    # --------------------------------------------------------- packet hooks
+    def on_packet(self, n: int) -> None:
+        """Fire packet-arrival faults for the ``n``-th (1-based) /stream
+        packet. Kill is >= (not ==) so concurrent arrivals can't skip
+        past the trigger; the stamp makes it fire once across
+        relaunches."""
+        if not self.enabled:
+            return
+        p = self.plan
+        if p.kill_packet >= 0 and n >= p.kill_packet and self._stamps.armed(
+            "stream_kill"
+        ):
+            self._stamps.mark("stream_kill")
+            logger.warning(f"[faults] stream SIGKILL at packet {n}")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def packet_fate(self, station_id: str, seq: Optional[int]) -> str:
+        """-> 'ok' | 'drop' | 'dup' | 'reorder' for this packet.
+
+        One uniform draw per (station, seq) checked against the three
+        rates in fixed order, so fates are mutually exclusive and each
+        fires at ~its configured rate. Packets without a seq are never
+        faulted (there is no duplicate/gap semantics to exercise)."""
+        if not self.enabled or seq is None:
+            return "ok"
+        p = self.plan
+        if p.drop_p <= 0 and p.dup_p <= 0 and p.reorder_p <= 0:
+            return "ok"
+        u = self._uniform(self._station_key(station_id), int(seq))
+        if u < p.drop_p:
+            return "drop"
+        if u < p.drop_p + p.dup_p:
+            return "dup"
+        if u < p.drop_p + p.dup_p + p.reorder_p:
+            return "reorder"
+        return "ok"
+
+    # -------------------------------------------------------- journal hook
+    def corrupt_journal(self, station_id: str) -> bool:
+        """One verdict per station (hash of its id): EVERY journal write
+        for a corrupt-selected station is truncated, so its failover
+        restore reliably exercises the torn-file -> fresh-session
+        path."""
+        if not self.enabled or self.plan.journal_corrupt_p <= 0:
+            return False
+        u = self._uniform(self._station_key(station_id), 0x0C0_44)
+        return u < self.plan.journal_corrupt_p
+
+
+_STREAM_FAULTS: Optional[StreamFaultInjector] = None
+
+
+def stream_faults() -> StreamFaultInjector:
+    """Process-wide stream injector, parsed from env once. journal.py
+    consults this (it has no handle on the server's injector); the
+    server uses the same instance so the kill stamp is shared."""
+    global _STREAM_FAULTS
+    if _STREAM_FAULTS is None:
+        _STREAM_FAULTS = StreamFaultInjector.from_env()
+    return _STREAM_FAULTS

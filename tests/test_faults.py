@@ -384,3 +384,119 @@ class TestServeFaults:
             ServeFaultPlan(slow_ms=40.0, replica=2), replica_index=0
         ).forward_delay()
         assert slept == [0.04]  # mistargeted: no extra sleep
+
+
+class TestStreamFaults:
+    """StreamFaultPlan/Injector units (packet fates, journal verdicts,
+    kill arming; the real-fleet runs live in tests/test_stream_chaos.py)."""
+
+    def test_plan_from_env_defaults_inert(self):
+        from seist_tpu.utils.faults import StreamFaultInjector, StreamFaultPlan
+
+        plan = StreamFaultPlan.from_env(env={})
+        assert not plan.enabled
+        inj = StreamFaultInjector(plan)
+        assert not inj.enabled
+        inj.on_packet(10**9)  # nothing scheduled: must be a no-op
+        assert inj.packet_fate("ST01", 1) == "ok"
+        assert inj.corrupt_journal("ST01") is False
+
+    def test_plan_parses_all_knobs(self):
+        from seist_tpu.utils.faults import StreamFaultPlan
+
+        plan = StreamFaultPlan.from_env(env={
+            "SEIST_FAULT_STREAM_DROP_P": "0.1",
+            "SEIST_FAULT_STREAM_DUP_P": "0.2",
+            "SEIST_FAULT_STREAM_REORDER_P": "0.05",
+            "SEIST_FAULT_STREAM_KILL_PACKET": "40",
+            "SEIST_FAULT_STREAM_JOURNAL_CORRUPT_P": "0.3",
+            "SEIST_FAULT_SERVE_REPLICA": "2",
+            "SEIST_FAULT_STAMP": "/tmp/x",
+        })
+        assert plan.enabled
+        assert (plan.drop_p, plan.dup_p, plan.reorder_p) == (0.1, 0.2, 0.05)
+        assert plan.kill_packet == 40
+        assert plan.journal_corrupt_p == 0.3
+        assert plan.replica == 2 and plan.stamp_path == "/tmp/x"
+
+    def test_replica_targeting_gates_enabled(self):
+        from seist_tpu.utils.faults import StreamFaultInjector, StreamFaultPlan
+
+        plan = StreamFaultPlan(drop_p=0.5, replica=1)
+        assert not StreamFaultInjector(plan, replica_index=0).enabled
+        assert StreamFaultInjector(plan, replica_index=1).enabled
+        anywhere = StreamFaultPlan(drop_p=0.5, replica=-1)
+        assert StreamFaultInjector(anywhere, replica_index=-1).enabled
+
+    def test_packet_fate_deterministic_and_exclusive(self):
+        from seist_tpu.utils.faults import StreamFaultInjector, StreamFaultPlan
+
+        plan = StreamFaultPlan(drop_p=0.1, dup_p=0.1, reorder_p=0.1)
+        a = StreamFaultInjector(plan, replica_index=-1)
+        b = StreamFaultInjector(plan, replica_index=-1)
+        fates = {}
+        for seq in range(1, 400):
+            f = a.packet_fate("CI.ST01", seq)
+            assert f == b.packet_fate("CI.ST01", seq), "replay must match"
+            fates[f] = fates.get(f, 0) + 1
+        # All four fates fire at roughly their configured rates.
+        assert set(fates) == {"ok", "drop", "dup", "reorder"}
+        assert fates["ok"] > 200
+        # No-seq packets are never faulted (no dup/gap semantics).
+        assert a.packet_fate("CI.ST01", None) == "ok"
+
+    def test_packet_fate_varies_by_station(self):
+        from seist_tpu.utils.faults import StreamFaultInjector, StreamFaultPlan
+
+        inj = StreamFaultInjector(
+            StreamFaultPlan(drop_p=0.3), replica_index=-1
+        )
+        seqs = range(1, 60)
+        a = [inj.packet_fate("CI.AAA", s) for s in seqs]
+        b = [inj.packet_fate("CI.BBB", s) for s in seqs]
+        assert a != b, "fates hash (station, seq), not seq alone"
+
+    def test_corrupt_journal_one_verdict_per_station(self):
+        from seist_tpu.utils.faults import StreamFaultInjector, StreamFaultPlan
+
+        inj = StreamFaultInjector(
+            StreamFaultPlan(journal_corrupt_p=0.4), replica_index=-1
+        )
+        sids = [f"CI.S{i:03d}" for i in range(100)]
+        verdicts = {sid: inj.corrupt_journal(sid) for sid in sids}
+        # Stable across calls: every write for a chosen station tears.
+        assert all(inj.corrupt_journal(s) == v for s, v in verdicts.items())
+        hit = sum(verdicts.values())
+        assert 10 < hit < 70  # ~40% of stations selected
+
+    def test_kill_stamp_fires_once_across_restarts(
+        self, tmp_path, monkeypatch
+    ):
+        from seist_tpu.utils import faults as faults_mod
+        from seist_tpu.utils.faults import StreamFaultInjector, StreamFaultPlan
+
+        sent = []
+        monkeypatch.setattr(
+            faults_mod.os, "kill", lambda pid, sig: sent.append(sig)
+        )
+        stamp = str(tmp_path / "stamp")
+        plan = StreamFaultPlan(kill_packet=3, stamp_path=stamp)
+        inj = StreamFaultInjector(plan, replica_index=-1)
+        inj.on_packet(2)
+        assert not sent
+        inj.on_packet(5)  # >= threshold (concurrent arrivals can skip ==)
+        assert sent == [signal.SIGKILL]
+        # "Relaunched" process: the stamp disarms the kill permanently.
+        again = StreamFaultInjector(plan, replica_index=-1)
+        again.on_packet(10)
+        assert sent == [signal.SIGKILL]
+
+    def test_stream_faults_singleton_parses_env_once(self, monkeypatch):
+        from seist_tpu.utils import faults as faults_mod
+
+        monkeypatch.setattr(faults_mod, "_STREAM_FAULTS", None)
+        monkeypatch.setenv("SEIST_FAULT_STREAM_DROP_P", "0.25")
+        inj = faults_mod.stream_faults()
+        assert inj.plan.drop_p == 0.25
+        assert faults_mod.stream_faults() is inj
+        monkeypatch.setattr(faults_mod, "_STREAM_FAULTS", None)

@@ -523,3 +523,126 @@ def test_front_tier_imports_no_jax():
         capture_output=True, text=True, cwd=repo_root, timeout=120,
     )
     assert proc.returncode == 0, proc.stderr
+
+
+# ------------------------------------------------------- stream affinity
+def _stream_body(sid="CI.ST01"):
+    return json.dumps(
+        {"station": {"id": sid}, "seq": 1,
+         "data": [[0.0, 0.0, 0.0]], "options": {}}
+    ).encode()
+
+
+class TestStationAffinity:
+    def test_rank_deterministic_and_spread(self):
+        from seist_tpu.serve.router import StationAffinity
+
+        aff = StationAffinity()
+        urls = [f"127.0.0.1:{9000 + i}" for i in range(3)]
+        sids = [f"CI.S{i:03d}" for i in range(300)]
+        homes = {}
+        for sid in sids:
+            rank = aff.rank(sid, urls)
+            assert rank == aff.rank(sid, list(reversed(urls)))
+            homes[sid] = rank[0]
+        counts = [sum(1 for h in homes.values() if h == u) for u in urls]
+        # Rendezvous hashing spreads ~uniformly: no replica starves.
+        assert min(counts) > 50
+
+    def test_rank_minimal_disruption_on_removal(self):
+        from seist_tpu.serve.router import StationAffinity
+
+        aff = StationAffinity()
+        urls = [f"127.0.0.1:{9000 + i}" for i in range(3)]
+        sids = [f"CI.S{i:03d}" for i in range(300)]
+        before = {sid: aff.rank(sid, urls)[0] for sid in sids}
+        dead = urls[0]
+        survivors = urls[1:]
+        moved = 0
+        for sid in sids:
+            after = aff.rank(sid, survivors)[0]
+            if before[sid] == dead:
+                # Orphans land on their rank-2 replica...
+                assert after == aff.rank(sid, urls)[1]
+                moved += 1
+            else:
+                # ...and nobody else moves (the rendezvous property).
+                assert after == before[sid]
+        assert moved > 0
+
+    def test_note_counts_rehomes(self):
+        from seist_tpu.serve.router import StationAffinity
+
+        aff = StationAffinity()
+        assert aff.note("S1", "a") is None  # first home, not a re-home
+        assert aff.note("S1", "a") is None  # steady state
+        assert aff.note("S1", "b") == "a"   # failover
+        snap = aff.snapshot()
+        assert snap == {"stations": 1, "rehomes": 1, "by_replica": {"b": 1}}
+
+    @pytest.mark.parametrize("body,want", [
+        (_stream_body("CI.ST01"), "CI.ST01"),
+        (b'{"station":{"network":"CI","id":"A\\"B"},"data":[]}', 'A"B'),
+        (b'{"data":[[0,0,0]]}', None),
+        (b'{"station":{"network":"CI"},"data":[]}', None),
+        (b'not json at all', None),
+    ])
+    def test_station_id_extraction(self, body, want):
+        assert Router._station_id(body) == want
+
+
+class TestStreamForward:
+    def test_same_station_pins_to_one_replica(self, replicas):
+        router = _router(replicas)
+        for _ in range(6):
+            status, _, _ = router.forward("/stream", _stream_body())
+            assert status == 200
+        hits = sorted(r.hits for r in replicas)
+        assert hits == [0, 6], "stream packets must never round-robin"
+        assert router.status()["stream"]["stations"] == 1
+        assert router.status()["stream"]["rehomes"] == 0
+
+    def test_failover_rehomes_to_survivor(self, replicas):
+        router = _router(replicas)
+        router.forward("/stream", _stream_body())
+        home = next(r for r in replicas if r.hits == 1)
+        other = next(r for r in replicas if r is not home)
+        home.behavior = "error:500:boom"
+        status, _, payload = router.forward("/stream", _stream_body())
+        assert status == 200
+        assert json.loads(payload)["replica"] == other.url
+        stream = router.status()["stream"]
+        assert stream["rehomes"] == 1
+        assert stream["by_replica"] == {other.url: 1}
+
+    def test_shutting_down_503_retried_on_survivor(self, replicas):
+        router = _router(replicas)
+        router.forward("/stream", _stream_body())
+        home = next(r for r in replicas if r.hits == 1)
+        other = next(r for r in replicas if r is not home)
+        # The failover handoff: a draining/MuxClosed replica answers 503
+        # shutting_down, which IS retryable -> survivor adopts.
+        home.behavior = "error:503:shutting_down"
+        status, _, payload = router.forward("/stream", _stream_body())
+        assert status == 200
+        assert json.loads(payload)["replica"] == other.url
+        assert router.status()["stream"]["rehomes"] == 1
+
+    def test_shed_503_not_retried_for_stream(self, replicas):
+        router = _router(replicas)
+        router.forward("/stream", _stream_body())
+        home = next(r for r in replicas if r.hits == 1)
+        other = next(r for r in replicas if r is not home)
+        home.behavior = "error:503:shed"
+        status, _, payload = router.forward("/stream", _stream_body())
+        assert status == 503
+        assert json.loads(payload)["error"] == "shed"
+        assert other.hits == 0, "shed is a policy verdict, not a failure"
+
+    def test_stream_without_station_falls_back_to_round_robin(self, replicas):
+        router = _router(replicas)
+        body = json.dumps({"data": [[0.0, 0.0, 0.0]]}).encode()
+        for _ in range(4):
+            status, _, _ = router.forward("/stream", body)
+            assert status == 200
+        assert sorted(r.hits for r in replicas) == [2, 2]

@@ -290,3 +290,268 @@ class TestStreamBench:
             "--slo-p99-ms", "0.001",
         ])
         assert rc == bench_serve.SLO_EXIT_CODE
+
+
+# ----------------------------------------------------- durability plane
+def _envpick_service(stream_config=None):
+    """A fresh ServeService over the deterministic envpick entry (the
+    fake_service recipe, but per-test so journal_dir/stream_config can
+    vary). Caller owns shutdown()."""
+    from types import SimpleNamespace
+
+    from seist_tpu.serve import BatcherConfig as BC
+    from seist_tpu.serve import ServeService
+
+    def run(x, variant="fp32"):
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        a = jnp.abs(x[..., 0])
+        p = a / (a.max(axis=1, keepdims=True) + 1e-9)
+        s = jnp.clip(jnp.abs(x[..., 1]) / 3.0, 0.0, 1.0)
+        return jnp.stack([1.0 - p, p, s], axis=-1)
+
+    entry = SimpleNamespace(
+        name="envpick", window=WINDOW, in_channels=3, channel0="non",
+        is_picker=True, is_group=False, version=1, variants=("fp32",),
+        run=run,
+    )
+
+    class Pool:
+        warmup_report = []
+
+        def names(self):
+            return ["envpick"]
+
+        def get(self, name=None):
+            return entry
+
+        def warmup(self, buckets):
+            pass
+
+    return ServeService(
+        Pool(), BC(max_batch=4, max_delay_ms=5.0, max_queue=64),
+        stream_config=stream_config,
+    )
+
+
+def _feed(svc, station, rec, lo, hi, seq0, packet=97, end_at=None):
+    """Stream rec[lo:hi] in packets; -> (picks, last_response, next_seq)."""
+    picks = []
+    pos, seq = lo, seq0
+    r = None
+    while pos < hi:
+        seq += 1
+        r = svc.stream({
+            "model": "envpick", "station": station,
+            "data": rec[pos : pos + packet].tolist(),
+            "seq": seq, "options": OPTS,
+        })
+        picks += [("p", p["sample"]) for p in r["ppk"]]
+        picks += [("s", p["sample"]) for p in r["spk"]]
+        pos += packet
+    if end_at is not None and pos >= end_at:
+        seq += 1
+        r = svc.stream({"model": "envpick", "station": station,
+                        "end": True, "seq": seq, "options": OPTS})
+        picks += [("p", p["sample"]) for p in r["ppk"]]
+        picks += [("s", p["sample"]) for p in r["spk"]]
+    return picks, r, seq
+
+
+class TestStreamDurability:
+    """The failover contract end-to-end through ServeService: journal
+    restore mid-record, WAL-seeded dedup across restart, fault knobs."""
+
+    def test_replica_restart_resumes_from_journal(self, tmp_path):
+        """Kill a journaled service mid-record; a successor over the
+        same journal dir continues the pick stream exactly where the
+        reference (uninterrupted) session would be."""
+        rec = _record(700, seed=11)
+        st = {"id": "FO1"}
+
+        ref = _envpick_service()
+        try:
+            ref_picks, _, _ = _feed(ref, st, rec, 0, 700, 0, end_at=700)
+        finally:
+            ref.shutdown()
+
+        jd = str(tmp_path / "j")
+        a = _envpick_service({"journal_dir": jd, "journal_every_s": 0.0})
+        try:
+            got, _, seq = _feed(a, st, rec, 0, 97 * 3, 0)
+        finally:
+            a.shutdown(drain=True)  # journals final state (the handoff)
+        b = _envpick_service({"journal_dir": jd, "journal_every_s": 0.0})
+        try:
+            more, last, _ = _feed(b, st, rec, 97 * 3, 700, seq,
+                                  end_at=700)
+            got += more
+            assert last["n_samples"] == 700, "session resumed, not reset"
+            assert b.metrics()["stream"]["envpick"]["restores"] == 1.0
+        finally:
+            b.shutdown()
+        assert got == ref_picks
+
+    def test_alert_wal_seeds_dedup_across_restart(self, tmp_path):
+        """An alert emitted before a crash must not re-alert when the
+        successor re-forms the same hypothesis (exactly-once for the
+        consumer, via WAL replay into the dedup window)."""
+        import glob
+
+        geometry = [
+            {"id": "WA1", "network": "CI", "lat": 35.00, "lon": -117.00},
+            {"id": "WA2", "network": "CI", "lat": 35.05, "lon": -117.05},
+            {"id": "WA3", "network": "CI", "lat": 35.02, "lon": -116.95},
+        ]
+        rec = _record(600, seed=12)
+        jd = str(tmp_path / "j")
+        sc = {"journal_dir": jd, "journal_every_s": 0.0,
+              "assoc_min_stations": 3, "assoc_window_s": 60.0,
+              "assoc_tolerance_s": 3.0}
+
+        def run_once(svc):
+            alerts = []
+            for st in geometry:
+                _, responses = _stream_record(svc, st, rec, packet=200,
+                                              model="envpick")
+                for r in responses:
+                    alerts.extend(r["alerts"])
+            return alerts
+
+        a = _envpick_service(sc)
+        try:
+            first = run_once(a)
+            assert first, "scenario must alert at least once"
+            assert all(al["alert_id"] for al in first)
+            wals = glob.glob(f"{jd}/envpick/alerts*.wal")
+            assert wals, "every emitted alert is WAL'd before visibility"
+            n_walled = sum(1 for _ in open(wals[0]))
+            assert n_walled == len(first)
+        finally:
+            a.shutdown(drain=True)
+
+        b = _envpick_service(sc)
+        try:
+            second = run_once(b)  # identical replay = re-formed hypothesis
+            assert second == [], "WAL-seeded dedup must suppress replays"
+            s = b.metrics()["stream"]["envpick"]
+            assert s["alerts_deduped"] >= len(first)
+        finally:
+            b.shutdown()
+
+    def test_mux_closed_maps_to_shutting_down(self):
+        from seist_tpu.serve.protocol import ShuttingDown
+
+        svc = _envpick_service()
+        try:
+            st = {"id": "MC1"}
+            svc.stream({"model": "envpick", "station": st,
+                        "data": _record(97, seed=13).tolist(),
+                        "seq": 1, "options": OPTS})
+            svc._stream_muxes["envpick"].close_all()
+            # 503 shutting_down: the router's cue to re-home this
+            # station onto a survivor (NOT 500 — that would open the
+            # breaker on a deliberate drain).
+            with pytest.raises(ShuttingDown):
+                svc.stream({"model": "envpick", "station": st,
+                            "data": _record(97, seed=13).tolist(),
+                            "seq": 2, "options": OPTS})
+        finally:
+            svc.shutdown()
+
+
+class TestStreamFaultKnobs:
+    """SEIST_FAULT_STREAM_* through the serving stack (unit-level fate
+    logic lives in tests/test_faults.py)."""
+
+    @staticmethod
+    def _faulted_service(monkeypatch, **env):
+        from seist_tpu.utils import faults as faults_mod
+
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        monkeypatch.setattr(faults_mod, "_STREAM_FAULTS", None)
+        svc = _envpick_service()
+        return svc
+
+    def teardown_method(self, method):
+        # The singleton was re-parsed under fault env; reset so later
+        # tests (and modules) see the inert default again.
+        from seist_tpu.utils import faults as faults_mod
+
+        faults_mod._STREAM_FAULTS = None
+
+    def test_drop_swallows_server_side_after_200(self, monkeypatch):
+        svc = self._faulted_service(
+            monkeypatch, SEIST_FAULT_STREAM_DROP_P="1.0"
+        )
+        try:
+            r = svc.stream({"model": "envpick", "station": {"id": "DR1"},
+                            "data": _record(97, seed=14).tolist(),
+                            "seq": 1, "options": OPTS})
+            # The client sees success; the session saw nothing.
+            assert r["n_samples"] == 0 and r["windows"] == 0
+            assert svc.metrics()["stream"]["envpick"]["packets"] == 0.0
+        finally:
+            svc.shutdown()
+
+    def test_dup_feeds_twice_second_is_idempotent(self, monkeypatch):
+        svc = self._faulted_service(
+            monkeypatch, SEIST_FAULT_STREAM_DUP_P="1.0"
+        )
+        try:
+            r = svc.stream({"model": "envpick", "station": {"id": "DU1"},
+                            "data": _record(97, seed=15).tolist(),
+                            "seq": 1, "options": OPTS})
+            assert r["duplicate"] is False  # first copy is the real one
+            s = svc.metrics()["stream"]["envpick"]
+            assert s["packets"] == 2.0 and s["duplicates"] == 1.0
+        finally:
+            svc.shutdown()
+
+    def test_reorder_holds_then_delivers_stream_completes(self, monkeypatch):
+        svc = self._faulted_service(
+            monkeypatch, SEIST_FAULT_STREAM_REORDER_P="1.0"
+        )
+        try:
+            rec = _record(300, seed=16)
+            st = {"id": "RE1"}
+            for i, lo in enumerate(range(0, 300, 100)):
+                svc.stream({"model": "envpick", "station": st,
+                            "data": rec[lo : lo + 100].tolist(),
+                            "seq": i + 1, "options": OPTS})
+            r = svc.stream({"model": "envpick", "station": st,
+                            "end": True, "seq": 4, "options": OPTS})
+            # Every packet was held + delivered one late (the last via
+            # the pre-end flush): nothing is lost, order degrades to the
+            # session's duplicate/gap stitching.
+            assert r["closed"] is True
+            assert r["n_samples"] == 300
+        finally:
+            svc.shutdown()
+
+
+class TestShedFinalExemption:
+    def test_end_packet_admitted_while_shedding(self):
+        from seist_tpu.serve.protocol import Overloaded
+        from seist_tpu.serve.shed import AdmissionController, ShedConfig
+
+        # Streams ride the alert tier, which defaults to never-shed; a
+        # finite threshold makes the exemption observable.
+        ctl = AdmissionController(
+            lambda: 10_000.0,
+            ShedConfig(alert_delay_ms=500.0),
+            model="envpick",
+        )
+        try:
+            with pytest.raises(Overloaded):
+                ctl.admit("alert")
+            # end=true RELEASES capacity: always admitted, counted.
+            ctl.admit("alert", final=True)
+            tier = ctl.stats()["tiers"]["alert"]
+            assert tier["shedding"] is True
+            assert tier["final_exempt"] == 1
+            assert tier["admitted"] == 1
+        finally:
+            ctl.close()

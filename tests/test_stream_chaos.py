@@ -1,0 +1,464 @@
+"""Stream chaos lane (`make stream-chaos`): the digital twin's mainshock
+scenario replayed against a REAL 3-replica fleet (tools/twin_replica.py
+behind tools/supervise_fleet.py) with a SIGKILL injected on the
+station-heavy replica mid-mainshock — the ISSUE 17 acceptance run.
+
+The twin EXPORTS its arrival schedule to a file and this lane drives the
+fleet from that file, so the in-process twin gates and the chaos run
+argue about the same deterministic replay. The gates:
+
+* ZERO missed mainshock alerts: after journal restore on the survivors,
+  the union of stream-response alerts and the fleet's alert WALs
+  contains the mainshock (consumer model: dedup on ``alert_id``, group
+  distinct events on the cell+bucket id prefix).
+* BOUNDED duplicates: failover replay may re-emit, but no single
+  ``alert_id`` is emitted more than a handful of times — the consumer
+  double-counts nothing.
+* The kill is VISIBLE end to end: the fault stamp exists, the router's
+  affinity plane counted re-homes, the supervisor logged the crashed
+  replica's stream homes being re-homed, and the replica relaunched.
+* The client survives: reconnect-with-resume (retry the same seq)
+  turns every severed packet into a success or a counted drop — no
+  un-retried hard failures.
+
+Each test prints one ``[stream-chaos] VERDICT {json}`` line so the lane
+is greppable from CI logs.
+"""
+
+import glob
+import http.client
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+SUPERVISE_FLEET = os.path.join(REPO, "tools", "supervise_fleet.py")
+TWIN_REPLICA = os.path.join(REPO, "tools", "twin_replica.py")
+WINDOW = 256
+#: twinpick's bucket programs are tiny, but three replicas share one CPU
+WARM_TIMEOUT_S = 240.0
+
+SCENARIO_ARGS = [
+    "--stations", "36", "--duration-s", "30", "--window", str(WINDOW),
+    "--fs", "50", "--seed", "7", "--min-stations", "4", "--workers", "4",
+]
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _drain_pipe(pipe, buf):
+    for line in pipe:
+        buf.append(line)
+
+
+def _start_fleet(base_port, replica_args, env_extra=None, replicas=3,
+                 fleet_args=()):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [
+            sys.executable, SUPERVISE_FLEET,
+            "--replicas", str(replicas),
+            "--base-port", str(base_port),
+            "--router-port", "0",
+            "--probe-interval-s", "0.3",
+            "--backoff", "0.5",
+            "--drain-timeout-s", "20",
+            *fleet_args,
+            "--",
+            sys.executable, TWIN_REPLICA, *replica_args,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        text=True,
+    )
+    # Drain both pipes for the fleet's whole lifetime (the
+    # test_serve_chaos.py lesson: an undrained inherited pipe at the
+    # 64 KB kernel buffer wedges every fleet process on its next write).
+    proc.fleet_err = []
+    err_thread = threading.Thread(
+        target=_drain_pipe, args=(proc.stderr, proc.fleet_err), daemon=True
+    )
+    err_thread.start()
+    proc.fleet_err_thread = err_thread
+    router = None
+    for _ in range(50):
+        line = proc.stdout.readline()
+        if not line:
+            break
+        m = re.search(r"ROUTER=http://([\d.]+):(\d+)", line)
+        if m:
+            router = (m.group(1), int(m.group(2)))
+            break
+    if router is None:
+        proc.kill()
+        raise AssertionError("no ROUTER line from supervise_fleet")
+    proc.fleet_out = []
+    threading.Thread(
+        target=_drain_pipe, args=(proc.stdout, proc.fleet_out), daemon=True
+    ).start()
+    return proc, router[0], router[1]
+
+
+def _get(host, port, path, timeout=5.0):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:
+            return resp.status, raw.decode()
+    finally:
+        conn.close()
+
+
+def _wait_probed_ready(host, port, n, timeout_s=WARM_TIMEOUT_S):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            _, payload = _get(host, port, "/router/replicas")
+            states = [
+                r["probe_state"] for r in payload.get("replicas", [])
+            ]
+            if states.count("ok") >= n:
+                return
+        except OSError:
+            pass
+        time.sleep(0.25)
+    raise AssertionError(
+        f"fleet never reached {n} probed-ready replicas in {timeout_s}s"
+    )
+
+
+def _stop_fleet(proc, timeout=60):
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise
+    proc.fleet_err_thread.join(timeout=10)
+    return rc, "".join(proc.fleet_err)
+
+
+# ------------------------------------------------------ schedule driver
+def _build_and_export(tmp_path):
+    """Scenario + schedule via the twin, exported to (and re-loaded
+    from) the schedule file — the file is the contract both consumers
+    drive from."""
+    import twin
+
+    args = twin.get_args(SCENARIO_ARGS)
+    stations, events, waves, expected = twin.build_scenario(args)
+    rounds = twin.make_schedule(args, stations)
+    sched = str(tmp_path / "schedule.json")
+    twin.export_schedule(sched, args, stations, events, rounds)
+    with open(sched) as f:
+        doc = json.load(f)
+    assert doc["rounds"] == rounds  # the export IS the replay
+    return args, doc, waves, expected
+
+
+class _StreamClient:
+    """Reconnect-with-resume /stream driver over the exported schedule:
+    worker threads own stations ``w::W`` (per-station order is the
+    protocol invariant), a failed send retries the SAME seq — a
+    success-after-retry is a 'resume', exhausted retries are a counted
+    drop, never a silent one."""
+
+    MAX_RETRIES = 4
+
+    def __init__(self, host, port, doc, waves, workers=4,
+                 round_pause_s=0.25):
+        self.host, self.port = host, port
+        self.doc, self.waves = doc, waves
+        self.workers = workers
+        self.round_pause_s = round_pause_s
+        self.lock = threading.Lock()
+        self.alerts = []
+        self.ok = 0
+        self.resumed = 0
+        self.dropped = 0
+        self.resume_ms = []
+        self.options = {
+            "ppk_threshold": 0.5, "spk_threshold": 0.95,
+            "det_threshold": 0.95,
+            "sampling_rate": doc["scenario"]["fs"],
+        }
+
+    def _post(self, body):
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        try:
+            conn.request("POST", "/stream", json.dumps(body).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except OSError:
+            return 0, b""
+        finally:
+            conn.close()
+
+    def _send(self, st, pkt):
+        body = {
+            "model": "twinpick",
+            "station": {k: st[k] for k in ("id", "network", "lat", "lon")},
+            "seq": pkt["seq"],
+            "options": self.options,
+        }
+        if pkt.get("end"):
+            body["end"] = True
+        else:
+            body["data"] = self.waves[st["id"]][
+                pkt["lo"]:pkt["hi"]].tolist()
+        t0 = time.monotonic()
+        for attempt in range(1 + self.MAX_RETRIES):
+            status, raw = self._post(body)
+            if status == 200:
+                with self.lock:
+                    self.ok += 1
+                    if attempt:
+                        self.resumed += 1
+                        self.resume_ms.append(
+                            (time.monotonic() - t0) * 1000.0
+                        )
+                    try:
+                        self.alerts.extend(
+                            json.loads(raw).get("alerts") or []
+                        )
+                    except ValueError:
+                        pass
+                return
+            if not (status == 0 or status >= 500):
+                break  # 4xx: not retryable, the packet is gone
+            time.sleep(0.3 * (attempt + 1))
+        with self.lock:
+            self.dropped += 1
+
+    def drive(self):
+        by_id = {st["id"]: st for st in self.doc["stations"]}
+
+        def worker(w):
+            try:
+                mine = {
+                    st["id"]
+                    for st in self.doc["stations"][w :: self.workers]
+                }
+                for rnd in self.doc["rounds"]:
+                    for pkt in rnd:
+                        if pkt["station"] in mine:
+                            self._send(by_id[pkt["station"]], pkt)
+                    # Pace the replay: journals get a cadence tick and
+                    # the kill lands mid-stream, not post-hoc.
+                    time.sleep(self.round_pause_s)
+            except BaseException as e:  # noqa: BLE001
+                with self.lock:
+                    self.dropped += 10**6  # a dead worker fails the gate
+                sys.stderr.write(f"[chaos] worker {w} died: {e!r}\n")
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(self.workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert not any(t.is_alive() for t in threads), "driver wedged"
+
+
+def _wal_alerts(journal_dir):
+    out = []
+    for path in glob.glob(os.path.join(journal_dir, "twinpick",
+                                       "alerts*.wal")):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    return out
+
+
+def _verdict(name, gates, detail):
+    ok = all(gates.values())
+    print(f"[stream-chaos] VERDICT "
+          f"{json.dumps({'test': name, 'ok': ok, 'gates': gates, 'detail': detail})}",
+          flush=True)
+    assert ok, (gates, detail)
+
+
+def test_sigkill_station_heavy_replica_exactly_once(tmp_path):
+    """Acceptance: SIGKILL the replica homing the MOST stations while the
+    mainshock wave is arriving. Survivors restore its stations from the
+    shared journals, the router re-homes them, and the consumer-side
+    alert ledger shows the mainshock exactly once."""
+    from seist_tpu.serve.router import StationAffinity
+
+    args, doc, waves, expected = _build_and_export(tmp_path)
+    stations, events = doc["stations"], doc["events"]
+
+    # Pre-compute rendezvous placement (deterministic in the replica
+    # urls) to aim the kill at the station-heavy replica, and to time it
+    # against the mainshock round.
+    base_port = _free_port()
+    urls = [f"127.0.0.1:{base_port + i}" for i in range(3)]
+    aff = StationAffinity()
+    by_url = {u: 0 for u in urls}
+    for st in stations:
+        by_url[aff.rank(st["id"], urls)[0]] += 1
+    target_url = max(by_url, key=lambda u: by_url[u])
+    target = urls.index(target_url)
+    packet = WINDOW // 2
+    fs = doc["scenario"]["fs"]
+    main_round = int(events[0]["t"] * fs) // packet
+    # The target's per-round packet count ~= its homed stations; fire
+    # one round into the mainshock wave.
+    kill_packet = by_url[target_url] * (main_round + 1)
+
+    jd = str(tmp_path / "journals")
+    stamp = str(tmp_path / "kill.stamp")
+    proc, host, port = _start_fleet(
+        base_port,
+        replica_args=(
+            "--window", str(WINDOW), "--stations", "72",
+            "--min-stations", "4", "--journal-dir", jd,
+            "--journal-every-s", "0.2",
+        ),
+        env_extra={
+            "SEIST_FAULT_STREAM_KILL_PACKET": str(kill_packet),
+            "SEIST_FAULT_SERVE_REPLICA": str(target),
+            "SEIST_FAULT_STAMP": stamp,
+        },
+        fleet_args=("--router-retries", "3", "--request-timeout-s", "30"),
+    )
+    try:
+        _wait_probed_ready(host, port, 3)
+        client = _StreamClient(host, port, doc, waves)
+        client.drive()
+
+        _, reg = _get(host, port, "/router/replicas")
+        stream = reg.get("stream") or {}
+        # The relaunched target is back in rotation before teardown.
+        _wait_probed_ready(host, port, 3, timeout_s=120.0)
+    finally:
+        rc, err = _stop_fleet(proc, timeout=120)
+
+    wal = _wal_alerts(jd)
+    observed = client.alerts + wal
+    t_main = events[0]["t"]
+    main_obs = [
+        a for a in observed
+        if abs(a["origin"]["t_s"] - t_main) <= 3.0
+    ]
+    main_ids = {a["alert_id"] for a in main_obs}
+    # Consumer model: dedup on alert_id; distinct events group on the
+    # cell+bucket prefix.
+    emissions_per_id = {}
+    for a in client.alerts:
+        emissions_per_id[a["alert_id"]] = (
+            emissions_per_id.get(a["alert_id"], 0) + 1
+        )
+    worst_dup = max(emissions_per_id.values(), default=0)
+
+    gates = {
+        "kill_fired": os.path.exists(stamp),
+        "mainshock_alert_observed": len(main_ids) >= 1,
+        "duplicates_bounded": worst_dup <= 3,
+        "stations_rehomed": stream.get("rehomes", 0) > 0,
+        "rehome_logged": "was stream home to" in err,
+        "replica_relaunched": bool(
+            re.search(rf"replica {target} crashed rc=-9; relaunch", err)
+        ),
+        "client_no_unrescued_failures": client.dropped == 0,
+        "fleet_clean_exit": rc == 0,
+    }
+    detail = {
+        "target_replica": target,
+        "stations_on_target": by_url[target_url],
+        "kill_packet": kill_packet,
+        "alerts_seen": len(client.alerts),
+        "wal_records": len(wal),
+        "mainshock_ids": sorted(main_ids),
+        "rehomes": stream.get("rehomes", 0),
+        "resumed_packets": client.resumed,
+        "resume_ms_max": round(max(client.resume_ms, default=0.0), 1),
+        "worst_emissions_per_id": worst_dup,
+    }
+    _verdict("sigkill_station_heavy", gates, detail)
+    assert gates["fleet_clean_exit"], err
+
+
+def test_packet_faults_degrade_without_losing_mainshock(tmp_path):
+    """SEIST_FAULT_STREAM_{DROP,DUP,REORDER}_P at a few percent on every
+    replica: the plane degrades exactly as documented (gap-stitch
+    absorbs drops, idempotent seqs absorb dups, late reordered packets
+    fold into both) and the mainshock alert still lands."""
+    args, doc, waves, expected = _build_and_export(tmp_path)
+    jd = str(tmp_path / "journals")
+    proc, host, port = _start_fleet(
+        _free_port(),
+        replica_args=(
+            "--window", str(WINDOW), "--stations", "72",
+            "--min-stations", "4", "--journal-dir", jd,
+            "--journal-every-s", "0.2",
+        ),
+        env_extra={
+            "SEIST_FAULT_STREAM_DROP_P": "0.03",
+            "SEIST_FAULT_STREAM_DUP_P": "0.03",
+            "SEIST_FAULT_STREAM_REORDER_P": "0.03",
+        },
+        fleet_args=("--router-retries", "3", "--request-timeout-s", "30"),
+    )
+    try:
+        _wait_probed_ready(host, port, 3)
+        client = _StreamClient(host, port, doc, waves,
+                               round_pause_s=0.1)
+        client.drive()
+        _, reg = _get(host, port, "/router/replicas")
+    finally:
+        rc, err = _stop_fleet(proc, timeout=120)
+
+    observed = client.alerts + _wal_alerts(jd)
+    t_main = doc["events"][0]["t"]
+    main_ids = {
+        a["alert_id"] for a in observed
+        if abs(a["origin"]["t_s"] - t_main) <= 3.0
+    }
+    gates = {
+        "mainshock_alert_observed": len(main_ids) >= 1,
+        "client_no_unrescued_failures": client.dropped == 0,
+        "fleet_clean_exit": rc == 0,
+    }
+    detail = {
+        "alerts_seen": len(client.alerts),
+        "mainshock_ids": sorted(main_ids),
+        "ok_packets": client.ok,
+    }
+    _verdict("packet_faults", gates, detail)
+    assert gates["fleet_clean_exit"], err

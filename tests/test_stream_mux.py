@@ -85,7 +85,11 @@ class TestMux:
         with pytest.raises(QueueFull):
             mux.feed(st, _spiky(32))  # second window refused
         s = mux.stats()
-        assert s["windows_dropped"] == 1.0
+        # The refused window AND the rest of that feed's due batch are
+        # abandoned (zero-fill): a due window that never runs must not
+        # wedge the finality frontier, and a retried packet is a
+        # duplicate seq so those windows would never re-run.
+        assert s["windows_dropped"] == 2.0
         assert s["degraded_sessions"] == 1.0
         # The stream survives: later packets keep working on the holey curve.
         out = mux.feed(st, _spiky(32))
@@ -242,3 +246,147 @@ def test_thousand_station_mux_zero_post_warmup_compiles():
         f"post-warmup compiles: {budget.signatures()}"
     )
     batcher.shutdown()
+
+
+class TestMuxDurability:
+    """Journal plane: periodic snapshots, failover restore, the
+    close_all vs in-flight feed() contract (MuxClosed, never a freed
+    session), and journal hygiene on clean close."""
+
+    @staticmethod
+    def _mux(tmp_path, clock=None, journal_every_s=0.0):
+        from seist_tpu.stream.journal import StationJournal
+
+        journal = StationJournal(str(tmp_path), model="m")
+        kw = {"clock": clock} if clock is not None else {}
+        mux = StationMux(
+            _direct_submit,
+            MuxConfig(session=SESS, journal_every_s=journal_every_s,
+                      model="m"),
+            journal=journal,
+            **kw,
+        )
+        return mux, journal
+
+    def test_journal_written_and_restored(self, tmp_path):
+        mux, journal = self._mux(tmp_path)
+        st = {"id": "ST01", "lat": 35.0, "lon": -117.0}
+        mux.feed(st, _spiky(64, at=40), seq=1)
+        assert journal.load("ST01") is not None
+        assert mux.stats()["journal_writes"] >= 1.0
+
+        # "Replica death": a brand-new mux over the same journal dir.
+        mux2, _ = self._mux(tmp_path)
+        out = mux2.feed(st, _spiky(32), seq=2)
+        assert mux2.stats()["restores"] == 1.0
+        # Sample count continues from the journal watermark, not zero.
+        assert out["n_samples"] == 96
+        assert out["duplicate"] is False
+
+    def test_restore_parity_with_uninterrupted(self, tmp_path):
+        """Picks from journal-restored continuation == picks from one
+        uninterrupted session over the same packets."""
+        rec = _spiky(192, at=150)
+        pk = [rec[0:64], rec[64:128], rec[128:192]]
+        st = {"id": "ST01"}
+
+        ref = StationMux(_direct_submit, MuxConfig(session=SESS))
+        ref_picks = []
+        for i, data in enumerate(pk):
+            r = ref.feed(st, data, seq=i + 1, end=(i == 2))
+            ref_picks.append(r["picks"])
+
+        mux, _ = self._mux(tmp_path)
+        got_picks = [mux.feed(st, pk[0], seq=1)["picks"]]
+        mux2, _ = self._mux(tmp_path)  # crash + failover after packet 1
+        got_picks.append(mux2.feed(st, pk[1], seq=2)["picks"])
+        got_picks.append(mux2.feed(st, pk[2], seq=3, end=True)["picks"])
+        assert got_picks == ref_picks
+
+    def test_corrupt_journal_falls_back_to_fresh(self, tmp_path):
+        mux, journal = self._mux(tmp_path)
+        st = {"id": "ST01"}
+        mux.feed(st, _spiky(64), seq=1)
+        path = journal._path("ST01")
+        with open(path, "r+b") as f:
+            f.truncate(16)  # torn write
+        mux2, journal2 = self._mux(tmp_path)
+        out = mux2.feed(st, _spiky(32), seq=2)
+        # A torn file reads as "no journal" (corrupt_reads counter), not
+        # a restore failure — restores_failed is for version/config skew.
+        assert mux2.stats()["restores"] == 0.0
+        assert journal2.corrupt_reads == 1
+        assert out["n_samples"] == 32  # fresh session, gap-stitch re-warm
+
+    def test_config_skew_falls_back_to_fresh(self, tmp_path):
+        mux, _ = self._mux(tmp_path)
+        mux.feed({"id": "ST01"}, _spiky(64), seq=1)
+        from seist_tpu.stream.journal import StationJournal
+
+        other = SessionConfig(window=W, stride=8, channel0="non",
+                              sampling_rate=50, min_peak_dist=0.1)
+        mux2 = StationMux(
+            _direct_submit, MuxConfig(session=other, model="m"),
+            journal=StationJournal(str(tmp_path), model="m"),
+        )
+        mux2.feed({"id": "ST01"}, _spiky(32), seq=2)
+        assert mux2.stats()["restores_failed"] == 1.0
+
+    def test_close_all_rejects_inflight_feed(self, tmp_path):
+        from seist_tpu.stream.mux import MuxClosed
+
+        mux, journal = self._mux(tmp_path)
+        st = {"id": "ST01"}
+        mux.feed(st, _spiky(64), seq=1)
+        mux.close_all()
+        with pytest.raises(MuxClosed):
+            mux.feed(st, _spiky(32), seq=2)
+        with pytest.raises(MuxClosed):
+            mux.feed({"id": "NEW"}, _spiky(32), seq=1)
+        # close_all journaled the final state for failover handoff.
+        assert journal.load("ST01") is not None
+
+    def test_close_all_concurrent_with_feeds(self, tmp_path):
+        """Hammer feed() from threads while close_all() latches: every
+        feed either completes normally or raises MuxClosed — never a
+        session error, never an integrate into freed state."""
+        from seist_tpu.stream.mux import MuxClosed
+
+        mux, _ = self._mux(tmp_path)
+        sids = [f"ST{i:02d}" for i in range(8)]
+        for sid in sids:
+            mux.feed({"id": sid}, _spiky(32), seq=1)
+        errs = []
+        done = threading.Event()
+
+        def feeder(sid):
+            seq = 2
+            while not done.is_set():
+                try:
+                    mux.feed({"id": sid}, _spiky(16), seq=seq)
+                except MuxClosed:
+                    return
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+                    return
+                seq += 1
+
+        threads = [threading.Thread(target=feeder, args=(sid,))
+                   for sid in sids]
+        for t in threads:
+            t.start()
+        mux.close_all()
+        done.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errs
+        assert mux.n_sessions == 0
+
+    def test_clean_close_removes_journal(self, tmp_path):
+        mux, journal = self._mux(tmp_path)
+        st = {"id": "ST01"}
+        mux.feed(st, _spiky(64), seq=1)
+        assert journal.load("ST01") is not None
+        mux.feed(st, _spiky(32), seq=2, end=True)
+        # A cleanly finished stream needs no failover handoff.
+        assert journal.load("ST01") is None

@@ -228,3 +228,152 @@ class TestConfig:
         sess = StreamSession(SessionConfig(channel0="non", **CFG))
         with pytest.raises(ValueError):
             sess.push(np.zeros((4, 2), np.float32))
+
+
+class TestSnapshotRestore:
+    """Crash-parity pin: a session serialized at ANY packet boundary and
+    restored into a fresh process-worth of state must emit the identical
+    pick stream — same picks, same emission order, bit-for-bit — as the
+    uninterrupted session. This is what makes journal failover invisible
+    to the alert plane."""
+
+    @staticmethod
+    def _drive(apply_fn, rec, cfg, packets, restore_at=None):
+        import jax.numpy as jnp
+
+        from seist_tpu.stream.journal import (
+            state_from_bytes,
+            state_to_bytes,
+        )
+
+        sess = StreamSession(cfg)
+        emitted = {"ppk": [], "spk": [], "det": []}
+        pos = 0
+        for k, size in enumerate(packets):
+            if restore_at is not None and k == restore_at:
+                # Full codec roundtrip (bytes, not just dicts): exactly
+                # what the journal writes and the survivor reads.
+                blob = state_to_bytes(sess.snapshot())
+                sess = StreamSession.restore(state_from_bytes(blob))
+            for w in sess.push(rec[pos : pos + size]):
+                probs = np.asarray(apply_fn(jnp.asarray(w.data[None])))[0]
+                got = sess.integrate(w.offset, probs)
+                for ph in emitted:
+                    emitted[ph].extend(got[ph])
+            pos += size
+        for w in sess.finish():
+            probs = np.asarray(apply_fn(jnp.asarray(w.data[None])))[0]
+            got = sess.integrate(w.offset, probs)
+            for ph in emitted:
+                emitted[ph].extend(got[ph])
+        fin = sess.finalize()
+        for ph in emitted:
+            emitted[ph].extend(fin[ph])
+        return sess, emitted
+
+    @pytest.mark.parametrize("combine", ["mean", "max"])
+    def test_restore_every_packet_boundary(self, combine):
+        length = 331
+        rec = _record(length, seed=17, events=[60, 170, 290])
+        cfg = SessionConfig(channel0="non", combine=combine, **CFG)
+        packets = _schedules(length)["prime-13"]
+        _, ref_emitted = self._drive(_fake_apply, rec, cfg, packets)
+        for k in range(1, len(packets)):
+            # The emission stream is the pin. Cumulative ``picks``
+            # history is deliberately NOT journaled (it is O(stream);
+            # those picks were already delivered downstream), so only
+            # what each session EMITS is compared — and it must match
+            # element-for-element across the crash point.
+            _, emitted = self._drive(
+                _fake_apply, rec, cfg, packets, restore_at=k
+            )
+            assert emitted == ref_emitted, f"boundary {k} diverged"
+
+    def test_restore_det_channel0(self):
+        length = 220
+        rec = _record(length, seed=9, events=[70, 150])
+        cfg = SessionConfig(channel0="det", combine="mean", **CFG)
+        packets = _schedules(length)["prime-7"]
+        _, ref = self._drive(_det_apply, rec, cfg, packets)
+        for k in (1, len(packets) // 2, len(packets) - 1):
+            _, emitted = self._drive(
+                _det_apply, rec, cfg, packets, restore_at=k
+            )
+            assert emitted == ref
+
+    def test_snapshot_with_pending_raises(self):
+        sess = StreamSession(SessionConfig(channel0="non", **CFG))
+        wins = sess.push(_record(64, seed=1))
+        assert wins  # one due window, not yet integrated
+        with pytest.raises(RuntimeError):
+            sess.snapshot()
+
+    def test_restore_rejects_version_skew(self):
+        sess = StreamSession(SessionConfig(channel0="non", **CFG))
+        state = sess.snapshot()
+        state["meta"]["version"] = 999
+        with pytest.raises(ValueError):
+            StreamSession.restore(state)
+
+    def test_restore_roundtrips_config(self):
+        cfg = SessionConfig(channel0="non", combine="max", **CFG)
+        sess = StreamSession(cfg)
+        got = StreamSession.restore(sess.snapshot())
+        assert got.config == cfg
+
+
+class TestAbandon:
+    def test_abandon_unwedges_frontier(self):
+        """A window whose forward pass was lost (transport refusal,
+        crash) is zero-filled so the finality frontier advances — the
+        stream keeps emitting instead of wedging forever."""
+        cfg = SessionConfig(channel0="non", **CFG)
+        rec = _record(640, seed=5, events=[80, 420])
+        import jax.numpy as jnp
+
+        sess = StreamSession(cfg)
+        dropped = False
+        n_emitted = 0
+        pos = 0
+        for _ in range(10):
+            for w in sess.push(rec[pos : pos + 64]):
+                if not dropped and w.offset >= 128:
+                    dropped = True
+                    sess.abandon(w.offset)
+                    continue
+                probs = np.asarray(_fake_apply(jnp.asarray(w.data[None])))[0]
+                got = sess.integrate(w.offset, probs)
+                n_emitted += sum(len(v) for v in got.values())
+            pos += 64
+        assert dropped
+        # The frontier moved past the hole: the event at 420 (after the
+        # abandoned window) still produced picks mid-stream.
+        assert any(p > 300 for p in sess.picks["ppk"])
+
+    def test_abandoned_hole_emits_no_phantom_detections(self):
+        """A mean-combined 'non' coverage hole renders as pure noise
+        (prob 1.0 on channel 0), not as an all-zero row that the
+        detector would read as a strength-1 event. Non-overlapping
+        stride makes the abandoned window a true hits==0 hole."""
+        cfg = SessionConfig(window=64, stride=64, sampling_rate=50,
+                            min_peak_dist=0.1, channel0="non",
+                            combine="mean")
+        sess = StreamSession(cfg)
+        quiet = (np.random.default_rng(3).standard_normal((192, 3))
+                 * 0.05).astype(np.float32)
+
+        def all_noise(n):
+            probs = np.zeros((n, 3), np.float32)
+            probs[:, 0] = 1.0  # pure noise verdict
+            return probs
+
+        for w in sess.push(quiet):
+            if w.offset == 64:
+                sess.abandon(w.offset)
+                continue
+            sess.integrate(w.offset, all_noise(w.data.shape[0]))
+        for w in sess.finish():
+            sess.integrate(w.offset, all_noise(w.data.shape[0]))
+        sess.finalize()
+        assert sess.picks["det"] == []
+        assert sess.picks["ppk"] == []

@@ -667,10 +667,16 @@ def _run_stream_bench(args) -> int:
     numbers must arrive in order; different stations are independent).
 
     The JSON carries aggregate packet-latency percentiles PLUS
-    per-station accounting — percentiles over station mean latencies and
-    the worst stations by mean — so one hot station can't hide in (or
-    masquerade as) a fleet-wide tail. ``--slo-p99-ms`` gates the
-    aggregate p99 exactly like the /predict bench."""
+    per-station accounting — percentiles over station mean latencies,
+    the worst stations by mean, and per-station failure ledgers
+    (by_status, dropped/duplicated/resumed packet counts) — so one hot
+    or unlucky station can't hide in (or masquerade as) a fleet-wide
+    tail. Connection errors and 5xx are RETRIED with the same seq
+    (reconnect-with-resume) instead of abandoning the station: during a
+    fleet failover the retry lands on a survivor and the packet counts
+    as ``resumed``, so a chaos run's "dropped" number is honest
+    client-observed loss, not transport noise. ``--slo-p99-ms`` gates
+    the aggregate p99 exactly like the /predict bench."""
     import numpy as np
 
     n_st = int(args.stream_stations)
@@ -749,9 +755,21 @@ def _run_stream_bench(args) -> int:
 
     lock = threading.Lock()
     agg = {"ok": 0, "errors": 0, "windows": 0, "picks": 0, "alerts": 0,
-           "dropped_windows": 0, "by_status": {}}
+           "dropped_windows": 0, "by_status": {},
+           "dropped_packets": 0, "duplicate_packets": 0,
+           "resumed_packets": 0}
     latencies: List[float] = []
     per_station: Dict[str, List[float]] = {s["id"]: [] for s in stations}
+    #: per-station failure ledger: the chaos lane's client-side truth.
+    st_acc: Dict[str, Dict[str, Any]] = {
+        s["id"]: {"by_status": {}, "dropped": 0, "duplicates": 0,
+                  "resumed": 0}
+        for s in stations
+    }
+    #: reconnect-with-resume budget per packet: transport errors and
+    #: 5xx re-send the SAME seq (idempotent server-side — a replayed
+    #: packet the first send actually reached dedups as a duplicate).
+    max_retries = 3
     n_workers = max(1, min(args.concurrency, n_st))
     t0 = time.monotonic()
     deadline = t0 + duration
@@ -775,30 +793,56 @@ def _run_stream_bench(args) -> int:
                     }
                     if args.model_name:
                         body["model"] = args.model_name
-                    t_send = time.monotonic()
-                    status, resp = send(body)
-                    lat_ms = (time.monotonic() - t_send) * 1000.0
-                    with lock:
-                        agg["by_status"][status] = (
-                            agg["by_status"].get(status, 0) + 1
-                        )
-                        if status == 200:
-                            agg["ok"] += 1
-                            latencies.append(lat_ms)
-                            per_station[st["id"]].append(lat_ms)
-                            agg["windows"] += resp.get("windows", 0)
-                            agg["picks"] += (
-                                len(resp.get("ppk", []))
-                                + len(resp.get("spk", []))
-                                + len(resp.get("det", []))
+                    attempts = 0
+                    while True:
+                        t_send = time.monotonic()
+                        status, resp = send(body)
+                        lat_ms = (time.monotonic() - t_send) * 1000.0
+                        acc = st_acc[st["id"]]
+                        with lock:
+                            agg["by_status"][status] = (
+                                agg["by_status"].get(status, 0) + 1
                             )
-                            agg["alerts"] += len(resp.get("alerts", []))
-                            agg["dropped_windows"] = max(
-                                agg["dropped_windows"],
-                                resp.get("dropped_windows", 0),
+                            acc["by_status"][status] = (
+                                acc["by_status"].get(status, 0) + 1
                             )
-                        else:
-                            agg["errors"] += 1
+                            if status == 200:
+                                agg["ok"] += 1
+                                latencies.append(lat_ms)
+                                per_station[st["id"]].append(lat_ms)
+                                agg["windows"] += resp.get("windows", 0)
+                                agg["picks"] += (
+                                    len(resp.get("ppk", []))
+                                    + len(resp.get("spk", []))
+                                    + len(resp.get("det", []))
+                                )
+                                agg["alerts"] += len(
+                                    resp.get("alerts", [])
+                                )
+                                agg["dropped_windows"] = max(
+                                    agg["dropped_windows"],
+                                    resp.get("dropped_windows", 0),
+                                )
+                                if resp.get("duplicate"):
+                                    acc["duplicates"] += 1
+                                    agg["duplicate_packets"] += 1
+                                if attempts:
+                                    acc["resumed"] += 1
+                                    agg["resumed_packets"] += 1
+                                break
+                            retryable = (
+                                status == 0 or status >= 500
+                            ) and attempts < max_retries                                 and time.monotonic() < deadline
+                            if not retryable:
+                                agg["errors"] += 1
+                                acc["dropped"] += 1
+                                agg["dropped_packets"] += 1
+                                break
+                        # Reconnect-with-resume: same seq, brief
+                        # backoff — a failover needs a beat for the
+                        # router to re-home the station.
+                        attempts += 1
+                        time.sleep(0.2 * attempts)
                 rounds += 1
                 # Open loop: the next round launches on the cadence
                 # clock, not after completions.
@@ -871,6 +915,20 @@ def _run_stream_bench(args) -> int:
             {"id": sid, "mean_ms": round(m, 3)} for sid, m in worst
         ],
         "stations_reporting": len(means),
+        "dropped_packets": agg["dropped_packets"],
+        "duplicate_packets": agg["duplicate_packets"],
+        "resumed_packets": agg["resumed_packets"],
+        # Only stations that saw trouble (capped): a thousand clean
+        # ledgers would drown the artifact.
+        "station_failures": {
+            sid: acc
+            for sid, acc in sorted(
+                st_acc.items(),
+                key=lambda kv: -(kv[1]["dropped"] + kv[1]["resumed"]),
+            )[:20]
+            if acc["dropped"] or acc["resumed"] or acc["duplicates"]
+            or set(acc["by_status"]) - {200}
+        },
         "stream_stats": stream_stats,
         "measured_at": datetime.now(timezone.utc).strftime(
             "%Y-%m-%dT%H:%M:%SZ"

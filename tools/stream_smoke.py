@@ -108,10 +108,18 @@ def _parity(stream_picks, offline, verdict_rows, sid):
 
 
 def main() -> int:
+    import shutil
+    import tempfile
+
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("JAX_PLATFORMS", "cpu")
     port = _free_port()
+    # Journal plane rides the smoke too: every feed journals
+    # (--stream-journal-every-s 0) so the durability path — snapshot,
+    # atomic write, clean-close removal — is exercised at full cadence
+    # under a real model, and the verdict gates journal_writes > 0.
+    journal_dir = tempfile.mkdtemp(prefix="stream_smoke_journal_")
     proc = subprocess.Popen(
         [
             sys.executable, os.path.join(REPO, "main.py"), "serve",
@@ -121,6 +129,8 @@ def main() -> int:
             "--max-batch", "8",
             "--max-delay-ms", "5",
             "--max-queue", "512",
+            "--stream-journal-dir", journal_dir,
+            "--stream-journal-every-s", "0",
         ],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
     )
@@ -238,18 +248,30 @@ def main() -> int:
             ) and parity_ok
         verdict["parity"] = rows
 
+        # Cleanly-closed sessions remove their journals (no failover
+        # handoff needed) — writes happened, files are gone.
+        leftover = []
+        for root, _dirs, files in os.walk(journal_dir):
+            leftover += [f for f in files if f.endswith(".npz")]
+        verdict["journal_leftover_files"] = len(leftover)
+
         verdict["ok"] = bool(
             tally["rejects"] == 0
             and tally["dropped"] == 0
             and tally["degraded"] == 0
             and stream_stats.get("windows_dropped") == 0.0
             and stream_stats.get("sessions_closed") == float(STATIONS)
+            and stream_stats.get("journal_writes", 0.0) > 0.0
+            and stream_stats.get("restores_failed", 0.0) == 0.0
+            and not leftover
             and parity_ok
         )
         return _finish(proc, err_buf, verdict)
     except BaseException:
         _finish(proc, err_buf, verdict)
         raise
+    finally:
+        shutil.rmtree(journal_dir, ignore_errors=True)
 
 
 def _finish(proc, err_buf, verdict) -> int:

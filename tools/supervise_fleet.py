@@ -406,7 +406,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         slot.spawn()
         router.registry.add(slot.url)
         fleet.add_source(f"replica-{slot.index}", slot.url)
-    server = start_router_server(router, args.router_host, args.router_port)
+    router_port = args.router_port
+    if router_port == 0:
+        # An ephemeral (port-0) router bind can land ON a replica's
+        # pre-assigned port: the replica process may not have bound it
+        # yet, so the kernel hands it out, and that replica then
+        # crash-loops on EADDRINUSE until its relaunch budget retires
+        # the slot. Pick the ephemeral port ourselves, excluding every
+        # slot's port.
+        import socket
+
+        replica_ports = {slot.port for slot in slots}
+        while True:
+            probe = socket.socket()
+            probe.bind((args.router_host, 0))
+            router_port = probe.getsockname()[1]
+            probe.close()
+            if router_port not in replica_ports:
+                break
+    server = start_router_server(router, args.router_host, router_port)
     server.fleet = fleet
     fleet.start()
     host, port = server.server_address[:2]
@@ -541,6 +559,18 @@ def _monitor(
             # Pull it from rotation NOW — the router should stop routing
             # to a dead port before the next health probe finds out.
             router.registry.mark_down(slot.url, reason=f"rc={rc}")
+            # Streaming failover visibility: how many stations the dead
+            # replica was home to. They re-home to survivors on their
+            # next packet (journal restore / gap-stitch re-warm); the
+            # chaos lane greps this line to time the re-home.
+            homed = router.affinity.snapshot()["by_replica"].get(
+                slot.url, 0
+            )
+            if homed:
+                _log(
+                    f"replica {slot.index} was stream home to {homed} "
+                    "stations; re-homing to survivors"
+                )
             if rc == 0:
                 _log(f"replica {slot.index} exited 0 (voluntary); "
                      "slot retired")

@@ -85,6 +85,11 @@ def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     ap.add_argument("--smoke", action="store_true",
                     help="the pinned make twin-smoke configuration: "
                          "50 stations, 60 s scenario")
+    ap.add_argument("--export-schedule", default=None, metavar="PATH",
+                    help="also write the deterministic arrival schedule "
+                    "(stations + per-round packet plan) as JSON, so the "
+                    "real-fleet chaos lane replays the exact same "
+                    "mainshock delivery this twin run drove")
     args = ap.parse_args(argv)
     if args.smoke:
         args.stations = 50
@@ -195,6 +200,93 @@ def synth_network(args, stations, events, rng, velocity_kms=6.0):
     return waves, expected
 
 
+def build_scenario(args):
+    """The full deterministic scenario from one seed: geometry, events,
+    waveforms, truth table. One rng threads through all three stages, so
+    any consumer (the in-process twin, the chaos lane's HTTP driver)
+    regenerates bit-identical waveforms from the same args."""
+    rng = np.random.default_rng(args.seed)
+    stations = make_stations(args, rng)
+    events = make_events(args, rng)
+    waves, expected = synth_network(args, stations, events, rng)
+    return stations, events, waves, expected
+
+
+def make_schedule(args, stations) -> List[List[Dict[str, Any]]]:
+    """Deterministic arrival schedule: a list of ROUNDS, each round the
+    packets delivered in that scenario step, in station order. All fault
+    roles are resolved here — dup stations' replayed packets appear
+    twice (same seq), late stations' bursts land in the round that
+    flushes them, dropout packets are simply absent (seq still advances,
+    so the receiver sees the gap). The final round carries one
+    ``end=true`` close per station. ``drive`` and the real-fleet chaos
+    lane (tests/test_stream_chaos.py) both consume this plan, so the
+    twin's gates and the chaos run argue about the SAME replay."""
+    fs = args.fs
+    packet = args.window // 2
+    L = int(args.duration_s * fs)
+    n_rounds = (L + packet - 1) // packet
+    drop_lo = int(DROPOUT_SPAN_S[0] * L)
+    drop_hi = int(DROPOUT_SPAN_S[1] * L)
+    rounds: List[List[Dict[str, Any]]] = [[] for _ in range(n_rounds + 1)]
+    for st in stations:
+        sid = st["id"]
+        seq = 0
+        held: List[Dict[str, Any]] = []
+        for r in range(n_rounds):
+            lo, hi = r * packet, min((r + 1) * packet, L)
+            seq += 1
+            if st["dropout"] and lo < drop_hi and hi > drop_lo:
+                continue  # packet lost; seq advances -> gap
+            pkt = {"station": sid, "seq": seq, "lo": lo, "hi": hi}
+            if st["late"]:
+                held.append(pkt)
+                if r % 4 == 3 or r == n_rounds - 1:
+                    rounds[r].extend(held)
+                    held = []
+                continue
+            rounds[r].append(pkt)
+            if st["dup"] and seq % 5 == 0:
+                rounds[r].append(dict(pkt))  # replayed packet, same seq
+        rounds[n_rounds].extend(held)  # stragglers (never for r%4 math)
+        seq += 1
+        rounds[n_rounds].append(
+            {"station": sid, "seq": seq, "end": True}
+        )
+    return rounds
+
+
+def export_schedule(path, args, stations, events, rounds) -> None:
+    """One self-describing JSON artifact: enough to regenerate the
+    waveforms (scenario args incl. seed) plus the resolved delivery
+    plan. Written atomically (dotfile + replace, the flight.py idiom) so
+    a concurrently-starting chaos driver never reads a torn file."""
+    doc = {
+        "scenario": {
+            "stations": args.stations,
+            "duration_s": args.duration_s,
+            "window": args.window,
+            "fs": args.fs,
+            "seed": args.seed,
+            "mainshock_frac": args.mainshock_frac,
+            "noise_frac": args.noise_frac,
+            "min_stations": args.min_stations,
+        },
+        "stations": stations,
+        "events": events,
+        "n_rounds": len(rounds),
+        "rounds": rounds,
+    }
+    tmp = os.path.join(
+        os.path.dirname(os.path.abspath(path)) or ".",
+        "." + os.path.basename(path) + ".tmp",
+    )
+    with open(tmp, "w") as f:
+        json.dump(doc, f, separators=(",", ":"))
+        f.write("\n")
+    os.replace(tmp, path)
+
+
 # -------------------------------------------------------------- drive
 def _make_service(args):
     """ServeService over the deterministic z-outlier picker (module
@@ -236,30 +328,30 @@ def _make_service(args):
             "assoc_min_stations": args.min_stations,
             "assoc_window_s": 30.0,
             "assoc_tolerance_s": 2.0,
+            # Durability plane — unset for the in-process twin, set by
+            # tools/twin_replica.py when the chaos fleet needs journaled
+            # failover over the same deterministic model.
+            "journal_dir": getattr(args, "journal_dir", None),
+            "journal_every_s": getattr(args, "journal_every_s", 5.0),
+            "assoc_dedup_window_s": getattr(
+                args, "assoc_dedup_window_s", 2.0
+            ),
         },
     )
 
 
-def drive(args, service, stations, waves):
-    """Feed the whole network through POST /stream semantics.
-
-    ``--workers`` threads each OWN stations ``w::W`` (per-station packet
-    order is a protocol invariant); within a worker, rounds advance all
-    its stations one packet at a time, so picks reach the associator in
-    roughly scenario-time order. Fault behaviors ride the delivery loop:
-    dup stations re-send every 5th packet (same seq), late stations hold
-    4 rounds and deliver a burst, dropout stations skip the packets
-    inside the dropout span (seq keeps counting -> a visible gap)."""
+def drive(args, service, stations, waves, rounds):
+    """Feed the whole network through POST /stream semantics, replaying
+    the arrival schedule ``make_schedule`` resolved (dup/late/dropout
+    fates and all). ``--workers`` threads each OWN stations ``w::W``
+    (per-station packet order is a protocol invariant); within a worker,
+    rounds advance in schedule order, so picks reach the associator in
+    roughly scenario-time order."""
     from seist_tpu.serve.protocol import Overloaded, QueueFull, ServeError
 
-    fs = args.fs
-    packet = args.window // 2
-    L = int(args.duration_s * fs)
-    n_rounds = (L + packet - 1) // packet
-    drop_lo = int(DROPOUT_SPAN_S[0] * L)
-    drop_hi = int(DROPOUT_SPAN_S[1] * L)
     options = {"ppk_threshold": 0.5, "spk_threshold": 0.95,
-               "det_threshold": 0.95, "sampling_rate": fs}
+               "det_threshold": 0.95, "sampling_rate": args.fs}
+    by_id = {st["id"]: st for st in stations}
 
     lock = threading.Lock()
     out = {"alerts": [], "sheds": 0, "errors": 0, "packets": 0,
@@ -294,32 +386,18 @@ def drive(args, service, stations, waves):
     def worker(w):
         # Whole body under try: (threadlint thread-target-raises).
         try:
-            mine = stations[w :: max(1, args.workers)]
-            state = {st["id"]: {"seq": 0, "held": []} for st in mine}
-            for r in range(n_rounds):
-                lo, hi = r * packet, min((r + 1) * packet, L)
-                for st in mine:
-                    s = state[st["id"]]
-                    s["seq"] += 1
-                    if st["dropout"] and lo < drop_hi and hi > drop_lo:
-                        continue  # packet lost; seq advances -> gap
-                    data = waves[st["id"]][lo:hi].tolist()
-                    if st["late"]:
-                        s["held"].append((s["seq"], data))
-                        if r % 4 == 3 or r == n_rounds - 1:
-                            for seq, d in s["held"]:
-                                send(st, d, seq)
-                            s["held"] = []
+            mine = {st["id"] for st in stations[w :: max(1, args.workers)]}
+            for rnd in rounds:
+                for pkt in rnd:
+                    sid = pkt["station"]
+                    if sid not in mine:
                         continue
-                    send(st, data, s["seq"])
-                    if st["dup"] and s["seq"] % 5 == 0:
-                        send(st, data, s["seq"])  # replayed packet, same seq
-            for st in mine:  # close every session: tail windows + finalize
-                s = state[st["id"]]
-                for seq, d in s["held"]:
-                    send(st, d, seq)
-                s["seq"] += 1
-                send(st, None, s["seq"], end=True)
+                    st = by_id[sid]
+                    if pkt.get("end"):
+                        send(st, None, pkt["seq"], end=True)
+                    else:
+                        data = waves[sid][pkt["lo"]:pkt["hi"]].tolist()
+                        send(st, data, pkt["seq"])
         except BaseException as e:  # noqa: BLE001
             with lock:
                 out["errors"] += 1
@@ -421,19 +499,22 @@ def evaluate(args, events, expected, run, stream_stats):
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = get_args(argv)
-    rng = np.random.default_rng(args.seed)
-    stations = make_stations(args, rng)
-    events = make_events(args, rng)
-    waves, expected = synth_network(args, stations, events, rng)
+    stations, events, waves, expected = build_scenario(args)
+    rounds = make_schedule(args, stations)
     print(f"[twin] scenario: {len(stations)} stations "
           f"({sum(s['noise'] for s in stations)} noise, 5 dropout, "
           f"3 late, 4 dup), mainshock @ {events[0]['t']:.1f}s, "
           f"{len(events) - 1} aftershocks, {args.duration_s:.0f}s @ "
           f"{args.fs} Hz", flush=True)
+    if args.export_schedule:
+        export_schedule(args.export_schedule, args, stations, events,
+                        rounds)
+        print(f"[twin] arrival schedule -> {args.export_schedule}",
+              flush=True)
 
     service = _make_service(args)
     try:
-        run = drive(args, service, stations, waves)
+        run = drive(args, service, stations, waves, rounds)
         stream_stats = service.metrics()["stream"].get("twinpick", {})
     finally:
         service.shutdown()

@@ -1,0 +1,107 @@
+"""One HTTP replica of the digital twin's deterministic picker.
+
+The stream-chaos lane (`make stream-chaos`) needs a REAL fleet — three
+processes behind the router, SIGKILL-able mid-mainshock — but the gates
+need the twin's computable ground truth, which a checkpointed model
+cannot give. This bridges the two: the exact ``twinpick`` z-outlier
+service ``tools/twin.py`` drives in-process, wrapped in the serving
+stack's HTTP front-end with the durability plane on (per-station
+journals + alert WAL under ``--journal-dir``, shared by the fleet — the
+sharing IS the failover channel).
+
+Launched by ``tools/supervise_fleet.py`` exactly like a ``main.py
+serve`` replica::
+
+    python tools/supervise_fleet.py --replicas 3 -- \
+        python tools/twin_replica.py --journal-dir /tmp/j
+
+Signals follow the serve CLI's contract: SIGTERM = managed preemption
+(drain, flush journals via ``shutdown(drain=True)``, exit
+``PREEMPT_EXIT_CODE`` so the supervisor relaunches), SIGINT = operator
+stop (exit 0). A SIGKILL — the chaos lane's weapon — runs nothing at
+all, which is the point: recovery must come from the journals the mux
+wrote BEFORE the crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+from types import SimpleNamespace
+from typing import List, Optional
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS))
+sys.path.insert(0, _TOOLS)
+
+
+def get_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(description="twin picker HTTP replica")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--window", type=int, default=256)
+    ap.add_argument("--stations", type=int, default=200,
+                    help="station capacity hint (mux max_stations)")
+    ap.add_argument("--min-stations", type=int, default=4)
+    ap.add_argument("--journal-dir", default=None,
+                    help="shared fleet journal/WAL root (unset = none)")
+    ap.add_argument("--journal-every-s", type=float, default=0.5,
+                    help="per-station journal cadence; the chaos default "
+                    "is tight so a SIGKILL loses sub-second state")
+    ap.add_argument("--dedup-window-s", type=float, default=2.0)
+    return ap.parse_args(argv)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = get_args(argv)
+    import twin
+
+    from seist_tpu.serve.server import (
+        PREEMPT_EXIT_CODE,
+        start_http_server,
+    )
+    from seist_tpu.utils.logger import logger
+
+    service = twin._make_service(SimpleNamespace(
+        window=args.window,
+        stations=args.stations,
+        min_stations=args.min_stations,
+        journal_dir=args.journal_dir,
+        journal_every_s=args.journal_every_s,
+        assoc_dedup_window_s=args.dedup_window_s,
+    ))
+    server = start_http_server(service, args.host, args.port)
+    host, port = server.server_address[:2]
+    logger.info(f"[twin-replica] listening on http://{host}:{port} "
+                f"journal_dir={args.journal_dir or '-'}")
+
+    stop = threading.Event()
+    exit_code = {"rc": 0}
+
+    def _term(signum, frame):
+        if signum == signal.SIGTERM:
+            exit_code["rc"] = PREEMPT_EXIT_CODE
+        # threadlint: disable=signal-handler-unsafe -- flag store +
+        # edge-triggered publish; main thread is parked in stop.wait.
+        service.begin_drain()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    while not stop.wait(1.0):  # timed: a lost set() can't park forever
+        pass
+    rc = exit_code["rc"]
+    logger.info("[twin-replica] draining...")
+    # drain=True closes every stream mux: sessions journal their final
+    # state (the clean-handoff half of failover; SIGKILL skips this).
+    service.shutdown(drain=True)
+    server.shutdown()
+    logger.info(f"[twin-replica] stopped (rc={rc})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
