@@ -6,8 +6,8 @@ CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
 .PHONY: native test t1 lint lint-baseline irlint-report lockgraph \
 	serve-smoke serve-chaos obs-smoke trace-smoke rollout-smoke chaos \
-	pack-smoke bench-loader repick-smoke bench-repick stream-smoke \
-	twin-smoke stream-chaos clean
+	pack-smoke bench-loader repick-smoke bench-repick quant-smoke \
+	stream-smoke twin-smoke stream-chaos clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -84,8 +84,11 @@ pack-smoke:
 
 # Packed-ingest throughput ladder (docs/DATA.md "Benchmarks"): hdf5
 # per-sample reads vs packed per-sample reads vs packed+direct-ingest
-# batch fills on one shared fixture, with the per-stage ms/wf budget.
-# Gate: direct >= 2x hdf5. The committed headline is BENCH_loader_r01.json.
+# batch fills on one shared fixture, with the per-stage ms/wf budget,
+# plus the fp32/bf16/int8 storage-dtype ladder (measured bytes/wf;
+# int8 includes the stage_raw device-dequant lane). Gates: direct >=
+# 2x hdf5, int8 bytes <= 0.55x fp32. Committed headline:
+# BENCH_loader_r02.json.
 bench-loader:
 	JAX_PLATFORMS=cpu python -m tools.bench_loader --compare
 
@@ -97,6 +100,17 @@ bench-loader:
 # One JSON verdict line; non-zero on any violation.
 repick-smoke:
 	JAX_PLATFORMS=cpu python -m tools.repick_smoke
+
+# int8 end-to-end smoke (docs/DATA.md "Storage dtype"): tiny fp32 +
+# int8 packs of the same synthetic source -> direct ingest -> inline
+# repick of both -> gates on-disk bytes <= 0.55x fp32, decision parity
+# vs the fp32 catalog (pick positions within the repo's 0.1 s residual
+# tolerance), host-feed (fill + device_put) speedup >= 1.7x (bytes-
+# bound CPU mechanism proof; the end-to-end chip run is flagged
+# tpu_run: pending), and zero post-warm-up compiles. One JSON verdict
+# line. Committed headline: BENCH_repick_r02.json.
+quant-smoke:
+	JAX_PLATFORMS=cpu python -m tools.quant_smoke
 
 # Batch-vs-serve throughput headline (docs/DATA.md "Batch re-picking"):
 # the repick engine and tools/bench_serve on the SAME model/window/host,

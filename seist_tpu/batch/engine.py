@@ -77,6 +77,33 @@ def _block(out: Any) -> None:
         getattr(leaf, "block_until_ready", lambda: None)()
 
 
+def normalize_transpose(raw):
+    """In-trace input prep: (B, C, L) float32 -> normalized
+    channels-last (B, L, C) — the same 'std' z-score the serving path
+    applies host-side (preprocess.normalize, zero std divides by 1),
+    moved on device so the host fill stays a pure memcpy. Module-level
+    so the irlint manifest lowers the exact program the engine runs."""
+    import jax.numpy as jnp
+
+    x = raw - jnp.mean(raw, axis=2, keepdims=True)
+    std = jnp.std(raw, axis=2, keepdims=True)
+    x = x / jnp.where(std == 0, 1.0, std)
+    return jnp.transpose(x, (0, 2, 1))
+
+
+def dequant_rows(q, scale):
+    """In-trace dequant of int8 shard rows: (B, C, L) int8 + per-row
+    per-channel (B, C) float32 scales -> float32 waveforms. Fused into
+    the consuming program (stage_raw ingest) so the widening happens on
+    DEVICE — the host->device transfer stays 4x narrow. The z-score in
+    :func:`normalize_transpose` is per-channel scale-invariant, so the
+    quantized path's parity vs fp32 storage is bounded by rounding
+    alone."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * scale[:, :, None]
+
+
 class RepickEngine:
     """One worker's archive re-picking loop: a loaded pool entry
     (ModelEntry or MultiTaskEntry) driven at full batch straight off a
@@ -136,6 +163,10 @@ class RepickEngine:
                     f"group '{entry.name}' does not serve tasks {unknown}; "
                     f"available: {list(entry.tasks)}"
                 )
+        # int8 end-to-end: a stage_raw store hands the engine int8 rows
+        # plus resident per-row scales; the program dequantizes on
+        # device (dequant_rows fused ahead of the z-score).
+        self.stage_raw = bool(getattr(store, "stage_raw", False))
         self._program: Optional[aot.AotProgram] = None
         self._warm = False
         self._slow_ms = float(
@@ -151,28 +182,14 @@ class RepickEngine:
         self._c_bytes = BUS.counter("batch_infer_bytes")
 
     # ------------------------------------------------------------ programs
-    def _prep_fn(self):
-        """In-trace input prep: raw (B, C, L) float32 -> normalized
-        channels-last (B, L, C) — the same 'std' z-score the serving
-        path applies host-side (preprocess.normalize, zero std divides
-        by 1), moved on device so the host fill stays a pure memcpy."""
-        import jax.numpy as jnp
-
-        def prep(raw):
-            x = raw - jnp.mean(raw, axis=2, keepdims=True)
-            std = jnp.std(raw, axis=2, keepdims=True)
-            x = x / jnp.where(std == 0, 1.0, std)
-            return jnp.transpose(x, (0, 2, 1))
-
-        return prep
-
     def _step_fn(self, variant: str):
-        """One micro-batch's full program body: prep -> forward (single
-        model) or prep -> trunk -> requested heads (group fan-out) under
-        the serving variant conventions (aot.variant_compute /
-        head_variant_compute + eager transform_variables, so the
-        executable holds the variant's weights at rest)."""
-        prep = self._prep_fn()
+        """One micro-batch's full program body: [dequant ->] prep ->
+        forward (single model) or trunk -> requested heads (group
+        fan-out) under the serving variant conventions
+        (aot.variant_compute / head_variant_compute + eager
+        transform_variables, so the executable holds the variant's
+        weights at rest). stage_raw stores add a second (B, C) scale
+        arg and the int8->f32 widening happens HERE, in-program."""
         entry = self.entry
         if not entry.is_group:
             compute = aot.variant_compute(
@@ -181,46 +198,64 @@ class RepickEngine:
             tv = aot.transform_variables(entry.variables, variant)
             task = self.tasks[0]
 
-            def step(raw):
-                x = prep(raw)
+            def body(x):
                 return {task: compute(tv, x)}
 
-            return step
+        else:
+            from seist_tpu.models.seist import backbone_apply
 
-        from seist_tpu.models.seist import backbone_apply
+            trunk_compute = aot.variant_compute(
+                lambda v, x: backbone_apply(entry.trunk_model, v, x),
+                variant,
+                cast_outputs=False,  # bf16 features flow to bf16 heads
+            )
+            trunk_v = aot.transform_variables(entry.trunk_variables, variant)
+            head_computes = {
+                t: aot.head_variant_compute(entry.heads[t].model, variant)
+                for t in self.tasks
+            }
+            head_vs = {
+                t: aot.transform_variables(entry.heads[t].variables, variant)
+                for t in self.tasks
+            }
 
-        trunk_compute = aot.variant_compute(
-            lambda v, x: backbone_apply(entry.trunk_model, v, x),
-            variant,
-            cast_outputs=False,  # bf16 features flow to bf16 heads
-        )
-        trunk_v = aot.transform_variables(entry.trunk_variables, variant)
-        head_computes = {
-            t: aot.head_variant_compute(entry.heads[t].model, variant)
-            for t in self.tasks
-        }
-        head_vs = {
-            t: aot.transform_variables(entry.heads[t].variables, variant)
-            for t in self.tasks
-        }
+            def body(x):
+                feats = trunk_compute(trunk_v, x)
+                return {
+                    t: head_computes[t](head_vs[t], feats, x)
+                    for t in self.tasks
+                }
 
-        def step(raw):
-            x = prep(raw)
-            feats = trunk_compute(trunk_v, x)
-            return {t: head_computes[t](head_vs[t], feats, x) for t in self.tasks}
+        if self.stage_raw:
+
+            def step(raw, scale):
+                return body(normalize_transpose(dequant_rows(raw, scale)))
+
+        else:
+
+            def step(raw):
+                return body(normalize_transpose(raw))
 
         return step
+
+    def _arg_shapes(self):
+        """PER-STEP compile signature: stage_raw programs take the int8
+        rows AS STORED plus the per-row scale sidecar."""
+        b, c, n = self.batch_size, self.store.n_ch, self.store.raw_len
+        if self.stage_raw:
+            return [((b, c, n), np.int8), ((b, c), np.float32)]
+        return [((b, c, n), np.float32)]
 
     def _compile(self, variant: str) -> aot.AotProgram:
         key = (
             f"repick/{self.entry.name}/b{self.batch_size}"
             f"x{self.batches_per_call}/{variant}"
+            + ("+i8shards" if self.stage_raw else "")
         )
         return aot.aot_compile_multi(
             key,
             self._step_fn(variant),
-            [((self.batch_size, self.store.n_ch, self.store.raw_len),
-              np.float32)],
+            self._arg_shapes(),
             steps=self.batches_per_call,
             model=self.entry.name,
         )
@@ -241,12 +276,18 @@ class RepickEngine:
         self._program = program
         # One call end-to-end: warms pick_peaks/detect_events at the
         # decode shape and proves the executable answers.
-        x = np.zeros(
-            (self.batches_per_call, self.batch_size, self.store.n_ch,
-             self.store.raw_len),
-            np.float32,
+        shape = (
+            self.batches_per_call, self.batch_size, self.store.n_ch,
+            self.store.raw_len,
         )
-        out = program(x)
+        if self.stage_raw:
+            args = (
+                np.zeros(shape, np.int8),
+                np.ones(shape[:3], np.float32),
+            )
+        else:
+            args = (np.zeros(shape, np.float32),)
+        out = program(*args)
         _block(out)
         self._decode_call(out, n_valid=1, row_lo=0)
         self._warm = True
@@ -277,8 +318,23 @@ class RepickEngine:
             (self.batches_per_call, self.batch_size, self.store.n_ch,
              self.store.raw_len)
         ).astype(np.float32)
-        ref = jax.device_get(ref_prog(probe))
-        out = jax.device_get(var_prog(probe))
+        if self.stage_raw:
+            # Quantize the probe with the PACK-TIME quantizer so both
+            # programs see the archive's actual inputs; ref (fp32
+            # weights) and variant then differ by the weight variant
+            # alone — the gate isolates exactly that error.
+            from seist_tpu.data import packed
+
+            k, b, c, n = probe.shape
+            q, sc = packed.quantize_rows(probe.reshape(-1, n))
+            args = (
+                q.reshape(k, b, c, n),
+                sc.reshape(k, b, c),
+            )
+        else:
+            args = (probe,)
+        ref = jax.device_get(ref_prog(*args))
+        out = jax.device_get(var_prog(*args))
         failed = []
         for task in self.tasks:
             spec = (
@@ -397,7 +453,21 @@ class RepickEngine:
                     self.store.n_ch,
                     self.store.raw_len,
                 )
-            yield c, x, n_valid, lo, monotonic() - t0
+                if self.stage_raw:
+                    # Resident per-row scales ride the same fallback
+                    # gather as the labels — row<->scale stays
+                    # consistent through quarantine replacement.
+                    args = (
+                        x,
+                        rows["data_scale"].reshape(
+                            self.batches_per_call,
+                            self.batch_size,
+                            self.store.n_ch,
+                        ),
+                    )
+                else:
+                    args = (x,)
+            yield c, args, n_valid, lo, monotonic() - t0
 
     @staticmethod
     def _put(item):
@@ -407,8 +477,8 @@ class RepickEngine:
         slabs are fresh per fill — ingest.py's reuse_staging auto rule)."""
         import jax
 
-        c, x, n_valid, lo, fill_s = item
-        return c, jax.device_put(x), n_valid, lo, fill_s
+        c, args, n_valid, lo, fill_s = item
+        return c, jax.device_put(args), n_valid, lo, fill_s
 
     # ----------------------------------------------------------------- run
     def run_unit(
@@ -468,7 +538,7 @@ class RepickEngine:
                     time.sleep(self._slow_ms / 1e3)
                 t0 = monotonic()
                 with BUS.span("batch_infer_device"):
-                    out = self._program(x_dev)
+                    out = self._program(*x_dev)
                     _block(out)
                 self.stage["device"] += monotonic() - t0
                 t0 = monotonic()

@@ -47,7 +47,11 @@ import numpy as np
 
 from seist_tpu import taskspec
 from seist_tpu.data import io_guard
-from seist_tpu.data.packed import PackedDataset, read_waveform_slice
+from seist_tpu.data.packed import (
+    INT8_POISON,
+    PackedDataset,
+    read_waveform_slice,
+)
 from seist_tpu.data.pipeline import RawStore, SeismicDataset
 from seist_tpu.data.preprocess import pad_phases
 
@@ -92,7 +96,40 @@ class PackedRawStore(RawStore):
         prefetch: int = 2,
         reuse_staging: Optional[bool] = None,
         storage_dtype: Optional[np.dtype] = None,
+        scales: Optional[np.ndarray] = None,
+        stage_raw: bool = False,
     ) -> None:
+        # On-disk dtype (bf16 shard variants halve the read bandwidth,
+        # int8 v3 shards quarter it); default fills dequant/upcast into
+        # the float32 staging slab so everything downstream of the fill
+        # stays dtype-blind. ``stage_raw`` (int8 only) instead stages
+        # the int8 rows AS-IS — one memcpy, no host widening — plus a
+        # resident per-row ``data_scale`` column; the consuming device
+        # program dequantizes (the repick engine's int8 end-to-end
+        # path; bytes stay 4x narrow across the host->device transfer).
+        self.storage_dtype = (
+            np.dtype(storage_dtype)
+            if storage_dtype is not None
+            else np.dtype(np.float32)
+        )
+        self.stage_raw = bool(stage_raw)
+        if self.stage_raw and self.storage_dtype != np.int8:
+            raise ValueError(
+                "stage_raw staging is the int8 device-dequant path; "
+                f"this pack stores {self.storage_dtype}"
+            )
+        if self.storage_dtype == np.int8:
+            if scales is None:
+                raise ValueError(
+                    "int8 packs need the per-row scale sidecar columns "
+                    "(scale_0..); this index has none — repack (v3)"
+                )
+            scales = np.ascontiguousarray(scales, np.float32)
+            if self.stage_raw:
+                # Resident like the labels so the quarantine-fallback
+                # tree-gather (a[actual]) keeps row<->scale consistent.
+                arrays = dict(arrays)
+                arrays["data_scale"] = scales
         super().__init__(
             arrays,
             n_raw=n_raw,
@@ -101,14 +138,7 @@ class PackedRawStore(RawStore):
             phase_slots=phase_slots,
         )
         self.n_ch = int(n_ch)
-        # On-disk dtype (bf16 shard variants halve the read bandwidth);
-        # fills upcast into the float32 staging slab, so everything
-        # downstream of the fill stays dtype-blind.
-        self.storage_dtype = (
-            np.dtype(storage_dtype)
-            if storage_dtype is not None
-            else np.dtype(np.float32)
-        )
+        self._scales = scales
         self.row_nbytes = self.n_ch * self.raw_len * self.storage_dtype.itemsize
         self._data_dir = data_dir
         self._shards = np.asarray(shards, np.int64)
@@ -131,10 +161,14 @@ class PackedRawStore(RawStore):
                 reuse_staging = jax.default_backend() != "cpu"
         self._reuse = bool(reuse_staging) and batch_size > 0
         self._batch_size = int(batch_size)
+        self._staging_dtype = (
+            np.dtype(np.int8) if self.stage_raw else np.dtype(np.float32)
+        )
         self._ring: List[np.ndarray] = (
             [
                 np.empty(
-                    (self._batch_size, self.n_ch, self.raw_len), np.float32
+                    (self._batch_size, self.n_ch, self.raw_len),
+                    self._staging_dtype,
                 )
                 # one slab filling + `prefetch` queued + one in the step
                 for _ in range(prefetch + 2)
@@ -148,6 +182,7 @@ class PackedRawStore(RawStore):
         self._c_batches = BUS.counter("data_ingest_batches")
         self._c_samples = BUS.counter("data_ingest_samples")
         self._c_bytes = BUS.counter("data_ingest_bytes")
+        self._c_int8 = BUS.counter("data_ingest_int8_rows")
 
     # ------------------------------------------------------------- build
     @classmethod
@@ -158,6 +193,7 @@ class PackedRawStore(RawStore):
         batch_size: int = 0,
         prefetch: int = 2,
         reuse_staging: Optional[bool] = None,
+        stage_raw: bool = False,
     ) -> "PackedRawStore":
         """Metadata-only construction from a packed-backed
         :class:`SeismicDataset`. Mirrors ``RawStore.build``'s row
@@ -185,6 +221,21 @@ class PackedRawStore(RawStore):
                 "mixes them"
             )
         n_ch, raw_len = int(n_ch_col[0]), int(n_samp_col[0])
+
+        scales = None
+        if ds.storage_dtype == np.int8:
+            missing = [
+                f"scale_{c}" for c in range(n_ch) if f"scale_{c}" not in col
+            ]
+            if missing:
+                raise ValueError(
+                    "int8 packs need the per-row scale sidecar columns "
+                    f"({', '.join(missing)}); this index has none — "
+                    "repack (format v3)"
+                )
+            scales = np.stack(
+                [col[f"scale_{c}"] for c in range(n_ch)], axis=1
+            ).astype(np.float32)
 
         names = taskspec.flatten_io_names(
             sds.input_names + sds.label_names
@@ -283,6 +334,8 @@ class PackedRawStore(RawStore):
             prefetch=prefetch,
             reuse_staging=reuse_staging,
             storage_dtype=ds.storage_dtype,
+            scales=scales,
+            stage_raw=stage_raw,
         )
 
     # ---------------------------------------------------------- raw read
@@ -300,11 +353,34 @@ class PackedRawStore(RawStore):
             self.row_nbytes,
             desc=f"packed.direct (sample {r})",
         )
-        # Cast-assignment upcasts bf16 shard variants in place (no
-        # intermediate copy); f32 packs keep the plain memcpy.
-        out[...] = np.frombuffer(raw, self.storage_dtype).reshape(
+        row = np.frombuffer(raw, self.storage_dtype).reshape(
             self.n_ch, self.raw_len
         )
+        if self.storage_dtype == np.int8:
+            # int8 can't hold NaN: corruption is the out-of-contract
+            # -128 byte (the symmetric quantizer emits [-127, 127]
+            # only) or a non-finite sidecar scale.
+            if validate:
+                if (row == INT8_POISON).any():
+                    bad = int((row == INT8_POISON).sum())
+                    raise io_guard.CorruptSampleError(
+                        f"packed.direct: int8 sample {r} has {bad} "
+                        f"poison byte(s) ({INT8_POISON})"
+                    )
+                if not np.isfinite(self._scales[r]).all():
+                    raise io_guard.CorruptSampleError(
+                        f"packed.direct: int8 sample {r} has a "
+                        "non-finite dequant scale"
+                    )
+            if self.stage_raw:
+                out[...] = row  # bytes stay narrow; device dequantizes
+            else:
+                out[...] = row
+                out *= self._scales[r][:, None]
+            return
+        # Cast-assignment upcasts bf16 shard variants in place (no
+        # intermediate copy); f32 packs keep the plain memcpy.
+        out[...] = row
         if validate and not np.isfinite(out).all():
             bad = int(out.size - np.isfinite(out).sum())
             raise io_guard.CorruptSampleError(
@@ -355,7 +431,9 @@ class PackedRawStore(RawStore):
     # --------------------------------------------------------- batch fill
     def _staging(self, batch: int) -> np.ndarray:
         if not self._reuse:
-            return np.empty((batch, self.n_ch, self.raw_len), np.float32)
+            return np.empty(
+                (batch, self.n_ch, self.raw_len), self._staging_dtype
+            )
         buf = self._ring[self._ring_i]
         self._ring_i = (self._ring_i + 1) % len(self._ring)
         return buf[:batch]
@@ -393,6 +471,8 @@ class PackedRawStore(RawStore):
         self._c_batches.inc()
         self._c_samples.inc(batch)
         self._c_bytes.inc(batch * self.row_nbytes)
+        if self.storage_dtype == np.int8:
+            self._c_int8.inc(batch)
         return rows
 
     def row_batch(self, raw_idx: np.ndarray) -> Dict[str, Any]:
@@ -411,5 +491,7 @@ def describe(store: PackedRawStore) -> str:
         f"{store.disk_bytes / 2**20:.1f} MiB on-disk waveforms, "
         f"{store.nbytes / 2**20:.2f} MiB resident metadata, "
         f"staging {'ring' if store._reuse else 'per-batch'} "
-        f"({store.n_ch}x{store.raw_len} f32 rows)"
+        f"({store.n_ch}x{store.raw_len} {store._staging_dtype.name} rows"
+        + (", device dequant" if store.stage_raw else "")
+        + ")"
     )

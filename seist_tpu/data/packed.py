@@ -83,20 +83,34 @@ _INT_COLS = (
 # Per-shard bookkeeping columns that never reach the merged index.
 _SIDECAR_ONLY = ("total_bytes", "plan_lo", "plan_hi", "storage_itemsize")
 
-# On-disk waveform storage dtypes (format v2 ``meta.json["dtype"]``).
-# float32 is the training-parity default; bfloat16 halves the shard
-# bytes (and therefore read bandwidth) for inference-only archives —
-# readers upcast to float32 on fill, so every consumer downstream of the
-# read stays dtype-blind (the ROADMAP "quantized shard variants" item).
-_DTYPE_ALIASES = {"fp32": "float32", "bf16": "bfloat16"}
+# On-disk waveform storage dtypes (``meta.json["dtype"]``). float32 is
+# the training-parity default; bfloat16 halves the shard bytes (and
+# therefore read bandwidth) for inference-only archives; int8 (format
+# v3) quarters them with a per-row per-channel scale sidecar column —
+# readers dequantize/upcast to float32 on fill, so every consumer
+# downstream of the read stays dtype-blind (the ROADMAP "quantized
+# shard variants" item); the direct-ingest path can additionally stage
+# int8 rows AS-IS and dequantize on device (data/ingest.py).
+_DTYPE_ALIASES = {"fp32": "float32", "bf16": "bfloat16", "i8": "int8"}
+
+#: int8 per-channel scale sidecar columns (format v3): NaN-padded to 3
+#: channels exactly like snr_0..2; float packs never carry them, so the
+#: v2 index schema is byte-for-byte unchanged.
+_SCALE_COLS = ("scale_0", "scale_1", "scale_2")
+
+#: Symmetric int8 quantization never emits -128 (clip to [-127, 127]),
+#: so any -128 byte in a shard is out-of-contract — the poison marker
+#: the io_guard ladder treats as permanent corruption (int8 rows cannot
+#: carry NaN, this is their NaN-poison equivalent).
+INT8_POISON = -128
 
 
 def canonical_dtype(name: str) -> str:
     name = _DTYPE_ALIASES.get(str(name).lower(), str(name).lower())
-    if name not in ("float32", "bfloat16"):
+    if name not in ("float32", "bfloat16", "int8"):
         raise ValueError(
             f"unsupported packed storage dtype '{name}' "
-            "(use float32 or bfloat16)"
+            "(use float32, bfloat16 or int8)"
         )
     return name
 
@@ -108,9 +122,48 @@ def storage_dtype(name: str) -> np.dtype:
     name = canonical_dtype(name)
     if name == "float32":
         return np.dtype(np.float32)
+    if name == "int8":
+        return np.dtype(np.int8)
     import ml_dtypes
 
     return np.dtype(ml_dtypes.bfloat16)
+
+
+def quantize_rows(data: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-channel int8 quantization of one ``(C, L)`` float32
+    waveform: ``scale = max|x| / 127`` (clamped like serve/aot's
+    weight quantizer), ``q = clip(round(x / scale), -127, 127)``.
+    Returns ``(q int8 (C, L), scale float32 (C,))`` — THE pack-time
+    quantizer, shared by the repick engine's parity probe and the
+    round-trip tests so tolerances cannot drift from the format."""
+    data = np.asarray(data, np.float32)
+    scale = (
+        np.maximum(np.abs(data).max(axis=1), 1e-8) / 127.0
+    ).astype(np.float32)
+    q = np.clip(
+        np.round(data / scale[:, None]), -127, 127
+    ).astype(np.int8)
+    return q, scale
+
+
+class DtypeMixError(ValueError):
+    """A pack directory already holds shards across the quantized/float
+    boundary from what this run requests. Float<->float resumes repack
+    (itemsize is part of the plan identity); int8 packs change the
+    SIDECAR SCHEMA too (scale columns), so mixing is refused loudly
+    instead of half-rewriting a directory two readers would disagree
+    on."""
+
+    def __init__(self, existing: str, requested: str, out_dir: str):
+        self.existing = existing
+        self.requested = requested
+        self.out_dir = out_dir
+        super().__init__(
+            f"pack dir {out_dir} already holds {existing} shards; "
+            f"refusing to mix with --dtype {requested} (int8 packs carry "
+            "a scale sidecar column float packs lack). Pack into a fresh "
+            "directory, or rewrite this one with --no-resume."
+        )
 
 
 def shard_path(out_dir: str, shard_id: int) -> str:
@@ -188,12 +241,13 @@ def plan_shards(
 
 
 # ---------------------------------------------------------------- shard write
-def _new_cols() -> Dict[str, list]:
+def _new_cols(quantized: bool = False) -> Dict[str, list]:
     return {
         **{f: [] for f in _SCALAR_FIELDS},
         "snr_0": [],
         "snr_1": [],
         "snr_2": [],
+        **({c: [] for c in _SCALE_COLS} if quantized else {}),
         "offset": [],
         "n_ch": [],
         "n_samp": [],
@@ -239,7 +293,8 @@ def pack_shard(
     the sidecar rename is the shard-complete commit point, so a kill at
     any instant leaves either a complete shard or a resumable hole."""
     store_dt = storage_dtype(dtype)
-    cols = _new_cols()
+    quantized = store_dt == np.int8
+    cols = _new_cols(quantized)
     total = 0
     bin_path = shard_path(out_dir, plan.shard_id)
     tmp_bin = bin_path + ".tmp"
@@ -252,7 +307,19 @@ def pack_shard(
                     raise ValueError(
                         f"event {j}: data must be (C, L), got {data.shape}"
                     )
-                if store_dt != np.float32:
+                if quantized:
+                    if data.shape[0] > len(_SCALE_COLS):
+                        raise ValueError(
+                            f"event {j}: int8 packs support up to "
+                            f"{len(_SCALE_COLS)} channels (scale sidecar "
+                            f"columns), got {data.shape[0]}"
+                        )
+                    data, scale = quantize_rows(data)
+                    for c in range(len(_SCALE_COLS)):
+                        cols[f"scale_{c}"].append(
+                            float(scale[c]) if c < scale.size else np.nan
+                        )
+                elif store_dt != np.float32:
                     data = data.astype(store_dt)
                 f.write(data.tobytes())
                 _append_sample(cols, event, row, j)
@@ -398,6 +465,44 @@ def merge_index(
     return arrays
 
 
+def _existing_pack_dtype(out_dir: str) -> Optional[str]:
+    """Best-effort canonical dtype of whatever already lives in
+    ``out_dir``: meta.json when the pack committed, else the first
+    complete sidecar (an interrupted pack has no meta yet). None when
+    the directory holds no pack artifacts."""
+    meta_p = os.path.join(out_dir, _META)
+    if os.path.exists(meta_p):
+        try:
+            with open(meta_p) as f:
+                return canonical_dtype(
+                    json.load(f).get("dtype", "float32")
+                )
+        except (OSError, ValueError, KeyError):
+            return None
+    try:
+        sidecars = sorted(
+            f for f in os.listdir(out_dir) if f.endswith(_SIDECAR_SUFFIX)
+        )
+    except OSError:
+        return None
+    for name in sidecars:
+        try:
+            with np.load(
+                os.path.join(out_dir, name), allow_pickle=False
+            ) as z:
+                if "scale_0" in z.files:
+                    return "int8"
+                itemsize = (
+                    int(z["storage_itemsize"][0])
+                    if "storage_itemsize" in z.files
+                    else 4
+                )
+            return {1: "int8", 2: "bfloat16"}.get(itemsize, "float32")
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+            continue
+    return None
+
+
 def pack_sources(
     sources: Sequence[PackSource],
     out_dir: str,
@@ -417,6 +522,12 @@ def pack_sources(
     dtype = canonical_dtype(dtype)
     t0 = monotonic()
     os.makedirs(out_dir, exist_ok=True)
+    if resume:
+        existing = _existing_pack_dtype(out_dir)
+        if existing is not None and (existing == "int8") != (
+            dtype == "int8"
+        ):
+            raise DtypeMixError(existing, dtype, out_dir)
     datasets = [s.create() for s in sources]
     channels = list(datasets[0].channels())
     fs = int(datasets[0].sampling_rate())
@@ -493,7 +604,9 @@ def pack_sources(
         "sampling_rate": fs,
         "n_events": n_total,
         "n_shards": len(plans),
-        "format_version": 2,
+        # v3 = int8 waveforms + scale sidecar columns; float packs stay
+        # v2 so every pre-int8 reader keeps accepting them unchanged.
+        "format_version": 3 if dtype == "int8" else 2,
         "dtype": dtype,
         "samples_per_shard": caps[0] if len(set(caps)) == 1 else caps,
         "sources": [
@@ -517,6 +630,13 @@ def pack_sources(
         f"packed {n_total} events into {len(plans)} shard(s) at {out_dir} "
         f"({skipped} resumed, {wall_s:.1f}s)"
     )
+    # On-disk accounting for the dtype ladder verdict: actual shard
+    # bytes vs what the same event set costs at fp32 (the ISSUE 18
+    # bytes<=0.55x acceptance is measured here, not asserted).
+    on_disk = sum(
+        os.path.getsize(shard_path(out_dir, p.shard_id)) for p in plans
+    )
+    fp32_bytes = int((arrays["n_ch"] * arrays["n_samp"]).sum()) * 4
     return {
         "out": out_dir,
         "dtype": dtype,
@@ -525,6 +645,10 @@ def pack_sources(
         "samples": n_total,
         "samples_packed": stats["samples"],
         "bytes": stats["bytes"],
+        "on_disk_bytes": on_disk,
+        "bytes_per_row": round(on_disk / max(n_total, 1), 1),
+        "fp32_bytes_per_row": round(fp32_bytes / max(n_total, 1), 1),
+        "bytes_vs_fp32": round(on_disk / max(fp32_bytes, 1), 4),
         "samples_per_shard": meta["samples_per_shard"],
         "sources": [s["name"] for s in meta["sources"]],
         "wall_s": round(wall_s, 2),
@@ -696,6 +820,26 @@ class PackedDataset(DatasetBase):
             .reshape(c, length)
             .astype(np.float32)
         )
+        if self._storage_dtype == np.int8:
+            # Format v3 host-path dequant. int8 rows cannot carry NaN,
+            # so their poison markers are the out-of-contract -128 byte
+            # and a non-finite sidecar scale — both permanent corruption
+            # through the same io_guard ladder as a NaN-poisoned float
+            # row.
+            scale = np.array(
+                [row[f"scale_{ch}"] for ch in range(c)], np.float32
+            )
+            if data.min() <= INT8_POISON:
+                raise CorruptSampleError(
+                    f"packed (sample {idx}): int8 row holds the "
+                    f"out-of-contract {INT8_POISON} byte (poisoned?)"
+                )
+            if not np.isfinite(scale).all():
+                raise CorruptSampleError(
+                    f"packed (sample {idx}): non-finite int8 scale "
+                    f"{scale.tolist()}"
+                )
+            data *= scale[:, None]
 
         def scalar(field):
             v = row[field]

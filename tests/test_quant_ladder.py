@@ -1,0 +1,290 @@
+"""int8 quantization ladder (ISSUE 18): format-v3 packs, round-trip
+error bounds, dtype-mix refusal, and io_guard parity on the stage_raw
+device-dequant ingest path.
+
+The contract under test: archive bytes stay int8 from disk to the
+device boundary (single-memcpy staging + resident per-row scales), the
+HOST dequant lanes (PackedDataset events, PackedRawStore default fill)
+reproduce ``q * scale`` exactly, and every fault the float shards
+survive — truncation, poisoned rows, corrupt scales — the int8 shards
+survive through the SAME quarantine/fallback ladder.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import seist_tpu
+from seist_tpu import taskspec
+from seist_tpu.data import io_guard, pipeline
+from seist_tpu.data.ingest import PackedRawStore
+from seist_tpu.data.packed import (
+    INT8_POISON,
+    DtypeMixError,
+    PackSource,
+    pack_sources,
+    quantize_rows,
+    shard_path,
+)
+
+seist_tpu.load_all()
+
+N_EVENTS = 20
+L_TRACE = 512
+WINDOW = 256
+
+#: One spec per label kind the pipeline serves off packed rows: soft
+#: pick curves (dpk), ONEHOT (pmp), and the three VALUE heads
+#: (emg/baz/dis) — labels ride the index, never the quantized bytes.
+TASK_SPECS = ("seist_s_dpk", "seist_s_pmp", "magnet", "seist_s_baz",
+              "seist_s_dis")
+
+
+def _pack(root, dtype, n_events=N_EVENTS, trace=L_TRACE, sps=6, workers=0):
+    return pack_sources(
+        [PackSource(
+            name="synthetic",
+            dataset_kwargs={
+                "num_events": n_events,
+                "trace_samples": trace,
+                "cache": False,
+            },
+        )],
+        str(root),
+        samples_per_shard=sps,
+        num_workers=workers,
+        dtype=dtype,
+    )
+
+
+@pytest.fixture(scope="module")
+def pack_pair(tmp_path_factory):
+    """(fp32 dir, int8 dir, int8 pack stats) of the same source."""
+    root = tmp_path_factory.mktemp("quant_pair")
+    s32 = _pack(root / "f32", "float32")
+    s8 = _pack(root / "i8", "int8")
+    return s32["out"], s8["out"], s8
+
+
+def _sds(packed_dir, model="seist_s_dpk", **kw):
+    kw.setdefault("shuffle", False)
+    kw.setdefault("data_split", False)
+    return pipeline.from_task_spec(
+        taskspec.get_task_spec(model), "packed", "train", seed=0,
+        in_samples=WINDOW, augmentation=False, data_dir=packed_dir, **kw,
+    )
+
+
+# ------------------------------------------------------------ round trip
+@pytest.mark.parametrize("model", TASK_SPECS)
+def test_int8_roundtrip_bounds_per_task(pack_pair, model):
+    """pack -> ingest -> dequant vs the fp32 source, per label kind:
+    waveforms within the half-LSB bound 0.5 * scale, EXACTLY equal to
+    re-applying the pack-time quantizer, labels bit-identical."""
+    f32_dir, i8_dir, _ = pack_pair
+    st32 = PackedRawStore.build(_sds(f32_dir, model), batch_size=4)
+    st8 = PackedRawStore.build(_sds(i8_dir, model), batch_size=4)
+    idx = np.arange(st32.n_raw)
+    r32 = st32.row_batch(idx)
+    r8 = st8.row_batch(idx)
+    assert r8["data"].dtype == np.float32
+    for j in range(st32.n_raw):
+        q, scale = quantize_rows(r32["data"][j])
+        # Host dequant is exactly q * scale (shared quantizer — the
+        # tolerance can't drift from the format).
+        np.testing.assert_array_equal(
+            r8["data"][j], q.astype(np.float32) * scale[:, None]
+        )
+        err = np.abs(r8["data"][j] - r32["data"][j])
+        bound = 0.5 * scale[:, None] + 1e-7
+        assert (err <= bound).all(), (model, j, float(err.max()))
+    for k in r32:
+        if k == "data":
+            continue
+        if isinstance(r32[k], dict):  # values / onehots sub-columns
+            for name in r32[k]:
+                np.testing.assert_array_equal(r8[k][name], r32[k][name])
+        else:
+            np.testing.assert_array_equal(r8[k], r32[k])
+
+
+def test_int8_parallel_pack_bit_identical(tmp_path):
+    """2-worker int8 pack == serial pack, byte for byte, scale sidecar
+    included — the plan-first contract extends to format v3."""
+    from tests.test_packed import _dir_fingerprint
+
+    a = _pack(tmp_path / "serial", "int8")
+    b = _pack(tmp_path / "par", "int8", workers=2)
+    assert a["shards"] == b["shards"] > 1
+    fp_a = _dir_fingerprint(a["out"])
+    assert "scale_0" in fp_a["index.npz"]
+    assert fp_a == _dir_fingerprint(b["out"])
+
+
+def test_int8_pack_bytes_verdict(pack_pair):
+    """The pack stats report measured on-disk bytes vs fp32 (the CLI's
+    one-line JSON verdict) and meet the <=0.55x acceptance ceiling."""
+    f32_dir, i8_dir, s8 = pack_pair
+    def shard_bytes(d):
+        return sum(
+            os.path.getsize(os.path.join(d, f))
+            for f in os.listdir(d) if f.endswith(".bin")
+        )
+    assert s8["on_disk_bytes"] == shard_bytes(i8_dir)
+    assert s8["bytes_vs_fp32"] == pytest.approx(
+        shard_bytes(i8_dir) / shard_bytes(f32_dir)
+    )
+    assert s8["bytes_vs_fp32"] <= 0.55
+
+
+# ------------------------------------------------------- dtype-mix refusal
+def test_dtype_mix_refused_both_directions(tmp_path):
+    _pack(tmp_path / "i8", "int8")
+    with pytest.raises(DtypeMixError) as ei:
+        _pack(tmp_path / "i8", "float32")
+    assert ei.value.existing == "int8"
+    assert ei.value.requested == "float32"
+    _pack(tmp_path / "f32", "bfloat16")
+    with pytest.raises(DtypeMixError) as ei:
+        _pack(tmp_path / "f32", "int8")
+    assert ei.value.existing == "bfloat16"
+    assert ei.value.requested == "int8"
+
+
+def test_pack_dataset_cli_structured_mix_refusal(tmp_path, capsys):
+    """tools/pack_dataset.py surfaces DtypeMixError as a machine-
+    readable one-line JSON verdict with exit code 2."""
+    from tools.pack_dataset import main as pack_main
+
+    out = str(tmp_path / "pack")
+    kwargs = json.dumps({
+        "num_events": 6, "trace_samples": 128, "cache": False,
+    })
+    base = ["--dataset", "synthetic", "--dataset-kwargs", kwargs,
+            "--out", out, "--samples-per-shard", "3"]
+    assert pack_main(base + ["--dtype", "int8"]) == 0
+    capsys.readouterr()
+    assert pack_main(base + ["--dtype", "float32"]) == 2
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict == {
+        "ok": False,
+        "error": "dtype_mix",
+        "existing_dtype": "int8",
+        "requested_dtype": "float32",
+        "out": out,
+        "detail": verdict["detail"],
+    }
+    assert "scale sidecar" in verdict["detail"]
+
+
+# ------------------------------------------------------------ fault parity
+@pytest.mark.faults
+@pytest.mark.parametrize("stage_raw", (False, True))
+def test_int8_poison_byte_quarantined(tmp_path, stage_raw):
+    """A -128 byte (symmetric quantization never emits it) is permanent
+    corruption: quarantine + deterministic fallback, on both the host-
+    dequant and the stage_raw lanes."""
+    out = _pack(tmp_path / "pack", "int8", sps=50)["out"]  # one shard
+    sds = _sds(out)
+    store = PackedRawStore.build(sds, batch_size=4, stage_raw=stage_raw)
+    poison = 3
+    with open(shard_path(out, 0), "r+b") as f:
+        f.seek(int(store._offsets[poison]))
+        f.write(np.full(8, INT8_POISON, np.int8).tobytes())
+    io_guard.COUNTERS.reset()
+    rows = store.row_batch_at(
+        np.array([poison, 0]), epoch=0, idx=np.array([poison, 0])
+    )
+    assert io_guard.COUNTERS.snapshot()["quarantined"] == 1
+    assert poison in sds.quarantine
+    if stage_raw:
+        assert rows["data"].dtype == np.int8
+        assert not (rows["data"] == INT8_POISON).any()
+        assert np.isfinite(rows["data_scale"]).all()
+    else:
+        assert np.isfinite(rows["data"]).all()
+
+
+@pytest.mark.faults
+def test_int8_corrupt_scale_sidecar_quarantined(tmp_path):
+    """A non-finite scale in the v3 sidecar (truncated/garbled index
+    column) kills the row through the same CorruptSampleError ladder —
+    never a NaN waveform downstream."""
+    out = _pack(tmp_path / "pack", "int8", sps=50)["out"]
+    idx_path = os.path.join(out, "index.npz")
+    with np.load(idx_path, allow_pickle=False) as z:
+        cols = {k: z[k].copy() for k in z.files}
+    victim = 2
+    cols["scale_0"][victim] = np.nan
+    np.savez(idx_path, **cols)
+    sds = _sds(out)
+    store = PackedRawStore.build(sds, batch_size=4)
+    io_guard.COUNTERS.reset()
+    rows = store.row_batch_at(
+        np.array([victim, 0]), epoch=0, idx=np.array([victim, 0])
+    )
+    assert io_guard.COUNTERS.snapshot()["quarantined"] == 1
+    assert victim in sds.quarantine
+    assert np.isfinite(rows["data"]).all()
+
+
+@pytest.mark.faults
+def test_truncated_int8_shard_stage_raw_falls_back(tmp_path):
+    """Truncated v3 shard through the stage_raw fill: short read ->
+    quarantine -> the replacement row is the deterministic candidate's
+    CONTENT (int8 bytes + its scale row stay consistent)."""
+    out = _pack(tmp_path / "pack", "int8", sps=5)["out"]
+    sds = _sds(out)
+    store = PackedRawStore.build(sds, batch_size=4, stage_raw=True)
+    last_shard = int(store._shards.max())
+    p = shard_path(out, last_shard)
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.truncate(size - store.row_nbytes // 2)
+    victims = np.flatnonzero(
+        (store._shards == last_shard)
+        & (store._offsets + store.row_nbytes > size - store.row_nbytes // 2)
+    )
+    bad = int(victims[0])
+    io_guard.COUNTERS.reset()
+    raw_idx = np.array([bad, 0, 1])
+    rows = store.row_batch_at(raw_idx, epoch=0, idx=raw_idx)
+    snap = io_guard.COUNTERS.snapshot()
+    assert snap["quarantined"] == 1
+    assert snap["fallback_reads"] == 1
+    assert bad in sds.quarantine
+    cand = next(
+        c
+        for c in sds.quarantine.candidates(bad, seed=0, epoch=0, idx=bad)
+        if c != bad
+    )
+    expect = store.row_batch_at(np.array([cand]), epoch=0,
+                                idx=np.array([cand]))
+    np.testing.assert_array_equal(rows["data"][0], expect["data"][0])
+    np.testing.assert_array_equal(
+        rows["data_scale"][0], expect["data_scale"][0]
+    )
+
+
+# ------------------------------------------------- device-dequant parity
+def test_stage_raw_device_dequant_matches_host(pack_pair):
+    """The engine's in-program dequant (batch/engine.dequant_rows) over
+    the staged int8 rows + resident scales reproduces the host dequant
+    lane exactly, and the raw lane counts its rows on the obs bus."""
+    from seist_tpu.batch.engine import dequant_rows
+    from seist_tpu.obs.bus import BUS
+
+    _, i8_dir, _ = pack_pair
+    host = PackedRawStore.build(_sds(i8_dir), batch_size=4)
+    raw = PackedRawStore.build(_sds(i8_dir), batch_size=4, stage_raw=True)
+    idx = np.arange(4)
+    before = BUS.counter("data_ingest_int8_rows").value
+    r_host = host.row_batch_at(idx, epoch=0, idx=idx)
+    r_raw = raw.row_batch_at(idx, epoch=0, idx=idx)
+    assert BUS.counter("data_ingest_int8_rows").value == before + 8
+    assert r_raw["data"].dtype == np.int8
+    assert r_raw["data_scale"].shape == (4, raw.n_ch)
+    deq = np.asarray(dequant_rows(r_raw["data"], r_raw["data_scale"]))
+    np.testing.assert_array_equal(deq, r_host["data"])

@@ -23,9 +23,12 @@ runs the packed-ingest ladder on ONE shared fixture instead: hdf5
 per-sample reads vs packed per-sample reads vs packed+direct-ingest
 batch fills (data/ingest.py), with a per-stage budget that shows the
 per-sample Event decode and ``_stack`` assembly eliminated on the fast
-path. Pass gate: direct >= 2x the hdf5 per-sample read throughput
-(ISSUE 14 acceptance; the committed verdict lives in
-BENCH_loader_r01.json). Env: BENCH_EVENTS (512), BENCH_SAMPLES (8192),
+path — plus the storage-dtype ladder (fp32/bf16/int8 sibling packs of
+the same fixture: per-dtype fill ms/wf and measured on-disk bytes/wf;
+int8 also measures the stage_raw device-dequant lane). Pass gates:
+direct >= 2x the hdf5 per-sample read throughput (ISSUE 14) and int8
+on-disk bytes <= 0.55x fp32 (ISSUE 18); the committed verdict lives in
+BENCH_loader_r02.json. Env: BENCH_EVENTS (512), BENCH_SAMPLES (8192),
 BENCH_READS (400), BENCH_BATCH (64).
 """
 
@@ -225,6 +228,53 @@ def compare(out_path: str = "") -> int:
     direct_wfs = direct_n / dt
     fill_ms = dt * 1e3 / direct_n
 
+    # ------------------------------------------------------ dtype ladder
+    # fp32/bf16/int8 direct-ingest fills off sibling packs of the SAME
+    # fixture: per-dtype fill ms/wf plus on-disk bytes/wf measured from
+    # the shards (ISSUE 18 — the bandwidth claim is measured, not
+    # asserted). int8 additionally measures the stage_raw lane (rows
+    # staged AS int8 + resident scales, the repick engine's
+    # device-dequant feed) — that is the lane whose host->device bytes
+    # shrink 4x.
+    def shard_bytes(d):
+        return sum(
+            os.path.getsize(os.path.join(d, f))
+            for f in os.listdir(d)
+            if f.startswith("shard_") and f.endswith(".bin")
+        )
+
+    ladder = {}
+    fp32_bytes_wf = shard_bytes(packed_dir) / n_events
+    for dname in ("float32", "bfloat16", "int8"):
+        pdir = (
+            packed_dir
+            if dname == "float32"
+            else ensure_packed_fixture(n_events, in_samples, dtype=dname)
+        )
+        dsds = pipeline.from_task_spec(
+            spec, "packed", "train", seed=0, in_samples=in_samples,
+            augmentation=False, data_dir=pdir,
+        )
+        entry = {"bytes_per_wf": round(shard_bytes(pdir) / n_events, 1)}
+        entry["bytes_vs_fp32"] = round(
+            entry["bytes_per_wf"] / fp32_bytes_wf, 4
+        )
+        lanes = [("fill_f32", False)]
+        if dname == "int8":
+            lanes.append(("fill_raw_int8", True))
+        for lane, raw in lanes:
+            dstore = PackedRawStore.build(
+                dsds, batch_size=batch, stage_raw=raw
+            )
+            dstore.row_batch(chunks[0])  # warm memmaps/page cache
+            t0 = time.perf_counter()
+            for c in chunks:
+                dstore.row_batch(c)
+            ddt = time.perf_counter() - t0
+            entry[lane + "_wfs"] = round(direct_n / ddt, 1)
+            entry[lane + "_ms_per_wf"] = round(ddt * 1e3 / direct_n, 4)
+        ladder[dname] = entry
+
     verdict = {
         "metric": "packed_ingest_throughput",
         "unit": "waveforms/sec/host (single-thread read lane)",
@@ -247,13 +297,19 @@ def compare(out_path: str = "") -> int:
                 "eliminated": ["per_sample_event_decode", "_stack"],
             },
         },
+        "dtype_ladder": ladder,
         "config": {
             "n_events": n_events,
             "in_samples": in_samples,
             "n_reads": n_reads,
             "batch": batch,
         },
-        "pass": direct_wfs >= 2.0 * hdf5_wfs,
+        # Two gates: the ISSUE 14 direct>=2x hdf5 throughput floor and
+        # the ISSUE 18 int8 on-disk bytes<=0.55x fp32 ceiling.
+        "pass": (
+            direct_wfs >= 2.0 * hdf5_wfs
+            and ladder["int8"]["bytes_vs_fp32"] <= 0.55
+        ),
     }
     line = json.dumps(verdict)
     print(line)

@@ -138,16 +138,22 @@ def ensure_loader_fixture(n_events: int, in_samples: int) -> str:
     return root
 
 
-def ensure_packed_fixture(n_events: int, in_samples: int) -> str:
+def ensure_packed_fixture(
+    n_events: int, in_samples: int, dtype: str = "float32"
+) -> str:
     """The packed-shard conversion of :func:`ensure_loader_fixture`'s
     DiTing-light fixture (marker-cached): builds the HDF5 fixture, then
     repacks it with seist_tpu.data.packed.pack_dataset. Returns the
-    packed data_dir — train on it with dataset ``packed``."""
+    packed data_dir — train on it with dataset ``packed``. Non-float32
+    dtypes land in sibling ``packed_<dtype>`` directories (int8 packs
+    change the sidecar schema and may never share a directory with the
+    float fixture — the bench_loader dtype ladder packs all three)."""
     import sys
     import time
 
     src_dir = ensure_loader_fixture(n_events, in_samples)
-    out = os.path.join(src_dir, "packed")
+    suffix = "" if dtype == "float32" else f"_{dtype}"
+    out = os.path.join(src_dir, "packed" + suffix)
     marker = os.path.join(out, ".complete")
     if not os.path.exists(marker):
         sys.path.insert(
@@ -167,7 +173,7 @@ def ensure_packed_fixture(n_events: int, in_samples: int) -> str:
             data_split=False,
         )
         t0 = time.perf_counter()
-        pack_dataset(src, out)
+        pack_dataset(src, out, dtype=dtype)
         with open(marker, "w") as f:
             f.write("ok\n")
         print(

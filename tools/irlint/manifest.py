@@ -704,6 +704,68 @@ def group_programs(
     return out
 
 
+# --------------------------------------------------------- repick programs
+def repick_programs(
+    model_name: str = "phasenet",
+    *,
+    batch: int = 8,
+    window: int = 512,
+    variants: Sequence[str] = ("int8",),
+) -> List[ProgramSpec]:
+    """The batch repick engine's int8-shards program (ISSUE 18): int8
+    rows + per-row per-channel scales enter the device program AS
+    STORED; the dequant (``engine.dequant_rows``) is fused ahead of the
+    z-score prep and the variant forward — the exact per-micro-batch
+    step body ``RepickEngine._step_fn`` builds (the shipped executable
+    ``lax.map``s it over batches_per_call). Lowering it here keeps the
+    host-transfer and matmul-coverage audits on the path forever: the
+    widening must happen IN-program, never before the device boundary."""
+    import jax
+    import jax.numpy as jnp
+
+    import seist_tpu
+    from seist_tpu.batch import engine as engine_mod
+    from seist_tpu.serve import aot
+
+    seist_tpu.load_all()
+    ctx = _ModelCtx(model_name, window)
+    site = site_of(engine_mod.RepickEngine._step_fn)
+    out: List[ProgramSpec] = []
+    for variant in variants:
+        vs = variant_structs(ctx.var_structs, variant)
+        compute = aot.variant_compute(
+            lambda v, x: ctx.model.apply(v, x, train=False), variant
+        )
+
+        def step(v, q, scale, _compute=compute):
+            x = engine_mod.normalize_transpose(
+                engine_mod.dequant_rows(q, scale)
+            )
+            return _compute(v, x)
+
+        out.append(
+            ProgramSpec(
+                key=f"repick/{model_name}/b{batch}/{variant}+i8shards",
+                kind="serve",
+                site=site,
+                fn=step,
+                args=(
+                    vs,
+                    jax.ShapeDtypeStruct(
+                        (batch, ctx.in_channels, window), jnp.int8
+                    ),
+                    jax.ShapeDtypeStruct(
+                        (batch, ctx.in_channels), jnp.float32
+                    ),
+                ),
+                policy="bf16" if variant == "bf16" else "fp32",
+                bucket=batch,
+                notes={"variant": variant, "shards": "int8"},
+            )
+        )
+    return out
+
+
 # --------------------------------------------------------- stream program
 def stream_program(
     *, window: int = 512, n_windows: int = 15, record_len: int = 4096
@@ -817,6 +879,12 @@ def default_manifest(
             lambda: group_programs(
                 serve_group, group_tasks, buckets=buckets, ladder=ladder,
                 variants=variants, window=window,
+            ),
+        ),
+        (
+            [f"repick/phasenet/b{batch}/int8+i8shards"],
+            lambda: repick_programs(
+                "phasenet", batch=batch, window=window, variants=("int8",)
             ),
         ),
         (

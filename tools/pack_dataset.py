@@ -84,10 +84,13 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--dtype", default="float32",
-        choices=("float32", "fp32", "bfloat16", "bf16"),
+        choices=("float32", "fp32", "bfloat16", "bf16", "int8", "i8"),
         help="on-disk waveform dtype; bf16 halves shard bytes (and read "
         "bandwidth) for INFERENCE-ONLY archives — readers upcast to "
-        "float32 on fill (docs/DATA.md)",
+        "float32 on fill; int8 (format v3) quarters them with per-row "
+        "per-channel max-abs scales in the index sidecar — readers "
+        "dequantize on fill, the repick engine dequantizes ON DEVICE "
+        "(docs/DATA.md). int8 and float packs cannot share a directory.",
     )
     ap.add_argument(
         "--dataset-kwargs", default="",
@@ -96,7 +99,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     import seist_tpu
-    from seist_tpu.data.packed import PackSource, pack_sources
+    from seist_tpu.data.packed import DtypeMixError, PackSource, pack_sources
 
     seist_tpu.load_all()
     ds_kwargs = json.loads(args.dataset_kwargs) if args.dataset_kwargs else {}
@@ -113,15 +116,29 @@ def main(argv=None) -> int:
                 dataset_kwargs=ds_kwargs,
             )
         ]
-    stats = pack_sources(
-        sources,
-        args.out,
-        num_workers=args.workers,
-        samples_per_shard=args.samples_per_shard or None,
-        shard_mb=args.shard_mb,
-        resume=not args.no_resume,
-        dtype=args.dtype,
-    )
+    try:
+        stats = pack_sources(
+            sources,
+            args.out,
+            num_workers=args.workers,
+            samples_per_shard=args.samples_per_shard or None,
+            shard_mb=args.shard_mb,
+            resume=not args.no_resume,
+            dtype=args.dtype,
+        )
+    except DtypeMixError as e:
+        # Structured refusal (test-pinned): int8 v3 packs change the
+        # index SCHEMA (scale sidecar), so they never share a directory
+        # with float packs.
+        print(json.dumps({
+            "ok": False,
+            "error": "dtype_mix",
+            "existing_dtype": e.existing,
+            "requested_dtype": e.requested,
+            "out": e.out_dir,
+            "detail": str(e),
+        }))
+        return 2
     stats["workers"] = args.workers
     print(json.dumps(stats))
     return 0

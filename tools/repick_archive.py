@@ -254,10 +254,15 @@ def run_worker(args, worker_index: int, num_workers: int) -> int:
         in_samples=raw_len, augmentation=False, shuffle=False,
         data_split=False,
     )
+    # int8 v3 archives feed the device-dequant path: rows stay int8
+    # through staging and the host->device copy, the program widens
+    # (batch/engine.dequant_rows is fused ahead of the z-score).
+    pds = packed_dataset_of(sds)
     store = PackedRawStore.build(
-        sds, batch_size=rows_per_call, prefetch=args.prefetch
+        sds, batch_size=rows_per_call, prefetch=args.prefetch,
+        stage_raw=(pds.storage_dtype == np.int8),
     )
-    keys = packed_dataset_of(sds)._meta_data["key"].to_numpy()
+    keys = pds._meta_data["key"].to_numpy()
     entry = _load_entry(args, raw_len)
     engine = RepickEngine(
         entry, store,
