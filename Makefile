@@ -5,9 +5,9 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 
 .PHONY: native test t1 lint lint-baseline irlint-report lockgraph \
-	serve-smoke serve-chaos obs-smoke trace-smoke rollout-smoke chaos \
-	pack-smoke bench-loader repick-smoke bench-repick quant-smoke \
-	stream-smoke twin-smoke stream-chaos clean
+	replay-smoke serve-smoke serve-chaos obs-smoke trace-smoke \
+	rollout-smoke chaos pack-smoke bench-loader repick-smoke \
+	bench-repick quant-smoke stream-smoke twin-smoke stream-chaos clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -17,27 +17,31 @@ $(NATIVE_DIR)/libwavekit.so: $(NATIVE_DIR)/wavekit.cpp
 test:
 	python -m pytest tests/ -x -q
 
-# Static-analysis gate, ALL THREE analyzers through one shared frontend
+# Static-analysis gate, ALL FOUR analyzers through one shared frontend
 # invocation (docs/STATIC_ANALYSIS.md; single interpreter startup, one
-# file walk feeding both AST passes, one manifest walk, combined exit
-# code): jaxlint — JAX hot-path hazards (host syncs, PRNG key reuse,
-# missing donate_argnums, retraces, wall-clock intervals, broad
+# file walk feeding the three AST passes, one manifest walk, combined
+# exit code): jaxlint — JAX hot-path hazards (host syncs, PRNG key
+# reuse, missing donate_argnums, retraces, wall-clock intervals, broad
 # excepts); threadlint — concurrency/lifecycle hazards (unguarded
 # shared attrs, unsafe signal handlers, silent thread death, untimed
-# waits, SYN-drop backlogs, exit-code contract); irlint — IR-level
-# properties of the LOWERED programs the repo ships (fp32 matmuls under
-# the bf16 policy, donation aliasing, in-program host transfers, bucket
-# padding waste, replicated data args on meshes). Each fails only on
-# findings not grandfathered in its tools/<tool>_baseline.json.
+# waits, SYN-drop backlogs, exit-code contract); detlint — determinism
+# hazards (unsorted dir enumeration, unseeded/global RNG, wall-clock or
+# unregistered env reads in det-critical modules, set/dict iteration
+# order, float reduction order); irlint — IR-level properties of the
+# LOWERED programs the repo ships (fp32 matmuls under the bf16 policy,
+# donation aliasing, in-program host transfers, bucket padding waste,
+# replicated data args on meshes). Each fails only on findings not
+# grandfathered in its tools/<tool>_baseline.json.
 lint:
 	python -m tools.lint
 
 # Re-accept the current jaxlint findings (review the diff before
-# committing!). Deliberately does NOT touch tools/threadlint_baseline.json
-# or tools/irlint_baseline.json: both are empty by construction — fix the
-# code or add a rationale'd `# threadlint: disable` / `# irlint: disable`
-# instead of grandfathering (`python -m tools.irlint --update-baseline`
-# additionally REFUSES to write while its baseline is empty).
+# committing!). Deliberately does NOT touch tools/threadlint_baseline.json,
+# tools/detlint_baseline.json, or tools/irlint_baseline.json: all three
+# are empty by construction — fix the code or add a rationale'd
+# `# threadlint: disable` / `# detlint: disable` / `# irlint: disable`
+# instead of grandfathering (detlint and irlint --update-baseline
+# additionally REFUSE to write while their baselines are empty).
 lint-baseline:
 	python -m tools.jaxlint seist_tpu --update-baseline
 
@@ -54,6 +58,16 @@ irlint-report:
 lockgraph:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m smoke --lock-graph \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+# detlint runtime audit lane (docs/STATIC_ANALYSIS.md "Determinism
+# analysis"): the whole det-critical pipeline — pack -> resume ->
+# repick -> journal-restore + alert WAL — run twice under perturbation
+# (PYTHONHASHSEED 0 vs 1, 1 vs 2 workers, reversed directory inode
+# order via the relink shim) with every digest pinned byte-identical.
+# One JSON verdict line (digests + perturbations tried); non-zero on
+# any divergence.
+replay-smoke:
+	JAX_PLATFORMS=cpu python -m tools.replay_smoke
 
 # Tier-1 verify: the exact line from ROADMAP.md (fast lane, CPU backend,
 # slow-marked kill/resume e2e excluded). Prints DOTS_PASSED for the driver.

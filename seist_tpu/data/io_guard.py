@@ -185,6 +185,9 @@ class RetryPolicy:
 
     def sleep_s(self, attempt: int) -> float:
         base = min(self.backoff_base_s * (2.0 ** attempt), self.backoff_cap_s)
+        # detlint: disable=unseeded-rng -- jitter shapes SLEEP TIME only
+        # (retry decorrelation after a shared-fs hiccup needs it to be
+        # uncorrelated across threads); it never touches sample content.
         return base * random.uniform(0.5, 1.5)
 
 

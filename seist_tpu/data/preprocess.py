@@ -495,6 +495,9 @@ class DataPreprocessor:
     ) -> Event:
         """Full preprocessing of one event (ref: preprocess.py:501-542)."""
         if rng is None:
+            # detlint: disable=unseeded-rng -- interactive-use fallback
+            # only: every det-path caller (pipeline, pack, repick)
+            # threads a Generator seeded from the run's root seed.
             rng = np.random.default_rng()
         if not inplace:
             event = copy.deepcopy(event)

@@ -299,9 +299,12 @@ class Associator:
         # node compresses moveout until unrelated picks BARELY cohere; a
         # node near the true origin fits fewer-or-equal picks nearly
         # exactly and must win.
-        soft = sum(1.0 - abs(ot - t_med) / tol for ot, _ in coherent)
+        # fsum: exactly-rounded regardless of pairing order, so the score
+        # (and the alert IDs downstream of t0) cannot drift by an ulp
+        # when the coherent-pick list arrives chunked differently.
+        soft = math.fsum(1.0 - abs(ot - t_med) / tol for ot, _ in coherent)
         spread = coherent[-1][0] - coherent[0][0]
-        t0 = sum(ot for ot, _ in coherent) / len(coherent)
+        t0 = math.fsum(ot for ot, _ in coherent) / len(coherent)
         return (soft, len(coherent), -spread, t0, [p for _, p in coherent])
 
     def _best_origin(self, picks: List[StationPick]):

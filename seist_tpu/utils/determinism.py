@@ -1,0 +1,30 @@
+"""Determinism-contract markers (see docs/STATIC_ANALYSIS.md
+"Determinism analysis").
+
+This module must stay dependency-free: it is imported by det-critical
+data/stream modules that the jax-free serving front tier also reaches.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+_F = TypeVar("_F", bound=Callable)
+
+__all__ = ["telemetry_only"]
+
+
+def telemetry_only(fn: _F) -> _F:
+    """Mark a function in a det-critical module as telemetry-only.
+
+    The marked function may read wall-clock (``time.time()``,
+    ``datetime.now()``) without tripping detlint's
+    ``wallclock-in-deterministic-path`` rule. The decoration is a
+    CONTRACT, not a mechanism: the author asserts the value never
+    reaches shard bytes, catalog rows, journal state, or IDs — only
+    logs, meters, and progress reporting. detlint recognizes the
+    decorator by name, so the assertion is reviewable at the def site
+    instead of buried in a suppression comment per call.
+    """
+    fn.__telemetry_only__ = True
+    return fn

@@ -1,0 +1,605 @@
+"""detlint tests: every rule catches its seeded violation and stays
+quiet on the clean twin; det-path gating (the same code is clean outside
+DET_PATH_GLOBS); the local-dataflow exemption for assigned enumerations;
+the detlint suppression tag (shared grammar with jaxlint/threadlint,
+disjoint namespace); CLI exit codes on seeded fixtures for EVERY rule in
+the catalog plus the refuse-empty --update-baseline contract; the
+replay-lane runtime helpers (digest/relink); the reversed-listdir
+resume/restore regressions; and the replay-smoke e2e under
+PYTHONHASHSEED x worker-count perturbation."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+# repo root is put on sys.path by tests/conftest.py
+from tools.detlint import __main__ as detlint_cli  # noqa: E402
+from tools.detlint.engine import lint_source  # noqa: E402
+from tools.detlint.runtime import (  # noqa: E402
+    combine,
+    digest_tree,
+    relink_tree,
+)
+
+DET_PATH = "seist_tpu/data/example.py"
+PLAIN_PATH = "seist_tpu/obs/example.py"
+
+
+def rules_of(src, path=PLAIN_PATH):
+    return [f.rule for f in lint_source(textwrap.dedent(src), path)]
+
+
+# ------------------------------------------------- unsorted-dir-enumeration
+def test_listdir_iteration_flagged():
+    src = """
+    import os
+
+    def scan(d):
+        for f in os.listdir(d):
+            process(f)
+    """
+    assert rules_of(src) == ["unsorted-dir-enumeration"]
+
+
+def test_sorted_listdir_clean():
+    src = """
+    import os
+
+    def scan(d):
+        for f in sorted(os.listdir(d)):
+            process(f)
+    """
+    assert rules_of(src) == []
+
+
+def test_listdir_emptiness_and_len_clean():
+    src = """
+    import os
+
+    def probe(d):
+        if os.listdir(d):
+            return len(os.listdir(d))
+        return 0
+    """
+    assert rules_of(src) == []
+
+
+def test_listdir_membership_clean():
+    src = """
+    import os
+
+    def has_meta(d):
+        return "meta.json" in os.listdir(d)
+    """
+    assert rules_of(src) == []
+
+
+def test_assigned_listdir_consumed_in_sorted_clean():
+    # The journal.py idiom: names = os.listdir(...) later wrapped in
+    # sorted() — every use order-insensitive, so the assignment is exempt.
+    src = """
+    import os
+
+    def station_ids(root):
+        names = os.listdir(root)
+        return sorted(n for n in names if n.endswith(".npz"))
+    """
+    assert rules_of(src) == []
+
+
+def test_assigned_glob_indexed_flagged():
+    # The obs_smoke bug shape: dumps[0] on an unsorted glob picks a
+    # machine-dependent file.
+    src = """
+    import glob
+
+    def first_dump(pat):
+        dumps = glob.glob(pat)
+        return dumps[0]
+    """
+    assert rules_of(src) == ["unsorted-dir-enumeration"]
+
+
+def test_iterdir_flagged_sorted_genexp_clean():
+    src = """
+    from pathlib import Path
+
+    def walk(p):
+        for f in Path(p).iterdir():
+            yield f
+
+    def walk_sorted(p):
+        return sorted(f.name for f in Path(p).iterdir())
+    """
+    assert rules_of(src) == ["unsorted-dir-enumeration"]
+
+
+# ------------------------------------------------------------- unseeded-rng
+def test_global_np_random_draw_flagged():
+    src = """
+    import numpy as np
+
+    def jiggle(x):
+        return x + np.random.uniform(-1, 1)
+    """
+    assert rules_of(src) == ["unseeded-rng"]
+
+
+def test_zero_arg_default_rng_flagged_seeded_clean():
+    src = """
+    import numpy as np
+
+    def bad():
+        return np.random.default_rng()
+
+    def good(seed):
+        return np.random.default_rng(seed)
+    """
+    assert rules_of(src) == ["unseeded-rng"]
+
+
+def test_seed_plumbing_clean():
+    src = """
+    import random
+    import numpy as np
+
+    def seed_everything(seed):
+        random.seed(seed)
+        np.random.seed(seed)
+    """
+    assert rules_of(src) == []
+
+
+def test_stdlib_random_draw_flagged():
+    src = """
+    import random
+
+    def jitter(base):
+        return base * random.uniform(0.5, 1.5)
+    """
+    assert rules_of(src) == ["unseeded-rng"]
+
+
+def test_jax_random_alias_not_mistaken_for_stdlib():
+    # `from jax import random` makes random.uniform a KEYED jax draw —
+    # deterministic by construction, none of stdlib's business.
+    src = """
+    from jax import random
+
+    def noise(key, shape):
+        return random.uniform(key, shape)
+    """
+    assert rules_of(src) == []
+
+
+def test_prngkey_from_wallclock_flagged_seed_clean():
+    src = """
+    import time
+    import jax
+
+    def bad():
+        return jax.random.PRNGKey(int(time.time()))
+
+    def good(seed):
+        return jax.random.PRNGKey(seed)
+    """
+    assert rules_of(src) == ["unseeded-rng"]
+
+
+# -------------------------------------------- wallclock-in-deterministic-path
+def test_wallclock_in_det_path_flagged():
+    src = """
+    import time
+
+    def stamp_row(row):
+        row["t"] = time.time()
+        return row
+    """
+    assert rules_of(src, DET_PATH) == ["wallclock-in-deterministic-path"]
+
+
+def test_wallclock_outside_det_path_clean():
+    src = """
+    import time
+
+    def stamp_row(row):
+        row["t"] = time.time()
+        return row
+    """
+    assert rules_of(src, PLAIN_PATH) == []
+
+
+def test_telemetry_only_decorator_exempts():
+    src = """
+    import time
+
+    from seist_tpu.utils.determinism import telemetry_only
+
+    @telemetry_only
+    def log_progress(n):
+        logger.info(f"{n} at {time.time()}")
+    """
+    assert rules_of(src, DET_PATH) == []
+
+
+def test_monotonic_interval_clean_in_det_path():
+    src = """
+    import time
+
+    def timed(fn):
+        t0 = time.monotonic()
+        fn()
+        return time.monotonic() - t0
+    """
+    assert rules_of(src, DET_PATH) == []
+
+
+def test_datetime_now_in_det_path_flagged():
+    src = """
+    from datetime import datetime
+
+    def tag():
+        return datetime.now().isoformat()
+    """
+    assert rules_of(src, DET_PATH) == ["wallclock-in-deterministic-path"]
+
+
+# --------------------------------------------- set-or-dict-order-dependence
+def test_set_iteration_flagged():
+    src = """
+    def emit_all(emit):
+        for x in {"a", "b", "c"}:
+            emit(x)
+    """
+    assert rules_of(src) == ["set-or-dict-order-dependence"]
+
+
+def test_list_of_set_flagged_sorted_clean():
+    src = """
+    def dedup_bad(xs):
+        return list(set(xs))
+
+    def dedup_good(xs):
+        return sorted(set(xs))
+    """
+    assert rules_of(src) == ["set-or-dict-order-dependence"]
+
+
+def test_set_membership_clean():
+    src = """
+    def is_vowel(c):
+        return c in {"a", "e", "i", "o", "u"}
+    """
+    assert rules_of(src) == []
+
+
+def test_dict_keys_join_flagged_sorted_clean():
+    src = """
+    def ident_bad(d):
+        return ",".join(d.keys())
+
+    def ident_good(d):
+        return ",".join(sorted(d.keys()))
+    """
+    assert rules_of(src) == ["set-or-dict-order-dependence"]
+
+
+# ------------------------------------------------------ float-reduction-order
+def test_float_sum_in_det_path_flagged():
+    src = """
+    def mean_origin(times):
+        return sum(t / 2.0 for t in times) / len(times)
+    """
+    assert rules_of(src, DET_PATH) == ["float-reduction-order"]
+
+
+def test_int_sum_in_det_path_clean():
+    src = """
+    def total_rows(shards):
+        return sum(len(s) for s in shards)
+    """
+    assert rules_of(src, DET_PATH) == []
+
+
+def test_fsum_clean_and_non_det_path_clean():
+    src = """
+    import math
+
+    def mean_origin(times):
+        return math.fsum(t / 2.0 for t in times) / len(times)
+    """
+    assert rules_of(src, DET_PATH) == []
+    bad = """
+    def score(rs):
+        return sum(1.0 - r for r in rs)
+    """
+    assert rules_of(bad, DET_PATH) == ["float-reduction-order"]
+    assert rules_of(bad, PLAIN_PATH) == []
+
+
+# ------------------------------------------------------- env-dependent-default
+def test_unregistered_env_read_in_det_path_flagged():
+    src = """
+    import os
+
+    def knob():
+        return os.environ.get("MY_SECRET_KNOB", "1")
+    """
+    assert rules_of(src, DET_PATH) == ["env-dependent-default"]
+
+
+def test_registered_env_reads_clean():
+    src = """
+    import os
+
+    def knobs():
+        a = os.environ.get("SEIST_FAULT_REPICK_SLOW_MS", "0")
+        b = os.environ.get("SEIST_IO_GUARD", "1")
+        c = os.getenv("PYTHONHASHSEED")
+        return a, b, c
+    """
+    assert rules_of(src, DET_PATH) == []
+
+
+def test_env_subscript_and_nonliteral_flagged():
+    src = """
+    import os
+
+    def bad(name):
+        return os.environ["MY_OTHER_KNOB"], os.environ.get(name)
+    """
+    assert rules_of(src, DET_PATH) == [
+        "env-dependent-default",
+        "env-dependent-default",
+    ]
+
+
+def test_env_read_outside_det_path_clean():
+    src = """
+    import os
+
+    def knob():
+        return os.environ.get("MY_SECRET_KNOB", "1")
+    """
+    assert rules_of(src, PLAIN_PATH) == []
+
+
+# --------------------------------------------------------------- suppressions
+def test_suppression_with_rationale_silences():
+    src = """
+    import os
+
+    def scan(d):
+        # detlint: disable=unsorted-dir-enumeration -- consumer dedups
+        for f in os.listdir(d):
+            process(f)
+    """
+    assert rules_of(src) == []
+
+
+def test_suppression_without_rationale_is_void():
+    src = """
+    import os
+
+    def scan(d):
+        for f in os.listdir(d):  # detlint: disable=unsorted-dir-enumeration
+            process(f)
+    """
+    assert sorted(rules_of(src)) == [
+        "suppression-missing-rationale",
+        "unsorted-dir-enumeration",
+    ]
+
+
+def test_jaxlint_tag_cannot_silence_detlint():
+    src = """
+    import os
+
+    def scan(d):
+        # jaxlint: disable=unsorted-dir-enumeration -- wrong tag
+        for f in os.listdir(d):
+            process(f)
+    """
+    assert rules_of(src) == ["unsorted-dir-enumeration"]
+
+
+def test_unused_suppression_flagged():
+    src = """
+    def clean():
+        # detlint: disable=unseeded-rng -- nothing here draws
+        return 1
+    """
+    assert rules_of(src) == ["unused-suppression"]
+
+
+# ------------------------------------------------------------------------ CLI
+#: rule -> (relpath under --root, seeded source). Det-path-only rules get
+#: a path inside DET_PATH_GLOBS so the fixture actually fires.
+_SEEDED_FIXTURES = {
+    "unsorted-dir-enumeration": ("pkg/scan.py", """
+        import os
+
+        def scan(d):
+            for f in os.listdir(d):
+                process(f)
+    """),
+    "unseeded-rng": ("pkg/rng.py", """
+        import numpy as np
+
+        def jiggle(x):
+            return x + np.random.uniform(-1, 1)
+    """),
+    "wallclock-in-deterministic-path": ("seist_tpu/data/stamp.py", """
+        import time
+
+        def stamp(row):
+            row["t"] = time.time()
+            return row
+    """),
+    "set-or-dict-order-dependence": ("pkg/order.py", """
+        def dedup(xs):
+            return list(set(xs))
+    """),
+    "float-reduction-order": ("seist_tpu/batch/red.py", """
+        def mean(ts):
+            return sum(t / 2.0 for t in ts) / len(ts)
+    """),
+    "env-dependent-default": ("seist_tpu/data/knob.py", """
+        import os
+
+        def knob():
+            return os.environ.get("MY_SECRET_KNOB", "1")
+    """),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_SEEDED_FIXTURES))
+def test_cli_exits_nonzero_on_seeded_violation(rule, tmp_path):
+    """Acceptance: `python -m tools.detlint` exits nonzero on a seeded
+    violation fixture for every rule in the catalog."""
+    rel, src = _SEEDED_FIXTURES[rule]
+    mod = tmp_path / rel
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(textwrap.dedent(src))
+    rc = detlint_cli.main(
+        [rel, "--root", str(tmp_path),
+         "--baseline", str(tmp_path / "baseline.json")]
+    )
+    assert rc == 1
+    found = [f.rule for f in lint_source(textwrap.dedent(src), rel)]
+    assert rule in found
+
+
+def test_cli_repo_gate_is_green():
+    """Acceptance: a bare `python -m tools.detlint` (default paths) exits
+    0 on this repo, and its shipped baseline is EMPTY by construction."""
+    assert detlint_cli.main([]) == 0
+    with open(detlint_cli._DEFAULT_BASELINE) as f:
+        assert json.load(f)["accepted"] == {}
+
+
+def test_cli_refuses_update_of_empty_baseline(tmp_path):
+    baseline = tmp_path / "detlint_baseline.json"
+    baseline.write_text('{"accepted": {}}\n')
+    before = baseline.read_text()
+    rc = detlint_cli.main(
+        ["--update-baseline", "--root", str(tmp_path.parent),
+         "--baseline", str(baseline)]
+    )
+    assert rc == 2
+    assert baseline.read_text() == before
+
+
+def test_cli_unknown_path_exits_2(tmp_path):
+    rc = detlint_cli.main(
+        ["no/such/dir", "--root", str(tmp_path),
+         "--baseline", str(tmp_path / "b.json")]
+    )
+    assert rc == 2
+
+
+def test_cli_list_rules_names_full_catalog(capsys):
+    from tools.detlint.rules import RULES
+
+    assert detlint_cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert len(RULES) >= 6
+    for rule in RULES:
+        assert rule.name in out
+
+
+# ------------------------------------------------------------- runtime lane
+def test_digest_tree_and_relink_preserve_bytes(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.bin").write_bytes(b"alpha")
+    (src / "z.bin").write_bytes(b"omega")
+    (src / "sub" / "m.npz").write_bytes(b"middle")
+    (src / ".tmp.partial").write_bytes(b"torn write")  # must be ignored
+    d1 = digest_tree(str(src))
+    assert set(d1) == {"a.bin", "z.bin", "sub/m.npz"}
+
+    dst = tmp_path / "dst"
+    n = relink_tree(str(src), str(dst))
+    assert n == 3  # dotfile excluded
+    assert digest_tree(str(dst)) == d1
+    assert combine(digest_tree(str(dst))) == combine(d1)
+
+
+def test_combine_is_insertion_order_invariant():
+    a = {"x": "1", "y": "2"}
+    b = {"y": "2", "x": "1"}
+    assert combine(a) == combine(b)
+
+
+def test_journal_restore_survives_reversed_listing(tmp_path):
+    """Reversed-listdir regression (journal side): a journal directory
+    re-materialized with reversed entry-creation order restores the
+    SAME station set and the SAME states, byte for byte."""
+    from tools.replay_smoke import _journal_digest, _journal_exercise
+
+    result = _journal_exercise(str(tmp_path))
+    assert result["journal_rev_identical"]
+    # and independently: a fresh reversed copy digests identical too
+    jroot = str(tmp_path / "journal")
+    jrev2 = str(tmp_path / "journal_rev2")
+    relink_tree(jroot, jrev2)
+    assert _journal_digest(jrev2) == result["journal"]
+
+
+def test_pack_resume_survives_reversed_listing(tmp_path):
+    """Reversed-listdir regression (pack side): deleting the commit
+    point + last sidecar and RESUMING inside a reversed-relink copy of
+    the archive reproduces the original tree byte-identically."""
+    import seist_tpu
+    from tools.replay_smoke import _pack, _resume_exercise
+
+    seist_tpu.load_all()
+    archive = str(tmp_path / "archive")
+    _pack(archive, workers=1)
+    assert _resume_exercise(archive, workers=1, relink=True)
+
+
+@pytest.mark.smoke
+def test_replay_smoke_e2e_perturbed():
+    """Replay-lane e2e (cheap phases): 2 hash seeds x 2 worker counts ->
+    byte-identical pack/journal/WAL digests, reversed-listdir included.
+    The repick (model) phase rides the slow-marked twin below and the
+    `make replay-smoke` lane."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.replay_smoke", "--skip-repick"],
+        stdout=subprocess.PIPE, text=True, timeout=900,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"]
+    assert verdict["identical"] == {
+        "pack": True, "catalog": True, "journal": True, "wal": True,
+    }
+    seeds = {p["hashseed"] for p in verdict["perturbations"]}
+    workers = {p["workers"] for p in verdict["perturbations"]}
+    assert seeds == {0, 1} and workers == {1, 2}
+    assert any(p["relink"] for p in verdict["perturbations"])
+    assert verdict["resume_identical"]
+    assert verdict["reversed_listdir_identical"]
+
+
+@pytest.mark.slow
+def test_replay_smoke_e2e_full():
+    """The full lane including the repick (model) phase — identical
+    catalog bytes across serial and 2-worker map-reduce under different
+    PYTHONHASHSEED."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.replay_smoke"],
+        stdout=subprocess.PIPE, text=True, timeout=1800,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"]
+    assert verdict["digests"]["catalog"]

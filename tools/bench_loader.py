@@ -239,7 +239,7 @@ def compare(out_path: str = "") -> int:
     def shard_bytes(d):
         return sum(
             os.path.getsize(os.path.join(d, f))
-            for f in os.listdir(d)
+            for f in sorted(os.listdir(d))
             if f.startswith("shard_") and f.endswith(".bin")
         )
 

@@ -1,0 +1,19 @@
+"""detlint — determinism & reproducibility static analysis.
+
+The fourth analyzer of the jaxlint/threadlint/irlint family (same
+engine, same rationale-required suppression grammar via
+``# detlint: disable=<rule> -- <rationale>``, same line-shift-proof
+baseline — empty by construction, like irlint's and threadlint's),
+aimed at the bug class every byte-identity contract in this repo is
+exposed to: unsorted directory enumeration, global/unseeded RNG state,
+wall-clock leaking into det-critical modules, set/dict iteration order,
+float reduction order, and unregistered environment reads.
+
+``tools/replay_smoke.py`` adds the runtime replay lane (the lockgraph
+analogue): pack -> resume -> repick -> journal-restore run twice under
+perturbation (PYTHONHASHSEED, worker count, shuffled directory inode
+order) with every digest pinned byte-identical. See
+docs/STATIC_ANALYSIS.md "Determinism analysis".
+"""
+
+from tools.detlint.engine import lint_paths, lint_source  # noqa: F401

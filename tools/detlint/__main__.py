@@ -1,0 +1,50 @@
+"""detlint CLI — the jaxlint frontend bound to the determinism catalog.
+
+    python -m tools.detlint                       # gate the default surface
+    python -m tools.detlint seist_tpu/data        # subset
+    python -m tools.detlint --no-baseline         # everything
+    python -m tools.detlint --list-rules
+
+Exit codes: 0 clean (vs baseline), 1 new findings, 2 usage/parse error.
+The baseline (tools/detlint_baseline.json) is EMPTY BY CONSTRUCTION:
+--update-baseline REFUSES to write while it is empty — fix the code or
+add a rationale'd ``# detlint: disable`` instead of grandfathering.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from tools.detlint.rules import RULES, RULES_BY_NAME
+from tools.jaxlint.__main__ import run
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_DEFAULT_BASELINE = os.path.join(
+    _REPO_ROOT, "tools", "detlint_baseline.json"
+)
+
+#: The gated surface when no paths are given: the whole library plus the
+#: tools the contracts run through (pack/repick/bench drivers). Matches
+#: what `make lint` feeds the combined runner.
+DEFAULT_PATHS = ("seist_tpu", "tools")
+
+
+def main(argv=None) -> int:
+    return run(
+        argv,
+        tag="detlint",
+        catalog=RULES,
+        rules_by_name=RULES_BY_NAME,
+        default_baseline=_DEFAULT_BASELINE,
+        docs="docs/STATIC_ANALYSIS.md §Determinism analysis",
+        example_paths="seist_tpu tools",
+        refuse_empty_baseline_update=True,
+        default_paths=DEFAULT_PATHS,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+"""detlint engine: the shared lint framework (tools/jaxlint/engine.py)
+bound to the ``detlint`` suppression tag and rule catalog.
+
+Everything structural — :class:`ModuleInfo`, rationale-required
+suppressions, the line-shift-proof :class:`Baseline`, file iteration —
+IS jaxlint's engine; the analyzers differ only in tag and rules, so a
+``# jaxlint: disable`` / ``# threadlint: disable`` comment can never
+silence a detlint finding (and vice versa) while the grammar and
+workflow stay identical.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from tools.jaxlint import engine as _engine
+from tools.jaxlint.engine import (  # noqa: F401  (re-exported surface)
+    META_RULES,
+    Baseline,
+    Finding,
+    ModuleInfo,
+    Suppression,
+    iter_python_files,
+)
+
+TAG = "detlint"
+
+
+def parse_suppressions(info: ModuleInfo):
+    return _engine.parse_suppressions(info, TAG)
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Sequence] = None,
+) -> List[Finding]:
+    from tools.detlint.rules import RULES
+
+    return _engine.lint_source(source, path, rules, tag=TAG, catalog=RULES)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    rules: Optional[Sequence] = None,
+) -> List[Finding]:
+    from tools.detlint.rules import RULES
+
+    return _engine.lint_paths(paths, root, rules, tag=TAG, catalog=RULES)

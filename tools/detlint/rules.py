@@ -1,0 +1,611 @@
+"""detlint rule catalog — determinism & reproducibility hazards, repo-tuned.
+
+Every rule is a pure function of one
+:class:`~tools.jaxlint.engine.ModuleInfo`. Nearly every load-bearing
+contract in this repo is a determinism contract — plan-first N-worker
+bit-identical packing, byte-identical repick catalogs across kill/resume
+histories, journal restore parity, deterministic alert IDs — and this
+catalog encodes the bug classes that silently break those contracts on a
+*different machine* while passing every test on this one:
+
+* **Filesystem order is not an order** (`unsorted-dir-enumeration`):
+  ``os.listdir``/``glob.glob``/``Path.iterdir`` return inode order, which
+  differs across filesystems, mounts, and rsync histories. Any consumer
+  that is not provably order-insensitive (``sorted``/``set``/``len``/
+  membership/emptiness tests) is flagged. Simple local dataflow follows a
+  result assigned to a name: the name is exempt only if EVERY use in its
+  scope is order-insensitive (``names = os.listdir(p)`` later consumed
+  inside ``sorted(...)`` passes; ``dumps[0]`` on an unsorted glob fails).
+* **Global RNG state is a hidden input** (`unseeded-rng`): module-level
+  ``np.random.*`` / stdlib ``random.*`` draws depend on whoever seeded
+  (or forgot to seed) the process; zero-arg ``default_rng()`` /
+  ``RandomState()`` are OS-entropy seeded; ``jax.random.PRNGKey(time...)``
+  launders wall-clock into the key tree. Registered seed plumbing
+  (``*.seed(...)``, constructing seeded generators) is exempt.
+* **Wall-clock reaches data** (`wallclock-in-deterministic-path`): in
+  modules declared determinism-critical (:data:`DET_PATH_GLOBS`),
+  ``time.time()``/``datetime.now()`` taint anything they touch — shard
+  metadata, catalog rows, alert IDs. Telemetry-only functions opt out via
+  the ``@telemetry_only`` decorator (seist_tpu/utils/determinism.py);
+  ``time.monotonic``/``perf_counter`` are exempt BY DESIGN — interval
+  measurement never serializes an absolute timestamp.
+* **Set iteration order is hash order** (`set-or-dict-order-dependence`):
+  iterating a set (or materializing one via ``list(set(...))`` — the
+  classic dedup-order bug) feeds PYTHONHASHSEED-dependent order into
+  whatever consumes it; ``dict.keys()`` piped straight into a digest or
+  ``join`` serializes insertion order. Both flagged unless sorted first.
+* **Float addition is not associative** (`float-reduction-order`): a
+  Python ``sum()`` over floats in a det-critical module changes in the
+  last ulp when pairing order changes — exactly what varies with worker
+  count. ``math.fsum`` (exact) or a stacked ``np.sum`` are the fixes.
+* **Environment is configuration, not entropy** (`env-dependent-default`):
+  an ``os.environ`` read in a det-critical module is a machine-dependent
+  default unless the variable is REGISTERED (:data:`REGISTERED_ENV`) —
+  registration means docs/DATA.md or docs/FAULT_TOLERANCE.md names it as
+  part of the run's recorded configuration.
+
+Known soundness limits (documented, accepted): aliasing ``env =
+os.environ`` hides reads from `env-dependent-default`; an enumeration
+passed across a function boundary before sorting is invisible to the
+local dataflow; ``time.monotonic`` persisted to disk would be a real bug
+the wallclock rule cannot see. The replay lane (tools/replay_smoke.py)
+exists to catch dynamically what these static limits miss.
+
+False positives are expected to be rare and cheap: suppress inline with
+``# detlint: disable=<rule> -- <rationale>``. The baseline
+(tools/detlint_baseline.json) is EMPTY BY CONSTRUCTION — the frontend
+refuses --update-baseline while it is empty. See docs/STATIC_ANALYSIS.md
+"Determinism analysis".
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.jaxlint.engine import Finding, ModuleInfo
+from tools.jaxlint.rules import Rule
+
+#: Modules whose outputs are pinned byte-identical by a repo contract
+#: (docs/DATA.md pack/resume/repick, docs/FAULT_TOLERANCE.md journal
+#: restore + alert dedup). Rules marked "det-path only" fire nowhere
+#: else: wall-clock in a bench harness is telemetry, wall-clock in the
+#: catalog merge is a broken contract.
+DET_PATH_GLOBS = (
+    "seist_tpu/data/*.py",
+    "seist_tpu/batch/*.py",
+    "seist_tpu/stream/journal.py",
+    "seist_tpu/stream/session.py",
+    "seist_tpu/stream/assoc.py",
+    "tools/pack_dataset.py",
+    "tools/repick_archive.py",
+)
+
+#: Environment variables a det-path module MAY read: each is recorded
+#: run configuration (docs name it, smoke lanes pin it) rather than
+#: ambient machine state. Extend this registry — with a docs cross-ref —
+#: instead of suppressing inline when a variable becomes part of the
+#: recorded contract.
+REGISTERED_ENV_EXACT = frozenset(
+    (
+        "SEIST_IO_GUARD",  # docs/FAULT_TOLERANCE.md — guard on/off switch
+        "SEIST_INGEST_REUSE_STAGING",  # docs/DATA.md — staging reuse mode
+        "PYTHONHASHSEED",  # the replay lane's own perturbation axis
+        "JAX_PLATFORMS",  # backend pin, recorded by every smoke lane
+        "TMPDIR",  # staging root; never reaches bytes on disk
+        "HOME",  # cache roots only
+    )
+)
+REGISTERED_ENV_PREFIXES = (
+    "SEIST_FAULT_",  # fault injection — docs/FAULT_TOLERANCE.md registry
+    "SEIST_IO_",  # io_guard retry/backoff knobs — docs/FAULT_TOLERANCE.md
+)
+
+#: Builtins whose value is independent of input ordering — an enumeration
+#: consumed ONLY through these is safe unsorted. ``sum`` is deliberately
+#: absent: integer sums are order-independent but float sums are not, and
+#: statically we cannot tell which we have.
+_ORDER_INSENSITIVE_FUNCS = frozenset(
+    ("sorted", "set", "frozenset", "len", "any", "all", "max", "min", "bool")
+)
+
+_ENUM_EXACT = frozenset(
+    ("os.listdir", "os.scandir", "glob.glob", "glob.iglob")
+)
+_ENUM_PATH_ATTRS = frozenset(("iterdir", "glob", "rglob"))
+
+_TELEMETRY_DECORATOR = "telemetry_only"
+
+
+def _is_det_path(path: str) -> bool:
+    return any(fnmatch.fnmatch(path, g) for g in DET_PATH_GLOBS)
+
+
+def _subtree_contains(root: ast.AST, node: ast.AST) -> bool:
+    return any(n is node for n in ast.walk(root))
+
+
+def _consumed_order_insensitively(info: ModuleInfo, node: ast.AST) -> bool:
+    """True when ``node``'s value provably cannot leak ordering: wrapped
+    (at any ancestor depth) in an order-insensitive builtin, used as a
+    membership-test operand, or used only as a truthiness test."""
+    for a in info.ancestors(node):
+        if isinstance(a, ast.Call):
+            fname = info.dotted_name(a.func)
+            if fname in _ORDER_INSENSITIVE_FUNCS:
+                return True
+        elif isinstance(a, ast.Compare):
+            if any(isinstance(op, (ast.In, ast.NotIn)) for op in a.ops):
+                return True
+        elif isinstance(a, (ast.If, ast.While)):
+            if _subtree_contains(a.test, node):
+                return True
+        elif isinstance(a, ast.IfExp):
+            if _subtree_contains(a.test, node):
+                return True
+        elif isinstance(a, ast.Assert):
+            return True
+        elif isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Scope boundary: stop the walk — an enclosing call in an
+            # OUTER scope never receives this value.
+            return False
+    return False
+
+
+def _scope_of(info: ModuleInfo, node: ast.AST) -> ast.AST:
+    fn = info.enclosing_function(node)
+    return fn if fn is not None else info.tree
+
+
+def _name_loads(scope: ast.AST, name: str) -> List[ast.Name]:
+    return [
+        n
+        for n in ast.walk(scope)
+        if isinstance(n, ast.Name)
+        and n.id == name
+        and isinstance(n.ctx, ast.Load)
+    ]
+
+
+def _in_telemetry_fn(info: ModuleInfo, node: ast.AST) -> bool:
+    for a in info.ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in a.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                dotted = info.dotted_name(target)
+                if dotted.split(".")[-1] == _TELEMETRY_DECORATOR:
+                    return True
+    return False
+
+
+class UnsortedDirEnumeration(Rule):
+    name = "unsorted-dir-enumeration"
+    summary = (
+        "os.listdir/glob/iterdir result consumed order-sensitively "
+        "without sorted() — filesystem inode order differs across machines"
+    )
+    hint = (
+        "wrap the enumeration in sorted(...); if the consumer is provably "
+        "order-insensitive, suppress with a rationale"
+    )
+
+    def _is_enum_call(self, info: ModuleInfo, node: ast.Call) -> bool:
+        dotted = info.dotted_name(node.func)
+        if dotted in _ENUM_EXACT:
+            return True
+        return (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _ENUM_PATH_ATTRS
+            and not dotted.startswith("glob.")
+        )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._is_enum_call(info, node):
+                continue
+            if _consumed_order_insensitively(info, node):
+                continue
+            # Local dataflow: `names = os.listdir(p)` is exempt iff EVERY
+            # later use of `names` in this scope is order-insensitive.
+            parent = info.parents.get(node)
+            if (
+                isinstance(parent, ast.Assign)
+                and parent.value is node
+                and len(parent.targets) == 1
+                and isinstance(parent.targets[0], ast.Name)
+            ):
+                name = parent.targets[0].id
+                scope = _scope_of(info, parent)
+                loads = _name_loads(scope, name)
+                if loads and all(
+                    _consumed_order_insensitively(info, n) for n in loads
+                ):
+                    continue
+            call_name = info.dotted_name(node.func) or (
+                f".{node.func.attr}(...)"
+                if isinstance(node.func, ast.Attribute)
+                else "enumeration"
+            )
+            yield self.finding(
+                info,
+                node,
+                f"{call_name} returns filesystem order — consumers see a "
+                "different sequence on a different machine",
+            )
+
+
+_NP_RANDOM_PREFIXES = ("np.random.", "numpy.random.")
+#: np.random attrs that are seed plumbing or seeded-generator
+#: construction, not global-state draws.
+_NP_RANDOM_ALLOWED = frozenset(
+    (
+        "default_rng",
+        "Generator",
+        "RandomState",
+        "SeedSequence",
+        "PCG64",
+        "Philox",
+        "MT19937",
+        "SFC64",
+        "seed",
+        "get_state",
+        "set_state",
+    )
+)
+#: zero-arg constructors fall back to OS entropy — unseeded by definition
+_NP_NEED_SEED = frozenset(("default_rng", "RandomState", "SeedSequence"))
+_STD_RANDOM_ALLOWED = frozenset(
+    ("seed", "Random", "SystemRandom", "getstate", "setstate")
+)
+_NONDET_KEY_SOURCES = frozenset(
+    (
+        "time.time",
+        "time.time_ns",
+        "os.urandom",
+        "os.getpid",
+        "uuid.uuid4",
+        "uuid.uuid1",
+    )
+)
+
+
+class UnseededRng(Rule):
+    name = "unseeded-rng"
+    summary = (
+        "global-state or OS-entropy RNG (np.random.* / random.* draws, "
+        "zero-arg default_rng(), PRNGKey from wall-clock)"
+    )
+    hint = (
+        "thread a seeded np.random.Generator (default_rng(seed)) or a "
+        "jax PRNG key from the run's root seed; global seeding belongs "
+        "in utils.misc.seed_everything only"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = info.dotted_name(node.func)
+            if not dotted:
+                continue
+            head, _, _ = dotted.partition(".")
+            tail = dotted.rsplit(".", 1)[-1]
+
+            if dotted.startswith(_NP_RANDOM_PREFIXES):
+                if tail in _NP_NEED_SEED and not node.args:
+                    yield self.finding(
+                        info,
+                        node,
+                        f"{dotted}() with no seed draws OS entropy — "
+                        "results differ on every run",
+                    )
+                elif tail not in _NP_RANDOM_ALLOWED:
+                    yield self.finding(
+                        info,
+                        node,
+                        f"{dotted} draws from numpy's GLOBAL rng state — "
+                        "a hidden input seeded (or not) by whoever ran "
+                        "first",
+                    )
+                continue
+
+            if head == "random" and "random" not in info.jax_random_aliases:
+                if tail not in _STD_RANDOM_ALLOWED:
+                    yield self.finding(
+                        info,
+                        node,
+                        f"{dotted} draws from the stdlib GLOBAL rng state",
+                    )
+                continue
+
+            if tail in ("PRNGKey", "key") and (
+                head in info.jax_random_aliases
+                or dotted.startswith("jax.random.")
+            ):
+                for arg in node.args:
+                    for sub in ast.walk(arg):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and info.dotted_name(sub.func)
+                            in _NONDET_KEY_SOURCES
+                        ):
+                            yield self.finding(
+                                info,
+                                node,
+                                f"{dotted} seeded from "
+                                f"{info.dotted_name(sub.func)}() — the "
+                                "key tree is not reproducible",
+                            )
+                            break
+
+
+_WALLCLOCK_CALLS = frozenset(
+    (
+        "time.time",
+        "time.time_ns",
+        "time.ctime",
+        "time.localtime",
+        "time.gmtime",
+        "time.strftime",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+        "datetime.datetime.today",
+        "datetime.now",
+        "datetime.utcnow",
+        "datetime.today",
+        "datetime.date.today",
+        "date.today",
+    )
+)
+
+
+class WallclockInDeterministicPath(Rule):
+    name = "wallclock-in-deterministic-path"
+    summary = (
+        "time.time()/datetime.now() in a determinism-critical module "
+        "(DET_PATH_GLOBS) outside a @telemetry_only function"
+    )
+    hint = (
+        "pass timestamps in from the caller, or mark the enclosing "
+        "function @telemetry_only (seist_tpu.utils.determinism) if the "
+        "value never reaches shard bytes, catalog rows, or IDs; "
+        "time.monotonic/perf_counter are already exempt for intervals"
+    )
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        if not _is_det_path(info.path):
+            return
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = info.dotted_name(node.func)
+            if dotted not in _WALLCLOCK_CALLS:
+                continue
+            if _in_telemetry_fn(info, node):
+                continue
+            yield self.finding(
+                info,
+                node,
+                f"{dotted}() in det-critical module {info.path} — "
+                "wall-clock taints anything it touches (shard meta, "
+                "catalog rows, alert IDs)",
+            )
+
+
+def _is_setish(info: ModuleInfo, node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return isinstance(node, ast.Call) and info.dotted_name(node.func) in (
+        "set",
+        "frozenset",
+    )
+
+
+def _is_dict_view(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("keys", "values", "items")
+        and not node.args
+    )
+
+
+_ORDERING_SINKS = frozenset(("list", "tuple", "enumerate", "iter", "reversed"))
+
+
+class SetOrDictOrderDependence(Rule):
+    name = "set-or-dict-order-dependence"
+    summary = (
+        "set iteration order (hash order) or dict-view bytes reaching "
+        "an ordered consumer — list(set(...)), for-over-set, "
+        "''.join(keys()), digests"
+    )
+    hint = (
+        "sorted(set(...)) fixes both dedup and order; serialize dicts "
+        "with sort_keys=True or json-canonical helpers"
+    )
+
+    def _sink_of_setish(
+        self, info: ModuleInfo, node: ast.AST
+    ) -> Optional[str]:
+        parent = info.parents.get(node)
+        # direct iteration
+        if isinstance(parent, (ast.For, ast.AsyncFor)) and parent.iter is node:
+            return "for-loop iteration"
+        if isinstance(parent, ast.comprehension) and parent.iter is node:
+            return "comprehension iteration"
+        if isinstance(parent, ast.Call) and node in parent.args:
+            fname = info.dotted_name(parent.func)
+            if fname in _ORDERING_SINKS:
+                return f"{fname}(...)"
+            if (
+                isinstance(parent.func, ast.Attribute)
+                and parent.func.attr == "join"
+            ):
+                return "str.join"
+            if fname.startswith("hashlib."):
+                return fname
+        return None
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if _is_setish(info, node):
+                if _consumed_order_insensitively(info, node):
+                    continue
+                sink = self._sink_of_setish(info, node)
+                if sink is not None:
+                    yield self.finding(
+                        info,
+                        node,
+                        f"set iteration order feeds {sink} — hash order "
+                        "varies with PYTHONHASHSEED and across processes",
+                    )
+            elif _is_dict_view(node):
+                parent = info.parents.get(node)
+                if not (
+                    isinstance(parent, ast.Call) and node in parent.args
+                ):
+                    continue
+                fname = info.dotted_name(parent.func)
+                is_join = (
+                    isinstance(parent.func, ast.Attribute)
+                    and parent.func.attr == "join"
+                )
+                if is_join or fname.startswith("hashlib."):
+                    yield self.finding(
+                        info,
+                        node,
+                        f".{node.func.attr}() serialized via "
+                        f"{'str.join' if is_join else fname} — insertion "
+                        "order becomes output bytes; sort first",
+                    )
+
+
+class FloatReductionOrder(Rule):
+    name = "float-reduction-order"
+    summary = (
+        "builtin sum() over float terms in a det-critical module — "
+        "pairing order (worker count, chunking) changes the last ulp"
+    )
+    hint = (
+        "math.fsum(...) is exactly rounded regardless of order; or stack "
+        "into one array and np.sum with a fixed reduction shape"
+    )
+
+    @staticmethod
+    def _float_evidence(arg: ast.AST) -> Optional[str]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Div):
+                return "division in the summand"
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, float):
+                return f"float literal {sub.value!r}"
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id == "float"
+            ):
+                return "float(...) in the summand"
+        return None
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        if not _is_det_path(info.path):
+            return
+        for node in ast.walk(info.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "sum"
+                and node.args
+            ):
+                continue
+            evidence = self._float_evidence(node.args[0])
+            if evidence is None:
+                continue
+            yield self.finding(
+                info,
+                node,
+                f"sum() over floats ({evidence}) — float addition is not "
+                "associative, so the result depends on pairing order",
+            )
+
+
+class EnvDependentDefault(Rule):
+    name = "env-dependent-default"
+    summary = (
+        "os.environ read in a det-critical module for a variable not in "
+        "the REGISTERED_ENV registry"
+    )
+    hint = (
+        "register the variable in tools/detlint/rules.py REGISTERED_ENV_* "
+        "with a docs cross-ref (it becomes recorded run configuration), "
+        "or thread the value through explicit config"
+    )
+
+    @staticmethod
+    def _registered(name: str) -> bool:
+        return name in REGISTERED_ENV_EXACT or any(
+            name.startswith(p) for p in REGISTERED_ENV_PREFIXES
+        )
+
+    def _env_read_name(
+        self, info: ModuleInfo, node: ast.AST
+    ) -> Optional[Tuple[ast.AST, Optional[str]]]:
+        """(node-to-report, var-name-or-None) for environ reads; None
+        var-name means the name is not a literal."""
+        if isinstance(node, ast.Call):
+            dotted = info.dotted_name(node.func)
+            if dotted in ("os.getenv", "os.environ.get") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    return node, arg.value
+                return node, None
+        elif isinstance(node, ast.Subscript):
+            if info.dotted_name(node.value) == "os.environ":
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and isinstance(
+                    sl.value, str
+                ):
+                    return node, sl.value
+                return node, None
+        return None
+
+    def check(self, info: ModuleInfo) -> Iterator[Finding]:
+        if not _is_det_path(info.path):
+            return
+        for node in ast.walk(info.tree):
+            hit = self._env_read_name(info, node)
+            if hit is None:
+                continue
+            report, name = hit
+            if name is None:
+                yield self.finding(
+                    info,
+                    report,
+                    "environ read with a non-literal variable name — "
+                    "cannot be checked against the registry",
+                )
+            elif not self._registered(name):
+                yield self.finding(
+                    info,
+                    report,
+                    f"environ read of unregistered {name!r} in a "
+                    "det-critical module — behavior now depends on "
+                    "ambient machine state",
+                )
+
+
+RULES: Tuple[Rule, ...] = (
+    UnsortedDirEnumeration(),
+    UnseededRng(),
+    WallclockInDeterministicPath(),
+    SetOrDictOrderDependence(),
+    FloatReductionOrder(),
+    EnvDependentDefault(),
+)
+
+RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULES}

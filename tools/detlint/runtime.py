@@ -1,0 +1,101 @@
+"""detlint runtime lane: replay-divergence helpers (the LockGraph
+analogue — docs/STATIC_ANALYSIS.md "Determinism analysis").
+
+Static rules prove the ABSENCE of known hazard patterns; this module
+provides the primitives ``tools/replay_smoke.py`` composes to prove the
+PRESENCE of the actual contract: run the pack -> resume -> repick ->
+journal-restore pipeline twice under perturbation (different
+``PYTHONHASHSEED``, different worker counts, shuffled directory inode
+order) and pin every digest byte-identical.
+
+* :func:`digest_tree` — sha256 per file under a root, keyed by posix
+  relpath, enumerated in SORTED order (the harness must not itself have
+  the bug it hunts).
+* :func:`relink_tree` — re-materialize a directory tree with directory-
+  entry CREATION order reversed (hard links when possible, copies as
+  fallback). On the filesystems this repo meets in practice, readdir
+  order follows entry creation order closely enough that an unsorted
+  ``os.listdir`` consumer sees a DIFFERENT sequence over the relinked
+  tree — the cheapest portable approximation of "same bytes, different
+  inode order" there is. A consumer that sorts is invariant either way,
+  which is exactly the property under test; on filesystems where
+  readdir order is name-hash-ordered the shim degrades to a no-op
+  (same-bytes copy), never to a false failure.
+* :func:`combine` — one hex digest over a digest map, for one-line
+  verdicts.
+
+Everything here is stdlib-only and import-light: the replay children
+pay for jax exactly once each, in the repick phase, not at helper
+import time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+from typing import Dict, Iterable, Optional, Sequence
+
+__all__ = ["combine", "digest_file", "digest_tree", "relink_tree"]
+
+
+def digest_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def digest_tree(
+    root: str, suffixes: Optional[Sequence[str]] = None
+) -> Dict[str, str]:
+    """{posix relpath: sha256} for every file under ``root`` (optionally
+    filtered to ``suffixes``), walked in sorted order. Dotfiles are
+    skipped: in-flight atomic-write temporaries (``.foo.tmp``) are not
+    part of any contract."""
+    out: Dict[str, str] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if not d.startswith("."))
+        for fn in sorted(filenames):
+            if fn.startswith("."):
+                continue
+            if suffixes and not any(fn.endswith(s) for s in suffixes):
+                continue
+            ap = os.path.join(dirpath, fn)
+            rel = os.path.relpath(ap, root).replace(os.sep, "/")
+            out[rel] = digest_file(ap)
+    return out
+
+
+def combine(digests: Dict[str, str]) -> str:
+    """One canonical digest over a digest map (sorted key order — the
+    map's own iteration order must never matter)."""
+    h = hashlib.sha256()
+    for k in sorted(digests):
+        h.update(f"{k}={digests[k]}\n".encode())
+    return h.hexdigest()
+
+
+def relink_tree(src: str, dst: str) -> int:
+    """Rebuild ``src`` under ``dst`` with per-directory entry creation
+    order REVERSED relative to sorted-name order; returns the file
+    count. Hard links preserve bytes for free; cross-device falls back
+    to copy."""
+    n = 0
+    for dirpath, dirnames, filenames in os.walk(src):
+        dirnames[:] = sorted(d for d in dirnames if not d.startswith("."))
+        rel = os.path.relpath(dirpath, src)
+        ddir = dst if rel == "." else os.path.join(dst, rel)
+        os.makedirs(ddir, exist_ok=True)
+        for fn in sorted(
+            (f for f in filenames if not f.startswith(".")), reverse=True
+        ):
+            sp = os.path.join(dirpath, fn)
+            dp = os.path.join(ddir, fn)
+            try:
+                os.link(sp, dp)
+            except OSError:
+                shutil.copy2(sp, dp)
+            n += 1
+    return n

@@ -42,6 +42,7 @@ def run(
     add_args: Optional[Callable] = None,
     refuse_empty_baseline_update: bool = False,
     source_cache: Optional[Dict[str, str]] = None,
+    default_paths: Optional[Sequence[str]] = None,
 ) -> int:
     """The shared gate frontend. ``tag`` is both the suppression-comment
     tag and the ``python -m tools.<tag>`` program name.
@@ -55,7 +56,8 @@ def run(
     against an existing EMPTY baseline (empty-by-construction invariant);
     ``source_cache`` ({abspath: source}) lets a combined runner
     (tools/lint.py) walk + read every file exactly once for all
-    analyzers."""
+    analyzers; ``default_paths`` makes a bare ``python -m tools.<tag>``
+    lint that surface instead of erroring (detlint's whole-repo gate)."""
     ap = argparse.ArgumentParser(
         prog=f"python -m tools.{tag}",
         description=f"{tag} static analysis (see {docs})",
@@ -108,9 +110,13 @@ def run(
         return 0
 
     if not args.paths and collect is None:
-        ap.error(
-            f"no paths given (try: python -m tools.{tag} {example_paths})"
-        )
+        if default_paths:
+            args.paths = list(default_paths)
+        else:
+            ap.error(
+                f"no paths given (try: python -m tools.{tag} "
+                f"{example_paths})"
+            )
 
     rules = None
     if args.select:

@@ -1,16 +1,17 @@
-"""Combined static-analysis gate: jaxlint + threadlint + irlint in ONE
-interpreter invocation (``make lint``).
+"""Combined static-analysis gate: jaxlint + threadlint + detlint +
+irlint in ONE interpreter invocation (``make lint``).
 
-The three analyzers share the engine frontend (tools/jaxlint/__main__.py
+The four analyzers share the engine frontend (tools/jaxlint/__main__.py
 ``run``); this runner additionally shares the FILE WALK — every source
 file under the AST analyzers' paths is read exactly once into a source
-cache both consume — and combines the exit codes (worst wins, usage
-errors beat findings). irlint's manifest walk happens once as well; its
-extra flags keep their defaults here (use ``python -m tools.irlint`` to
-vary them).
+cache all three AST passes consume — and combines the exit codes (worst
+wins, usage errors beat findings). irlint's manifest walk happens once
+as well; its extra flags keep their defaults here (use ``python -m
+tools.irlint`` to vary them).
 
     python -m tools.lint              # the full gate
-    python -m tools.lint --skip-ir    # AST analyzers only (fast loop)
+    python -m tools.lint --skip-ir    # no program lowering (fast loop)
+    python -m tools.lint --skip-det   # skip the determinism catalog
 
 Exit codes: 0 all clean, 1 new findings in any analyzer, 2 usage/parse/
 lowering error in any analyzer.
@@ -33,6 +34,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 AST_ANALYZERS = (
     ("jaxlint", ("seist_tpu",)),
     ("threadlint", ("seist_tpu", "tools")),
+    ("detlint", ("seist_tpu", "tools")),
 )
 
 
@@ -59,6 +61,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         action="store_true",
         help="run only the AST analyzers (no program lowering)",
     )
+    ap.add_argument(
+        "--skip-det",
+        action="store_true",
+        help="skip the determinism catalog (detlint)",
+    )
     args = ap.parse_args(argv)
 
     from tools.jaxlint.__main__ import run
@@ -66,9 +73,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from tools.jaxlint.rules import RULES_BY_NAME as JAX_BY_NAME
     from tools.threadlint.rules import RULES as THREAD_RULES
     from tools.threadlint.rules import RULES_BY_NAME as THREAD_BY_NAME
+    from tools.detlint.rules import RULES as DET_RULES
+    from tools.detlint.rules import RULES_BY_NAME as DET_BY_NAME
 
+    ast_analyzers = tuple(
+        (tag, paths)
+        for tag, paths in AST_ANALYZERS
+        if not (tag == "detlint" and args.skip_det)
+    )
     all_paths: List[str] = []
-    for _tag, paths in AST_ANALYZERS:
+    for _tag, paths in ast_analyzers:
         all_paths.extend(paths)
     cache = _prewalk(all_paths)
 
@@ -97,6 +111,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         docs="docs/STATIC_ANALYSIS.md",
         source_cache=cache,
     )
+    if not args.skip_det:
+        print("== detlint ==")
+        rcs["detlint"] = run(
+            list(AST_ANALYZERS[2][1]),
+            tag="detlint",
+            catalog=DET_RULES,
+            rules_by_name=DET_BY_NAME,
+            default_baseline=os.path.join(
+                _REPO_ROOT, "tools", "detlint_baseline.json"
+            ),
+            docs="docs/STATIC_ANALYSIS.md",
+            refuse_empty_baseline_update=True,
+            source_cache=cache,
+        )
     if not args.skip_ir:
         print("== irlint ==")
         from tools.irlint.__main__ import main as irlint_main

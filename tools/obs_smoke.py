@@ -150,9 +150,9 @@ def main() -> None:
                 proc.kill()
 
     checks["exit_code_75"] = rc == PREEMPT_EXIT_CODE
-    dumps = glob.glob(
+    dumps = sorted(glob.glob(
         os.path.join(log_base, "*", "flight", "flight_stall_watchdog_*.json")
-    )
+    ))
     checks["dump_exists"] = bool(dumps)
     if dumps:
         dump = json.load(open(dumps[0]))
