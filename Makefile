@@ -7,7 +7,8 @@ CXXFLAGS ?= -O3 -fPIC -shared -std=c++17 -Wall
 .PHONY: native test t1 lint lint-baseline irlint-report lockgraph \
 	replay-smoke serve-smoke serve-chaos obs-smoke trace-smoke \
 	rollout-smoke chaos pack-smoke bench-loader repick-smoke \
-	bench-repick quant-smoke stream-smoke twin-smoke stream-chaos clean
+	bench-repick quant-smoke stream-smoke twin-smoke stream-chaos \
+	batch-chaos bench-batch-fleet clean
 
 native: $(NATIVE_DIR)/libwavekit.so
 
@@ -125,6 +126,30 @@ repick-smoke:
 # line. Committed headline: BENCH_repick_r02.json.
 quant-smoke:
 	JAX_PLATFORMS=cpu python -m tools.quant_smoke
+
+# Batch-fleet chaos lane (docs/FAULT_TOLERANCE.md "Batch fleet
+# faults"): a 3-worker LEASE fleet (tools/supervise_repick.py over
+# batch/fleet.py) re-picks a synthetic archive with every batch-plane
+# failure class injected at once — worker 0 rides out a lease-store
+# partition (commits while locally valid, parks, heals into a counted
+# fence-reject), worker 1 is SIGKILL'd at its first lease (expiry ->
+# peer reclaim at the next fencing token -> crash-budget relaunch),
+# worker 2 is preempted into the exit-75 contract (drain, release,
+# rejoin). Gates: fleet finishes unattended, merged catalog sha256 ==
+# the serial no-fault run, ZERO double-committed segments, and the
+# fence-reject counter accounts the zombie attempt. repick_smoke
+# geometry, so the XLA compile cache stays warm across lanes. One JSON
+# verdict line.
+batch-chaos:
+	JAX_PLATFORMS=cpu python -m tools.batch_chaos
+
+# Batch-fleet scaling headline (docs/FAULT_TOLERANCE.md): 3 lease
+# workers vs 1 over the same archive via supervise_repick, byte-identity
+# HARD-gated; the >= 1.8x wall-clock gate is enforced on >= 3-core
+# hosts and recorded as pending on the 1-core CI box (the quant_smoke
+# "tpu_run: pending" idiom). Committed headline: BENCH_batch_fleet_r01.json.
+bench-batch-fleet:
+	JAX_PLATFORMS=cpu python -m tools.bench_batch_fleet
 
 # Batch-vs-serve throughput headline (docs/DATA.md "Batch re-picking"):
 # the repick engine and tools/bench_serve on the SAME model/window/host,

@@ -95,18 +95,60 @@ def segment_path(out_dir: str, unit_id: int, seg: int) -> str:
     return os.path.join(out_dir, f"unit_{unit_id:05d}.seg_{seg:04d}.jsonl")
 
 
+def segment_fence_path(out_dir: str, unit_id: int, seg: int) -> str:
+    return segment_path(out_dir, unit_id, seg) + ".fence"
+
+
+def read_segment_fence(out_dir: str, unit_id: int, seg: int) -> Optional[int]:
+    """The fencing token recorded beside a fleet-committed segment, or
+    None for serial commits / the crash window between link and sidecar
+    (both tolerated by the merge audit — the segment bytes themselves
+    are identical either way)."""
+    try:
+        with open(segment_fence_path(out_dir, unit_id, seg)) as f:
+            return int(f.read().strip())
+    except FileNotFoundError:
+        return None
+
+
 def commit_segment(
-    out_dir: str, unit_id: int, seg: int, lines: Sequence[str]
+    out_dir: str,
+    unit_id: int,
+    seg: int,
+    lines: Sequence[str],
+    *,
+    fence: Optional[int] = None,
 ) -> str:
-    """Atomically commit one segment's catalog rows (tmp+rename; the pid
-    suffix keeps two workers erroneously owning the same unit from
-    corrupting each other's tmp — last rename wins with identical
-    content, since rows are a pure function of the plan)."""
+    """Atomically commit one segment's catalog rows.
+
+    Serial path (``fence=None``, the PR 14 contract unchanged):
+    tmp+rename — last rename wins with identical content, since rows are
+    a pure function of the plan.
+
+    Fleet path (``fence`` = the committer's lease fencing token):
+    EXCLUSIVE publish via ``os.link`` — the first committer wins and a
+    zombie worker racing past its fence check hits FileExistsError
+    instead of silently re-publishing (the engine converts that into the
+    counted ``DoubleCommit``). The winning fence is recorded in a
+    ``.fence`` sidecar AFTER the link so the merge audit can reject
+    stale-fence histories; catalog bytes are untouched (byte-identity
+    with serial runs is preserved — the sidecar is not merged)."""
     path = segment_path(out_dir, unit_id, seg)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write("".join(lines))
-    os.replace(tmp, path)
+    if fence is None:
+        os.replace(tmp, path)
+        return path
+    try:
+        os.link(tmp, path)
+    finally:
+        os.unlink(tmp)
+    fpath = segment_fence_path(out_dir, unit_id, seg)
+    ftmp = f"{fpath}.tmp.{os.getpid()}"
+    with open(ftmp, "w") as f:
+        f.write(str(int(fence)))
+    os.replace(ftmp, fpath)
     return path
 
 
@@ -164,21 +206,54 @@ def merge_catalog(
     commit_every: int,
     *,
     meta: Optional[Dict[str, Any]] = None,
+    fences: Optional[Dict[int, int]] = None,
 ) -> Dict[str, Any]:
     """Reduce step: concatenate every unit's segments in (unit, segment)
     order into ``catalog.jsonl`` (tmp+rename), then commit
     ``catalog_meta.json`` LAST. Refuses loudly while any segment is
-    missing (a partial merge would look complete)."""
+    missing (a partial merge would look complete).
+
+    ``fences`` (fleet merges only) maps unit id -> the fencing token the
+    unit was marked DONE under, from the lease store's done ledger. The
+    merge then audits every segment's ``.fence`` sidecar: a sidecar
+    GREATER than the done fence means a zombie published a segment after
+    the unit was already completed and handed over — the exactly-once
+    invariant is broken and the merge refuses. Sidecars at or below the
+    done fence are normal history (earlier incarnations' segments are
+    trusted: content is a pure function of the plan); a missing sidecar
+    is the link-to-sidecar crash window, also trusted. The audit summary
+    lands in ``catalog_meta.json``; catalog bytes never depend on it."""
     missing: List[str] = []
+    stale: List[str] = []
+    fleet_segments = 0
     for unit in units:
         total = segments_per_unit(unit, rows_per_call, commit_every)
+        done_fence = (fences or {}).get(unit.unit_id)
         for seg in range(total):
             if not os.path.exists(segment_path(out_dir, unit.unit_id, seg)):
                 missing.append(f"unit {unit.unit_id} seg {seg}")
+                continue
+            if fences is None:
+                continue
+            seg_fence = read_segment_fence(out_dir, unit.unit_id, seg)
+            if seg_fence is not None:
+                fleet_segments += 1
+                if done_fence is not None and seg_fence > done_fence:
+                    stale.append(
+                        f"unit {unit.unit_id} seg {seg}: committed under "
+                        f"fence {seg_fence} > done fence {done_fence}"
+                    )
     if missing:
         raise FileNotFoundError(
             f"catalog merge: {len(missing)} segment(s) not committed yet "
             f"(first: {missing[0]}) — finish or resume the workers first"
+        )
+    if stale:
+        raise ValueError(
+            f"catalog merge: {len(stale)} segment(s) carry a fence NEWER "
+            f"than the fence their unit was completed under (first: "
+            f"{stale[0]}) — a zombie worker wrote after handover; the "
+            "exactly-once commit invariant is broken, refusing to merge"
         )
     cat_path = os.path.join(out_dir, _CATALOG)
     tmp = f"{cat_path}.tmp.{os.getpid()}"
@@ -200,6 +275,12 @@ def merge_catalog(
         "n_units": len(units),
         "catalog": _CATALOG,
     })
+    if fences is not None:
+        out_meta["fleet"] = {
+            "done_fences": {str(k): fences[k] for k in sorted(fences)},
+            "fenced_segments": fleet_segments,
+            "stale_fence_segments": 0,  # a nonzero count never merges
+        }
     meta_tmp = os.path.join(out_dir, _CATALOG_META + f".tmp.{os.getpid()}")
     with open(meta_tmp, "w") as f:
         json.dump(out_meta, f, sort_keys=True)

@@ -488,12 +488,20 @@ class RepickEngine:
         *,
         commit_every: int = 4,
         stop_event: Optional[threading.Event] = None,
+        lease: Optional[Any] = None,  # batch.fleet.HeldLease
     ) -> Dict[str, Any]:
         """Re-pick one work unit, committing a segment every
         ``commit_every`` device calls; resumes at the first missing
         segment. Returns per-unit stats. ``stop_event`` (SIGTERM) is
         honored at segment boundaries — the current segment commits,
-        later ones stay holes for the resume."""
+        later ones stay holes for the resume.
+
+        Under a fleet ``lease`` every commit first passes the fence
+        guard ladder (``lease.check_commit()`` — raises FenceRejected /
+        LeaseLost when this worker no longer owns the unit) and the
+        segment is published EXCLUSIVELY with the lease's fencing token;
+        an existing segment file surfaces as ``fleet.DoubleCommit``
+        (zombie publish stopped at the filesystem, counted on the bus)."""
         from seist_tpu.data.pipeline import _double_buffer
         from seist_tpu.obs.bus import BUS, monotonic
 
@@ -557,9 +565,28 @@ class RepickEngine:
                 if (c + 1) == min((seg + 1) * commit_every, n_calls):
                     t0 = monotonic()
                     with BUS.span("batch_infer_write"):
-                        catalog.commit_segment(
-                            out_dir, unit.unit_id, seg, lines
-                        )
+                        if lease is not None:
+                            lease.check_commit()
+                            try:
+                                catalog.commit_segment(
+                                    out_dir, unit.unit_id, seg, lines,
+                                    fence=lease.fence,
+                                )
+                            except FileExistsError as e:
+                                # Counted by the fleet worker's guarded
+                                # store (single source for the bus + the
+                                # verdict-line mirror).
+                                from seist_tpu.batch import fleet
+
+                                raise fleet.DoubleCommit(
+                                    f"unit {unit.unit_id} seg {seg}: "
+                                    f"already committed — fence "
+                                    f"{lease.fence} raced past its check"
+                                ) from e
+                        else:
+                            catalog.commit_segment(
+                                out_dir, unit.unit_id, seg, lines
+                            )
                     self.stage["write"] += monotonic() - t0
                     lines = []
                     seg += 1
@@ -595,12 +622,23 @@ class RepickEngine:
         stop_event: Optional[threading.Event] = None,
         compile_gate: bool = False,
         progress: Optional[Any] = None,  # train.checkpoint.ProgressFile
+        unit_retries: int = 0,
     ) -> Dict[str, Any]:
         """Re-pick a worker's unit list. With ``compile_gate`` the whole
         post-warm-up loop runs inside a ``CompileBudget`` window (the
         jaxlint runtime monitor) and the stats report how many traces /
-        XLA compiles it saw — the acceptance gate pins ZERO."""
-        from seist_tpu.obs.bus import monotonic
+        XLA compiles it saw — the acceptance gate pins ZERO.
+
+        A unit that raises is retried up to ``unit_retries`` times (the
+        committed-segment resume makes a retry cheap: it restarts at the
+        unit's first hole), and EVERY failed attempt emits a structured
+        record — ``batch_unit_error{unit=,exc=}`` on the obs bus (so
+        /metrics.json distinguishes a STUCK unit from a slow one — the
+        fleet supervisor's signal) plus a ``unit_errors`` list entry in
+        the returned stats. With the default ``unit_retries=0`` the
+        exception still propagates after being recorded: fail-loud is
+        unchanged, just no longer invisible to telemetry."""
+        from seist_tpu.obs.bus import BUS, monotonic
 
         if not self._warm:
             self.warmup()
@@ -613,14 +651,40 @@ class RepickEngine:
         stats: Dict[str, Any] = {
             "units": 0, "units_skipped": 0, "rows": 0, "calls": 0,
             "segments": 0, "segments_skipped": 0, "preempted": False,
+            "unit_errors": [],
         }
         ctx = budget if budget is not None else _NullCtx()
         with ctx:
             for unit in units:
-                u = self.run_unit(
-                    unit, out_dir, commit_every=commit_every,
-                    stop_event=stop_event,
-                )
+                attempt = 0
+                while True:
+                    try:
+                        u = self.run_unit(
+                            unit, out_dir, commit_every=commit_every,
+                            stop_event=stop_event,
+                        )
+                        break
+                    except Exception as e:  # record + retry/re-raise: a
+                        # quarantined unit must be VISIBLE on the bus,
+                        # not only in a log line
+                        record = {
+                            "unit": unit.unit_id,
+                            "exc": type(e).__name__,
+                            "retries": attempt,
+                        }
+                        stats["unit_errors"].append(record)
+                        BUS.counter(
+                            "batch_unit_error",
+                            unit=str(unit.unit_id),
+                            exc=type(e).__name__,
+                        ).inc()
+                        logger.warning(
+                            f"[batch] unit {unit.unit_id} attempt "
+                            f"{attempt + 1} failed: {type(e).__name__}: {e}"
+                        )
+                        if attempt >= unit_retries:
+                            raise
+                        attempt += 1
                 stats["rows"] += u["rows"]
                 stats["calls"] += u["calls"]
                 stats["segments"] += u["segments"]

@@ -161,13 +161,15 @@ _SMOKE_FILES = {
     "test_router.py",
     "test_threadlint.py",
     "test_dist_broadcast.py",
+    "test_batch_fleet.py",  # lease plane: fake work, ms clocks (slow e2e opts out)
 }
 
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
         if os.path.basename(str(item.fspath)) in _SMOKE_FILES:
-            item.add_marker(pytest.mark.smoke)
+            if item.get_closest_marker("slow") is None:
+                item.add_marker(pytest.mark.smoke)
 
 
 def make_packed_dir(tmp_path_factory, n_events=24, trace_samples=1024,

@@ -1,0 +1,560 @@
+"""Lease plane for the batch fleet (seist_tpu/batch/fleet.py):
+
+* lease-store matrix (shared-directory AND the KV algorithm over an
+  in-memory fake): contention, TTL expiry + reclaim, fence
+  monotonicity, done markers;
+* guarded wrapper: retry-with-backoff over transient errors, the
+  LeaseStoreUnavailable ladder, injected partition windows;
+* HeldLease: heartbeat renewal, the check_commit fence ladder
+  (reachable-store fence reject; partitioned-store local-validity
+  window), lost-lease latching;
+* FleetWorker: work-stealing contention, partition park/heal,
+  preemption release, zombie completion rejection;
+* exactly-once segment publish: the fenced catalog.commit_segment
+  exclusive link + the merge-side stale-fence audit;
+* engine.run_units structured per-unit error records on the obs bus;
+* the exit-75 contract end-to-end in the FLEET path (slow/chaos lane):
+  preempt via SEIST_FAULT_BATCH_PREEMPT_UNIT, peer reclaim, rejoin,
+  merged catalog byte-identical to the serial run.
+
+Everything above the e2e runs with fake work and millisecond clocks —
+no jax, no model — so the matrix rides tier-1 and the lockgraph lane.
+"""
+
+import json
+import os
+import threading
+import time
+import types
+
+import pytest
+
+from seist_tpu.batch import catalog, fleet
+from seist_tpu.utils.faults import BatchFaultInjector, BatchFaultPlan
+
+# Millisecond clocks: every wait in this file is bounded by these.
+FAST = dict(
+    ttl_s=0.25, heartbeat_s=0.05, grace_s=0.02, retries=3,
+    backoff_base_s=0.01, backoff_cap_s=0.05, op_timeout_s=0.5,
+    park_s=0.02, rescan_s=0.02,
+)
+
+
+def _cfg(**over):
+    return fleet.LeaseConfig(**{**FAST, **over})
+
+
+def _inert():
+    return BatchFaultInjector(BatchFaultPlan())
+
+
+class FakeKV:
+    """In-memory KV speaking the KVLeaseStore protocol, with an
+    injectable failure window (fail_ops counts down per op)."""
+
+    def __init__(self):
+        self._d = {}
+        self._lock = threading.Lock()
+        self.fail_ops = 0
+
+    def _maybe_fail(self):
+        with self._lock:
+            if self.fail_ops > 0:
+                self.fail_ops -= 1
+                raise fleet.LeaseStoreError("injected kv failure")
+
+    def put_new(self, key, value):
+        self._maybe_fail()
+        with self._lock:
+            if key in self._d:
+                return False
+            self._d[key] = value
+            return True
+
+    def put(self, key, value):
+        self._maybe_fail()
+        with self._lock:
+            self._d[key] = value
+
+    def get(self, key):
+        self._maybe_fail()
+        with self._lock:
+            return self._d.get(key)
+
+    def keys(self, prefix):
+        self._maybe_fail()
+        with self._lock:
+            return sorted(k for k in self._d if k.startswith(prefix))
+
+
+@pytest.fixture(params=["dir", "kv"])
+def store(request, tmp_path):
+    if request.param == "dir":
+        return fleet.DirLeaseStore(str(tmp_path / "leases"))
+    return fleet.KVLeaseStore(FakeKV())
+
+
+# ---------------------------------------------------------- store matrix
+def test_acquire_contention_single_winner(store):
+    a = store.try_acquire(7, "w0", ttl_s=5.0)
+    assert a is not None and a.fence == 1 and a.owner == "w0"
+    assert store.try_acquire(7, "w1", ttl_s=5.0) is None  # held, live
+    assert store.current_fence(7) == 1
+
+
+def test_ttl_expiry_then_reclaim_bumps_fence(store):
+    a = store.try_acquire(1, "w0", ttl_s=0.05, grace_s=0.02)
+    assert a.fence == 1
+    # grace not yet elapsed: deadline alone doesn't open the reclaim
+    time.sleep(0.06)
+    b = store.try_acquire(1, "w1", ttl_s=5.0, grace_s=5.0)
+    assert b is None
+    time.sleep(0.02)
+    b = store.try_acquire(1, "w1", ttl_s=5.0, grace_s=0.02)
+    assert b is not None and b.fence == 2 and b.owner == "w1"
+    # the zombie's renew now fails authoritatively
+    with pytest.raises(fleet.LeaseLost, match="fence advanced"):
+        store.renew(a, 5.0)
+
+
+def test_fence_strictly_monotonic_across_handoffs(store):
+    fences = []
+    for i in range(4):
+        rec = store.try_acquire(2, f"w{i}", ttl_s=5.0)
+        assert rec is not None
+        fences.append(rec.fence)
+        store.release(rec)  # zero deadline -> immediate reclaimability
+        time.sleep(0.03)  # > grace
+    assert fences == [1, 2, 3, 4]
+
+
+def test_renew_extends_deadline(store):
+    a = store.try_acquire(3, "w0", ttl_s=0.2)
+    b = store.renew(a, 5.0)
+    assert b.fence == a.fence and b.deadline > a.deadline
+    assert store.peek(3).deadline == b.deadline
+
+
+def test_mark_done_first_writer_wins_and_blocks_acquire(store):
+    a = store.try_acquire(4, "w0", ttl_s=5.0)
+    assert store.mark_done(4, a.fence, "w0") is True
+    assert store.mark_done(4, 9, "w1") is False  # first writer won
+    assert store.done_fence(4) == a.fence
+    assert store.is_done(4)
+    assert store.try_acquire(4, "w1", ttl_s=5.0) is None
+    with pytest.raises(fleet.LeaseLost):
+        store.renew(fleet.LeaseRecord(4, a.fence + 1, "w1", 0.0), 5.0)
+    assert store.done_fences([4, 5]) == {4: a.fence}
+
+
+# ------------------------------------------------------- guarded wrapper
+def test_guarded_retries_transient_then_succeeds():
+    kv = FakeKV()
+    g = fleet.GuardedLeaseStore(
+        fleet.KVLeaseStore(kv), config=_cfg(), faults=_inert()
+    )
+    kv.fail_ops = 2  # < retries: the caller never sees the failures
+    rec = g.try_acquire(0, "w0")
+    assert rec is not None and rec.fence == 1
+    assert g.snapshot()["store_errors"] == 2
+
+
+def test_guarded_unavailable_after_retry_budget():
+    kv = FakeKV()
+    g = fleet.GuardedLeaseStore(
+        fleet.KVLeaseStore(kv), config=_cfg(), faults=_inert()
+    )
+    kv.fail_ops = 10_000
+    with pytest.raises(fleet.LeaseStoreUnavailable):
+        g.try_acquire(0, "w0")
+    assert g.snapshot()["store_errors"] >= g.config.retries
+
+
+def test_guarded_passes_lease_lost_through_unretried():
+    kv = FakeKV()
+    st = fleet.KVLeaseStore(kv)
+    g = fleet.GuardedLeaseStore(st, config=_cfg(), faults=_inert())
+    a = g.try_acquire(0, "w0")
+    st.mark_done(0, a.fence + 1, "w1")
+    before = g.snapshot()["store_errors"]
+    with pytest.raises(fleet.LeaseLost):
+        g.renew(a)
+    assert g.snapshot()["store_errors"] == before  # authoritative, no retry
+
+
+def test_injected_partition_window_is_transient():
+    """BatchFaultInjector partition: ops inside the window raise, ops
+    after it succeed — the guarded wrapper surfaces Unavailable during
+    and recovers after (the park/heal cycle's store-level substrate)."""
+    inj = BatchFaultInjector(BatchFaultPlan(
+        partition_after_s=0.0, partition_for_s=0.15,
+    ))
+    g = fleet.GuardedLeaseStore(
+        fleet.KVLeaseStore(FakeKV()),
+        config=_cfg(op_timeout_s=0.08, retries=2), faults=inj,
+    )
+    with pytest.raises(fleet.LeaseStoreUnavailable):
+        g.try_acquire(0, "w0")  # also anchors the injector's clock
+    time.sleep(0.16)
+    assert g.try_acquire(0, "w0") is not None  # healed
+
+
+# ------------------------------------------------------------ held lease
+def test_heartbeat_keeps_short_ttl_alive(tmp_path):
+    g = fleet.GuardedLeaseStore(
+        fleet.DirLeaseStore(str(tmp_path)), config=_cfg(), faults=_inert()
+    )
+    held = fleet.HeldLease(g, g.try_acquire(0, "w0"))
+    try:
+        time.sleep(0.4)  # > ttl without renewal
+        held.check_commit()  # heartbeat renewed through it
+        assert held.locally_valid()
+        assert g.try_acquire(0, "w1") is None  # still held
+    finally:
+        held.stop()
+    assert g.snapshot()["renews"] >= 3
+
+
+def test_check_commit_rejects_advanced_fence(tmp_path):
+    st = fleet.DirLeaseStore(str(tmp_path))
+    g = fleet.GuardedLeaseStore(st, config=_cfg(), faults=_inert())
+    rec = g.try_acquire(0, "w0")
+    held = fleet.HeldLease(g, rec)
+    try:
+        # A peer reclaims behind our back (forced via release).
+        st.release(rec)
+        time.sleep(0.03)
+        assert st.try_acquire(0, "w1", ttl_s=5.0, grace_s=0.02).fence == 2
+        with pytest.raises(fleet.FenceRejected):
+            held.check_commit()
+        # the reject latches: later commits refuse without store I/O
+        with pytest.raises(fleet.FenceRejected):
+            held.check_commit()
+    finally:
+        held.stop()
+    assert g.snapshot()["fence_rejects"] >= 1
+
+
+def test_check_commit_partition_honors_local_validity():
+    """Store partitioned at commit time: allowed while locally valid
+    (no peer CAN have reclaimed yet), refused once the local window
+    passes — the degradation ladder's middle rungs."""
+    kv = FakeKV()
+    g = fleet.GuardedLeaseStore(
+        fleet.KVLeaseStore(kv),
+        config=_cfg(ttl_s=0.3, op_timeout_s=0.05, retries=2),
+        faults=_inert(),
+    )
+    held = fleet.HeldLease(g, g.try_acquire(0, "w0"))
+    try:
+        kv.fail_ops = 1 << 30  # hard partition from here on
+        held.check_commit()  # locally valid -> allowed
+        time.sleep(0.35)  # local validity window expires
+        with pytest.raises(fleet.LeaseLost, match="locally expired|unreachable"):
+            held.check_commit()
+    finally:
+        kv.fail_ops = 0
+        held.stop()
+
+
+# ------------------------------------------------------ exactly-once commit
+def test_fenced_commit_exclusive_and_sidecar(tmp_path):
+    out = str(tmp_path)
+    catalog.commit_segment(out, 0, 0, ["a\n"], fence=1)
+    assert catalog.read_segment_fence(out, 0, 0) == 1
+    with open(catalog.segment_path(out, 0, 0)) as f:
+        assert f.read() == "a\n"
+    # The zombie's publish: refused at the filesystem, content intact.
+    with pytest.raises(FileExistsError):
+        catalog.commit_segment(out, 0, 0, ["a\n"], fence=2)
+    assert catalog.read_segment_fence(out, 0, 0) == 1
+    # Serial commits (fence=None) keep overwrite semantics.
+    catalog.commit_segment(out, 0, 1, ["b\n"])
+    catalog.commit_segment(out, 0, 1, ["b\n"])
+    assert catalog.read_segment_fence(out, 0, 1) is None
+
+
+def test_merge_audit_rejects_zombie_fence(tmp_path):
+    out = str(tmp_path)
+    units = [catalog.WorkUnit(0, 0, 8)]
+    catalog.commit_segment(out, 0, 0, ['{"row":0}\n'], fence=3)
+    # done under fence 2, sidecar says 3 -> a zombie wrote after handover
+    with pytest.raises(ValueError, match="zombie|NEWER"):
+        catalog.merge_catalog(out, units, 8, 1, fences={0: 2})
+    # done fence >= sidecar: normal history, merges + audited meta
+    meta = catalog.merge_catalog(out, units, 8, 1, fences={0: 3})
+    assert meta["fleet"]["fenced_segments"] == 1
+    assert meta["fleet"]["done_fences"] == {"0": 3}
+    # and the fence sidecar never reaches catalog bytes
+    with open(os.path.join(out, "catalog.jsonl")) as f:
+        assert f.read() == '{"row":0}\n'
+
+
+# ------------------------------------------------------------ fleet worker
+def _units(n):
+    return [types.SimpleNamespace(unit_id=i) for i in range(n)]
+
+
+def test_worker_contention_each_unit_once(tmp_path):
+    ran = []
+    results = {}
+
+    def work(owner, offset):
+        st = fleet.DirLeaseStore(str(tmp_path))
+        w = fleet.FleetWorker(
+            st, _units(5), owner,
+            lambda u, held: (ran.append((owner, u.unit_id)),
+                             {"preempted": False})[-1],
+            config=_cfg(), faults=_inert(), scan_offset=offset,
+        )
+        results[owner] = w.run()
+
+    ts = [
+        threading.Thread(target=work, args=(f"w{i}", i)) for i in range(3)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert sorted(u for _, u in ran) == [0, 1, 2, 3, 4]  # exactly once
+    assert all(r["all_done"] for r in results.values())
+    assert sum(r["units_done"] for r in results.values()) == 5
+
+
+def test_worker_parks_through_partition_then_heals(tmp_path):
+    inj = BatchFaultInjector(BatchFaultPlan(
+        partition_after_s=0.0, partition_for_s=0.25,
+    ))
+    w = fleet.FleetWorker(
+        fleet.DirLeaseStore(str(tmp_path)), _units(2), "w0",
+        lambda u, held: {"preempted": False},
+        config=_cfg(op_timeout_s=0.05, retries=2), faults=inj,
+    )
+    stats = w.run()
+    assert stats["all_done"] and stats["units_done"] == 2
+    assert stats["parks"] >= 1  # it parked, it never crashed
+    assert stats["lease"]["store_errors"] >= 1
+
+
+def test_worker_preempt_releases_lease_for_peer(tmp_path):
+    st = fleet.DirLeaseStore(str(tmp_path))
+    stop = threading.Event()
+
+    def preempted_work(u, held):
+        stop.set()  # SIGTERM lands mid-unit
+        return {"preempted": True}
+
+    w0 = fleet.FleetWorker(
+        st, _units(2), "w0", preempted_work,
+        config=_cfg(), faults=_inert(), stop_event=stop,
+    )
+    s0 = w0.run()
+    assert s0["preempted"] and not s0["all_done"]
+    assert s0["lease"]["releases"] == 1
+    # The peer reclaims the RELEASED lease immediately (fence 2) and
+    # finishes everything.
+    time.sleep(0.03)  # > grace
+    w1 = fleet.FleetWorker(
+        st, _units(2), "w1", lambda u, held: {"preempted": False},
+        config=_cfg(), faults=_inert(),
+    )
+    s1 = w1.run()
+    assert s1["all_done"] and s1["units_done"] == 2
+    assert s1["lease"]["reclaims"] >= 1
+
+
+def test_worker_abandons_lost_unit_to_peer(tmp_path):
+    st = fleet.DirLeaseStore(str(tmp_path))
+
+    def losing_work(u, held):
+        raise fleet.LeaseLost("simulated mid-run loss")
+
+    w = fleet.FleetWorker(
+        st, _units(1), "w0", losing_work,
+        config=_cfg(), faults=_inert(),
+    )
+    done = {}
+
+    def finish():
+        time.sleep(0.35)  # let w0's fence-1 lease expire
+        w1 = fleet.FleetWorker(
+            st, _units(1), "w1", lambda u, held: {"preempted": False},
+            config=_cfg(), faults=_inert(),
+        )
+        done.update(w1.run())
+
+    t = threading.Thread(target=finish)
+    t.start()
+    stats = w.run()
+    t.join(timeout=30)
+    assert stats["units_lost"] >= 1
+    assert stats["all_done"]  # w0 exits because the DONE marker exists
+    assert done["units_done"] == 1
+
+
+def test_worker_zombie_completion_counted_as_fence_reject(tmp_path):
+    """w0 finishes the work but a peer completed the unit under a later
+    fence while w0 was cut off — w0's done marker loses the race and the
+    stale fence is counted (the chaos lane's deterministic reject)."""
+    st = fleet.DirLeaseStore(str(tmp_path))
+    units = _units(1)
+
+    def slow_work(u, held):
+        # While w0 computes, the unit is released + completed by a peer
+        # under fence 2 (simulating expiry + reclaim during a partition).
+        st.release(held.record)
+        time.sleep(0.03)
+        rec2 = st.try_acquire(0, "w1", ttl_s=5.0, grace_s=0.02)
+        assert rec2.fence == 2
+        st.mark_done(0, rec2.fence, "w1")
+        return {"preempted": False}
+
+    w = fleet.FleetWorker(st, units, "w0", slow_work,
+                          config=_cfg(), faults=_inert())
+    stats = w.run()
+    assert stats["all_done"]
+    assert stats["units_lost"] == 1 and stats["units_done"] == 0
+    assert stats["lease"]["fence_rejects"] >= 1
+    assert stats["lease"]["double_commits"] == 0
+
+
+# ------------------------------------------------- engine error records
+def test_run_units_surfaces_structured_unit_errors(monkeypatch):
+    """Satellite: a failing unit is VISIBLE — a structured record in the
+    returned stats and a labeled counter on the obs bus (/metrics.json),
+    not only a log line; unit_retries re-runs it before re-raising."""
+    from seist_tpu.batch.engine import RepickEngine
+    from seist_tpu.obs.bus import BUS
+
+    eng = RepickEngine.__new__(RepickEngine)
+    eng._warm = True
+    eng.stage = {"fill": 0.0, "device": 0.0, "decode": 0.0, "write": 0.0}
+    calls = {"n": 0}
+
+    def flaky_run_unit(unit, out_dir, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("first attempt dies")
+        return {
+            "unit": unit.unit_id, "rows": 4, "calls": 1, "segments": 1,
+            "segments_skipped": 0, "preempted": False,
+        }
+
+    monkeypatch.setattr(eng, "run_unit", flaky_run_unit)
+    units = [catalog.WorkUnit(0, 0, 4)]
+    stats = eng.run_units(units, "/nonexistent", unit_retries=1)
+    assert stats["unit_errors"] == [
+        {"unit": 0, "exc": "OSError", "retries": 0}
+    ]
+    assert stats["rows"] == 4 and calls["n"] == 2
+    c = BUS.counter("batch_unit_error", unit="0", exc="OSError")
+    assert c.value >= 1
+
+    # Budget exhausted: the record lands, then the error propagates
+    # (fail-loud unchanged).
+    calls["n"] = -10_000  # every attempt fails
+    monkeypatch.setattr(
+        eng, "run_unit",
+        lambda *a, **k: (_ for _ in ()).throw(ValueError("stuck")),
+    )
+    with pytest.raises(ValueError, match="stuck"):
+        eng.run_units(units, "/nonexistent", unit_retries=1)
+
+
+# ------------------------------------------------------------ fleet e2e
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_exit75_fleet_preempt_reclaim_rejoin_byte_identical(
+    tmp_path, monkeypatch, capsys
+):
+    """The exit-75 contract END-TO-END in the fleet path: worker 0 is
+    preempted by the fault knob at its first lease (SIGTERM -> drain ->
+    release -> exit 75), a peer reclaims the released lease and finishes
+    the archive, the original worker REJOINS and finds only done
+    markers, and the fence-audited merge is byte-identical to the
+    serial no-fault run."""
+    import seist_tpu
+    from seist_tpu.utils import faults as faults_mod
+    from tools.repick_archive import main as repick_main
+
+    seist_tpu.load_all()
+    from seist_tpu.data.packed import PackSource, pack_sources
+
+    archive = pack_sources(
+        [PackSource(
+            name="synthetic",
+            dataset_kwargs={
+                "num_events": 22, "trace_samples": 256, "cache": False,
+            },
+        )],
+        str(tmp_path / "archive"),
+        samples_per_shard=10,
+    )["out"]
+    base = [
+        "--archive", archive, "--model", "phasenet",
+        "--batch-size", "4", "--batches-per-call", "2",
+        "--commit-every", "1",
+    ]
+    serial_out = str(tmp_path / "serial")
+    assert repick_main(base + ["--out", serial_out]) == 0
+    with open(os.path.join(serial_out, "catalog.jsonl"), "rb") as f:
+        serial_bytes = f.read()
+
+    fleet_out = str(tmp_path / "fleet")
+    lease_dir = str(tmp_path / "leases")
+    fl = base + [
+        "--out", fleet_out, "--fleet", "--lease-dir", lease_dir,
+        "--lease-store", "dir", "--no-merge",
+    ]
+
+    def run(worker, *, env=()):
+        # Fresh injector per incarnation (subprocess semantics in-proc).
+        for k in list(os.environ):
+            if k.startswith("SEIST_FAULT_BATCH_"):
+                monkeypatch.delenv(k)
+        for k, v in env:
+            monkeypatch.setenv(k, v)
+        monkeypatch.setattr(faults_mod, "_BATCH_FAULTS", None)
+        return repick_main(fl + [
+            "--worker-index", str(worker), "--worker-id", f"w{worker}",
+        ])
+
+    monkeypatch.setenv("SEIST_LEASE_TTL_S", "2.0")
+    monkeypatch.setenv("SEIST_LEASE_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("SEIST_LEASE_GRACE_S", "0.05")
+    stamp = str(tmp_path / "w0.stamp")
+    rc = run(0, env=(
+        ("SEIST_FAULT_BATCH_PREEMPT_UNIT", "1"),
+        ("SEIST_FAULT_STAMP", stamp),
+    ))
+    assert rc == 75  # the preemption contract
+    assert os.path.exists(stamp)
+
+    rc = run(1)  # the peer: reclaims the released lease, finishes all
+    assert rc == 0
+    verdicts = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    peer = [v for v in verdicts if v.get("owner") == "w1"][-1]
+    assert peer["all_done"]
+    assert peer["lease"]["reclaims"] >= 1  # took over w0's lease
+    assert peer["lease"]["double_commits"] == 0
+
+    rc = run(0)  # the original worker rejoins: nothing left, exits clean
+    assert rc == 0
+    verdicts = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    rejoined = [v for v in verdicts if v.get("owner") == "w0"][-1]
+    assert rejoined["all_done"] and rejoined["units_done"] == 0
+
+    assert repick_main([
+        "--archive", archive, "--out", fleet_out, "--merge-only",
+        "--lease-dir", lease_dir,
+    ]) == 0
+    with open(os.path.join(fleet_out, "catalog.jsonl"), "rb") as f:
+        assert f.read() == serial_bytes

@@ -265,6 +265,59 @@ def test_two_worker_kill_resume_byte_identical(
     assert meta["n_rows"] == N_EVENTS
 
 
+def test_fleet_workers_byte_identical_with_fence_audit(
+    repick_archive_dir, serial_catalog, tmp_path, monkeypatch, capsys
+):
+    """Fleet mode (work-unit leases + fencing tokens, batch/fleet.py)
+    over the same archive: worker 0 work-steals every unit, worker 1
+    joins late and finds only done markers, the merge audits each
+    segment's fence sidecar against the done-fence ledger — and the
+    catalog is byte-identical to the serial run. The lease plane costs
+    zero bytes."""
+    from tools.repick_archive import main as repick_main
+
+    out = str(tmp_path)
+    lease_dir = os.path.join(out, "leases")
+    monkeypatch.setenv("SEIST_LEASE_TTL_S", "10.0")
+    fl = [
+        "--fleet", "--lease-dir", lease_dir, "--lease-store", "dir",
+        "--no-merge",
+    ]
+    assert _repick(
+        repick_archive_dir, out, *fl, "--worker-index", "0",
+        "--worker-id", "w0",
+    ) == 0
+    assert _repick(
+        repick_archive_dir, out, *fl, "--worker-index", "1",
+        "--worker-id", "w1",
+    ) == 0
+    verdicts = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    w0 = next(v for v in verdicts if v.get("owner") == "w0")
+    w1 = next(v for v in verdicts if v.get("owner") == "w1")
+    assert w0["role"] == "fleet-worker" and w0["all_done"]
+    assert w0["units_done"] >= 1 and w0["lease"]["double_commits"] == 0
+    assert w1["all_done"] and w1["units_done"] == 0  # only done markers
+    assert repick_main([
+        "--archive", repick_archive_dir, "--out", out, "--merge-only",
+        "--lease-dir", lease_dir,
+    ]) == 0
+    merge = next(
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{") and json.loads(line).get("role") == "merge"
+    )
+    audit = merge["fence_audit"]
+    assert audit["fenced_segments"] >= 1
+    assert audit["stale_fence_segments"] == 0
+    assert len(audit["done_fences"]) == w0["units_done"]
+    with open(os.path.join(out, "catalog.jsonl"), "rb") as f:
+        assert f.read() == serial_catalog
+
+
 def test_resume_refuses_changed_geometry(repick_archive_dir, tmp_path):
     out = str(tmp_path)
     assert _repick(repick_archive_dir, out, "--no-merge") == 0

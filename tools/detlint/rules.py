@@ -90,6 +90,7 @@ DET_PATH_GLOBS = (
 REGISTERED_ENV_EXACT = frozenset(
     (
         "SEIST_IO_GUARD",  # docs/FAULT_TOLERANCE.md — guard on/off switch
+        "SEIST_BATCH_WORKER",  # docs/FAULT_TOLERANCE.md — fleet worker index
         "SEIST_INGEST_REUSE_STAGING",  # docs/DATA.md — staging reuse mode
         "PYTHONHASHSEED",  # the replay lane's own perturbation axis
         "JAX_PLATFORMS",  # backend pin, recorded by every smoke lane
@@ -100,6 +101,7 @@ REGISTERED_ENV_EXACT = frozenset(
 REGISTERED_ENV_PREFIXES = (
     "SEIST_FAULT_",  # fault injection — docs/FAULT_TOLERANCE.md registry
     "SEIST_IO_",  # io_guard retry/backoff knobs — docs/FAULT_TOLERANCE.md
+    "SEIST_LEASE_",  # batch-fleet lease plane — docs/FAULT_TOLERANCE.md
 )
 
 #: Builtins whose value is independent of input ordering — an enumeration

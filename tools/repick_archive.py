@@ -88,6 +88,27 @@ def get_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--retries", type=int, default=2,
                     help="driver: crash-relaunch budget per worker "
                     "(preempt exits never consume it)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="lease-based fleet worker (batch/fleet.py): "
+                    "instead of a static units[i::N] slice, acquire "
+                    "work-unit leases with heartbeat + fencing token, "
+                    "reclaim peers' expired leases, and park through "
+                    "lease-store partitions — any number of workers, "
+                    "joining and dying at any time, converge on the "
+                    "same catalog (docs/FAULT_TOLERANCE.md)")
+    ap.add_argument("--lease-dir", default="",
+                    help="shared-directory lease store root (fleet "
+                    "mode; also lets --merge-only audit segment fences "
+                    "against the done ledger)")
+    ap.add_argument("--worker-id", default="",
+                    help="fleet mode: this worker's lease owner id "
+                    "(default: worker<index>@<pid>)")
+    ap.add_argument("--lease-store", default="auto",
+                    choices=("auto", "dir", "kv"),
+                    help="fleet lease store: 'dir' = shared directory "
+                    "(--lease-dir), 'kv' = the jax coordination-service "
+                    "KV (multi-host slices), 'auto' = kv when a "
+                    "coordination service is initialized, else dir")
     ap.add_argument("--no-merge", action="store_true",
                     help="skip the reduce step (driver/smoke runs merge "
                     "separately)")
@@ -115,6 +136,9 @@ def get_args(argv=None) -> argparse.Namespace:
                      "plan file records them)")
     elif bool(args.model) == bool(args.model_group):
         ap.error("exactly one of --model / --model-group is required")
+    if args.fleet and args.lease_store != "kv" and not args.lease_dir:
+        ap.error("--fleet needs --lease-dir (or --lease-store kv under "
+                 "an initialized jax coordination service)")
     return args
 
 
@@ -187,6 +211,16 @@ def _merge(args, meta, units, print_verdict: bool = True) -> Dict[str, Any]:
     # or misattribute the producing model in catalog_meta.json.
     plan = catalog.read_plan(args.out)
     rows_per_call = int(plan["batch_size"]) * int(plan["batches_per_call"])
+    # Fleet merges audit every segment's fence sidecar against the lease
+    # store's done ledger (merge_catalog refuses zombie-written
+    # segments); catalog.jsonl bytes are identical either way.
+    fences = None
+    if args.lease_dir and os.path.isdir(args.lease_dir):
+        from seist_tpu.batch import fleet
+
+        fences = fleet.DirLeaseStore(args.lease_dir).done_fences(
+            [u.unit_id for u in units]
+        )
     out_meta = catalog.merge_catalog(
         args.out, units, rows_per_call, int(plan["commit_every"]),
         meta={
@@ -196,6 +230,7 @@ def _merge(args, meta, units, print_verdict: bool = True) -> Dict[str, Any]:
             "variant": plan["variant"],
             "plan": plan,
         },
+        fences=fences,
     )
     verdict = {
         "ok": True,
@@ -204,6 +239,8 @@ def _merge(args, meta, units, print_verdict: bool = True) -> Dict[str, Any]:
         "rows": out_meta["n_rows"],
         "units": out_meta["n_units"],
     }
+    if fences is not None:
+        verdict["fence_audit"] = out_meta["fleet"]
     if print_verdict:
         print(json.dumps(verdict))
     return verdict
@@ -288,6 +325,9 @@ def run_worker(args, worker_index: int, num_workers: int) -> int:
     # main thread, at the next segment boundary).
     signal.signal(signal.SIGTERM, lambda s, f: stop.set())
 
+    if args.fleet:
+        return _run_fleet_worker(args, worker_index, units, engine, stop)
+
     mine = list(units)[worker_index::num_workers]
     progress = ProgressFile(
         os.path.join(args.out, f"worker_{worker_index}.json")
@@ -313,6 +353,88 @@ def run_worker(args, worker_index: int, num_workers: int) -> int:
     if stats["preempted"]:
         return PREEMPT_EXIT_CODE
     return 0
+
+
+def _lease_store(args):
+    """Build the configured lease store. 'auto' prefers the jax
+    coordination-service KV (real multi-host slices) and falls back to
+    the shared directory when no service is initialized."""
+    from seist_tpu.batch import fleet
+
+    if args.lease_store in ("auto", "kv"):
+        try:
+            return fleet.KVLeaseStore.from_runtime()
+        except fleet.LeaseStoreError:
+            if args.lease_store == "kv":
+                raise
+    return fleet.DirLeaseStore(args.lease_dir)
+
+
+def _run_fleet_worker(args, worker_index, units, engine, stop) -> int:
+    """One FLEET worker: every unit is a candidate (work-stealing over
+    leases, scan rotated by the worker index); the engine runs each
+    leased unit with the fence guard on every segment commit. Exits 75
+    on preemption — the supervisor relaunches and the worker re-joins
+    whatever work is still unleased."""
+    from seist_tpu.batch import fleet
+    from seist_tpu.train.checkpoint import PREEMPT_EXIT_CODE, ProgressFile
+
+    owner = args.worker_id or f"worker{max(worker_index, 0)}@{os.getpid()}"
+    store = _lease_store(args)
+    progress = ProgressFile(
+        os.path.join(args.out, f"fleet_{max(worker_index, 0)}.json")
+    )
+    engine.warmup()  # burn compile time BEFORE any lease TTL is ticking
+    totals = {"rows": 0, "calls": 0, "segments": 0}
+
+    def run_one(unit, held):
+        u = engine.run_unit(
+            unit, args.out, commit_every=args.commit_every,
+            stop_event=stop, lease=held,
+        )
+        for k in totals:
+            totals[k] += u[k]
+        progress.save({
+            "owner": owner, "unit": unit.unit_id, "fence": held.fence,
+            "preempted": u["preempted"], **totals,
+        })
+        return u
+
+    worker = fleet.FleetWorker(
+        store, units, owner, run_one,
+        stop_event=stop, scan_offset=max(worker_index, 0),
+    )
+    budget = None
+    if args.compile_gate:
+        from tools.jaxlint.runtime import CompileBudget
+
+        budget = CompileBudget()
+        budget.__enter__()
+    try:
+        stats = worker.run()
+    finally:
+        if budget is not None:
+            budget.__exit__(None, None, None)
+    verdict = {
+        "ok": stats["all_done"] or stats["preempted"],
+        "role": "fleet-worker",
+        "worker": worker_index,
+        "owner": owner,
+        "store": type(store).__name__,
+        **{k: stats[k] for k in (
+            "units_done", "units_lost", "parks", "preempted", "all_done",
+        )},
+        **totals,
+        "lease": stats["lease"],
+        **{f"warmup_{k}": v for k, v in engine.warmup_report.items()},
+    }
+    if budget is not None:
+        verdict["compiles_after_warmup"] = budget.total("")
+        verdict["xla_compiles_after_warmup"] = budget.backend_compiles
+    print(json.dumps(verdict), flush=True)
+    if stats["preempted"] and not stats["all_done"]:
+        return PREEMPT_EXIT_CODE
+    return 0 if verdict["ok"] else 1
 
 
 def _load_station_meta(path: str):

@@ -109,13 +109,23 @@ def _repick(archive: str, out: str, workers: int) -> str:
         rc = repick_main(base)
         assert rc == 0, f"serial repick rc={rc}"
     else:
+        # Multi-worker children ride the FLEET path (lease + fencing
+        # token, batch/fleet.py) so the divergence grid also proves the
+        # lease plane costs zero bytes: worker 0 work-steals every unit,
+        # worker 1 joins late and finds only done markers — the merge
+        # audits each segment's fence sidecar against the done ledger.
+        lease_dir = os.path.join(out, "leases")
         for i in range(workers):
             rc = repick_main(base + [
-                "--worker-index", str(i), "--num-workers", str(workers),
+                "--fleet", "--lease-dir", lease_dir, "--lease-store", "dir",
+                "--worker-index", str(i), "--worker-id", f"w{i}",
                 "--no-merge",
             ])
-            assert rc == 0, f"repick worker {i} rc={rc}"
-        rc = repick_main(["--archive", archive, "--out", out, "--merge-only"])
+            assert rc == 0, f"fleet repick worker {i} rc={rc}"
+        rc = repick_main([
+            "--archive", archive, "--out", out, "--merge-only",
+            "--lease-dir", lease_dir,
+        ])
         assert rc == 0, f"repick merge rc={rc}"
     return digest_file(os.path.join(out, "catalog.jsonl"))
 
